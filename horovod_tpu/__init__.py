@@ -1,0 +1,122 @@
+"""horovod_tpu — a TPU-native distributed deep-learning training framework.
+
+Capability rebuild of Horovod (reference: /root/reference, v0.25.0) with
+the data plane as XLA collective HLOs over the TPU ICI/DCN mesh
+(JAX / pjit / shard_map / Pallas) instead of NCCL/MPI/Gloo. SURVEY.md maps
+every reference component to its location here.
+
+Quick start (the reference's four-step recipe, README.rst:137-180,
+translated)::
+
+    import horovod_tpu as hvd
+    hvd.init()                       # 1. topology discovery, mesh build
+    # 2. shard the batch over the mesh (the "pin GPU" step is a no-op:
+    #    XLA owns placement)
+    # 3. wrap the optimizer — fuses + all-reduces gradients
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3 * hvd.size()))
+    # 4. broadcast initial parameters from rank 0
+    params = hvd.broadcast_parameters(params, root_rank=0)
+"""
+
+__version__ = "0.1.0"
+
+from .core.basics import (  # noqa: F401
+    ccl_built,
+    cross_rank,
+    cross_size,
+    cuda_built,
+    ddl_built,
+    dp_axis_names,
+    gloo_built,
+    gloo_enabled,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mesh,
+    mpi_built,
+    mpi_enabled,
+    nccl_built,
+    rank,
+    rocm_built,
+    shutdown,
+    size,
+    xla_built,
+    xla_enabled,
+)
+from .core.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    HorovodTpuError,
+    ProcessSetError,
+)
+from .core.process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    get_process_set_by_id,
+    global_process_set,
+    remove_process_set,
+)
+from .ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    grouped_allgather,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    grouped_reducescatter,
+    join,
+    masked_allreduce,
+    poll,
+    reducescatter,
+    reducescatter_async,
+    synchronize,
+)
+from .optim import (  # noqa: F401
+    Compression,
+    DistributedGradientTape,
+    DistributedOptimizer,
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+
+# Elastic + timeline live under their own namespaces, mirroring
+# hvd.elastic.* and hvd.start_timeline in the reference.
+from . import elastic  # noqa: F401
+
+
+def start_timeline(filename: str, mark_cycles: bool = False) -> None:
+    """Dynamic timeline start (reference: operations.cc:1048,
+    basics.py:156)."""
+    from .core.state import global_state
+
+    st = global_state()
+    if st.timeline is None:
+        from .utils.timeline import Timeline
+
+        st.timeline = Timeline(None)
+    st.timeline.start(filename, mark_cycles=mark_cycles)
+
+
+def stop_timeline() -> None:
+    from .core.state import global_state
+
+    st = global_state()
+    if st.timeline is not None:
+        st.timeline.stop()

@@ -1,0 +1,1 @@
+from . import basics, exceptions, knobs, process_sets, state  # noqa: F401

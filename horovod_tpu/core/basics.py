@@ -1,0 +1,353 @@
+"""init / shutdown / topology queries.
+
+Reference surface: /root/reference/horovod/common/basics.py:29
+(`HorovodBasics`: init, shutdown, rank, size, local_rank, local_size,
+cross_rank, cross_size, is_initialized, ...), backed by the C API in
+operations.cc:903-1370.
+
+TPU-native rank model
+---------------------
+The reference's world is *processes*, one accelerator each. JAX's world is
+*devices* driven by one controller per host. The mapping (SURVEY.md §2.6):
+
+  =================  =====================================================
+  reference          horovod_tpu
+  =================  =====================================================
+  size()             total devices on the data-parallel axis (SPMD ranks)
+  rank()             inside shard_map: traced `lax.axis_index` (the
+                     per-device rank). Outside: the first device rank this
+                     controller owns — `process_index * local_size` — so
+                     `rank() == 0` selects the coordinator, preserving the
+                     "if hvd.rank() == 0: save" idiom.
+  local_rank()       inside shard_map: rank % local_size; outside 0
+  local_size()       devices attached to this host
+  cross_rank()       process_index (which host/slice)
+  cross_size()       process_count
+  =================  =====================================================
+
+Multi-host bootstrap goes through `jax.distributed.initialize` (the
+coordination service over DCN) instead of MPI_Init / Gloo rendezvous
+(reference operations.cc:401 BackgroundThreadLoop); the launcher
+(horovod_tpu.runner) sets the coordinator env vars the way horovodrun sets
+HOROVOD_GLOO_RENDEZVOUS_ADDR (gloo_run.py:203).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .exceptions import NotInitializedError
+from .knobs import Knobs
+from .state import global_state
+
+_SIZE_ONE_WARNED = False
+
+
+# ---------------------------------------------------------------------------
+# axis-environment introspection (are we inside shard_map/pmap with the
+# data-parallel axis bound?)
+# ---------------------------------------------------------------------------
+
+def bound_axis_sizes() -> dict:
+    """Names and sizes of all currently-bound SPMD axes ({} at top level)."""
+    try:
+        from jax._src.core import get_axis_env
+
+        return dict(get_axis_env().axis_sizes)
+    except Exception:
+        return {}
+
+
+def in_spmd_context(axis_name: Optional[str] = None) -> bool:
+    sizes = bound_axis_sizes()
+    if axis_name is None:
+        st = global_state()
+        return any(ax in sizes for ax in st.dp_axis)
+    return axis_name in sizes
+
+
+# ---------------------------------------------------------------------------
+# init / shutdown
+# ---------------------------------------------------------------------------
+
+def _parse_mesh_spec(spec: str, n_devices: int):
+    """"dp=4,tp=2" -> (shape, axis_names); validates the product."""
+    shape, names = [], []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, dim = part.partition("=")
+        if dim == "-1":
+            dim_v = -1
+        else:
+            dim_v = int(dim)
+        names.append(name.strip())
+        shape.append(dim_v)
+    if shape.count(-1) > 1:
+        raise ValueError(f"at most one -1 dimension in mesh spec {spec!r}")
+    known = int(np.prod([d for d in shape if d != -1])) if shape else 1
+    if -1 in shape:
+        if n_devices % known:
+            raise ValueError(
+                f"mesh spec {spec!r} does not divide {n_devices} devices"
+            )
+        shape[shape.index(-1)] = n_devices // known
+    elif int(np.prod(shape)) != n_devices:
+        raise ValueError(
+            f"mesh spec {spec!r} has {int(np.prod(shape))} devices, "
+            f"but {n_devices} are available"
+        )
+    return tuple(shape), tuple(names)
+
+
+def _build_default_mesh(knobs: Knobs):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices())
+    if knobs.mesh_spec:
+        shape, names = _parse_mesh_spec(knobs.mesh_spec, devices.size)
+        return Mesh(devices.reshape(shape), names), names
+    return Mesh(devices.reshape(-1), ("hvd",)), ("hvd",)
+
+
+def init(
+    mesh=None,
+    dp_axis=None,
+    process_sets: Optional[Sequence] = None,
+    comm=None,
+) -> None:
+    """Initialize horovod_tpu.
+
+    Args:
+      mesh: optional pre-built `jax.sharding.Mesh`. Default: 1-D mesh named
+        "hvd" over all devices (or the HOROVOD_MESH spec).
+      dp_axis: axis name (or tuple of names) treated as the data-parallel
+        world for rank/size/allreduce defaults. Default: all axes of the
+        default mesh, or the first axis of a user mesh.
+      process_sets: optional list of ProcessSet objects to register at init,
+        mirroring `hvd.init(process_sets=...)`
+        (reference common/basics.py:48-100).
+      comm: accepted for API compatibility with `hvd.init(comm=...)`;
+        sub-communicator worlds are expressed as process sets or sub-meshes
+        on TPU, so a non-None value raises.
+
+    Reference call stack analog: SURVEY.md §3.1 / operations.cc:827
+    InitializeHorovodOnce — but there is no background thread to spawn for
+    the SPMD path; "initialization" is topology discovery + table setup.
+    """
+    import jax
+
+    if comm is not None:
+        raise ValueError(
+            "hvd.init(comm=...) passes an MPI communicator; on TPU express "
+            "sub-worlds as process_sets=[ProcessSet(ranks), ...] instead."
+        )
+
+    st = global_state()
+    with st.lock:
+        if st.initialized:
+            return
+
+        # Multi-host bootstrap: launcher-provided coordinator (runner/)
+        coord = os.environ.get("HVD_TPU_COORDINATOR_ADDRESS")
+        if coord and jax.process_count() == 1:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ["HVD_TPU_NUM_PROCESSES"]),
+                process_id=int(os.environ["HVD_TPU_PROCESS_ID"]),
+            )
+
+        st.knobs = Knobs.from_env()
+
+        if mesh is None:
+            mesh, axis_names = _build_default_mesh(st.knobs)
+            if dp_axis is None:
+                dp_axis = axis_names
+        else:
+            if dp_axis is None:
+                dp_axis = (mesh.axis_names[0],)
+        if isinstance(dp_axis, str):
+            dp_axis = (dp_axis,)
+        st.mesh = mesh
+        st.dp_axis = tuple(dp_axis)
+
+        from .process_sets import ProcessSetTable
+
+        st.process_set_table = ProcessSetTable(st.world_size())
+        if process_sets:
+            for ps in process_sets:
+                st.process_set_table.add(ps)
+
+        from ..utils.logging import configure_logging
+
+        configure_logging(st.knobs.log_level, st.knobs.log_hide_timestamp)
+
+        from ..utils.timeline import Timeline
+
+        st.timeline = Timeline(
+            st.knobs.timeline_filename or None,
+            mark_cycles=st.knobs.timeline_mark_cycles,
+        )
+
+        if st.knobs.autotune:
+            from ..ops.autotune import ParameterManager
+
+            st.parameter_manager = ParameterManager(st.knobs)
+
+        st.initialized = True
+
+
+def shutdown() -> None:
+    """Tear down state (reference: horovod_shutdown, operations.cc:983)."""
+    st = global_state()
+    with st.lock:
+        if st.timeline is not None:
+            st.timeline.close()
+        st.reset()
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return global_state().initialized
+
+
+def _require_init() -> None:
+    if not global_state().initialized:
+        raise NotInitializedError()
+
+
+# ---------------------------------------------------------------------------
+# topology queries
+# ---------------------------------------------------------------------------
+
+def size() -> int:
+    """Total SPMD ranks (devices along the data-parallel axes)."""
+    _require_init()
+    return global_state().world_size()
+
+
+def rank():
+    """Per-device rank inside shard_map (traced); coordinator-owned first
+    device rank outside (0 on the coordinator process)."""
+    _require_init()
+    st = global_state()
+    sizes = bound_axis_sizes()
+    live = [ax for ax in st.dp_axis if ax in sizes]
+    if live:
+        import jax
+
+        # row-major linearization over the bound dp axes
+        idx = jax.lax.axis_index(live[0])
+        for ax in live[1:]:
+            idx = idx * sizes[ax] + jax.lax.axis_index(ax)
+        return idx
+    import jax
+
+    return jax.process_index() * jax.local_device_count()
+
+
+def local_size() -> int:
+    _require_init()
+    import jax
+
+    return jax.local_device_count()
+
+
+def local_rank():
+    _require_init()
+    if in_spmd_context():
+        return rank() % local_size()
+    return 0
+
+
+def cross_size() -> int:
+    _require_init()
+    import jax
+
+    return jax.process_count()
+
+
+def cross_rank() -> int:
+    _require_init()
+    import jax
+
+    return jax.process_index()
+
+
+def mesh():
+    """The global device mesh (TPU-native extension)."""
+    _require_init()
+    return global_state().mesh
+
+
+def dp_axis_names() -> tuple:
+    _require_init()
+    return global_state().dp_axis
+
+
+def is_homogeneous() -> bool:
+    """True if every host drives the same number of devices
+    (reference: horovod_is_homogeneous, operations.cc:1135)."""
+    _require_init()
+    import jax
+
+    counts = {}
+    for d in jax.devices():
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return len(set(counts.values())) <= 1
+
+
+# Build-capability queries: the reference reports which transports were
+# compiled in (mpi_built/nccl_built/..., operations.cc:1167-1250). The TPU
+# data plane is always XLA collectives; report capabilities truthfully.
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """TPU-native extension: the data plane is XLA collective HLOs."""
+    return True
+
+
+def xla_enabled() -> bool:
+    return True

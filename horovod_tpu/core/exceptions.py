@@ -1,0 +1,58 @@
+"""Exception types for horovod_tpu.
+
+Capability parity with the reference's error surface
+(/root/reference/horovod/common/exceptions.py:1-49): a framework-internal
+error that elastic training catches and recovers from, and the interrupt
+raised when the host set changes under elastic training.
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all horovod_tpu errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error raised when a collective operation fails.
+
+    Elastic training (`horovod_tpu.elastic.run`) catches this, restores the
+    last committed state and re-initializes on the surviving slice
+    (reference: horovod/common/exceptions.py HorovodInternalError;
+    horovod/common/elastic.py:151-175).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Raised inside `State.commit()`/`check_host_updates()` when the elastic
+    driver notifies the worker that the host/slice set changed
+    (reference: horovod/common/elastic.py:57-99).
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API requiring `horovod_tpu.init()` was called before init."""
+
+    def __init__(self, what: str = "horovod_tpu"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class ProcessSetError(HorovodTpuError):
+    """Invalid process-set operation (unknown set, duplicate ranks, ...).
+
+    Reference analog: horovod/common/process_set.cc error statuses.
+    """
+
+
+class TensorShapeMismatchError(HorovodTpuError):
+    """Ranks submitted inconsistent shapes/dtypes for the same collective.
+
+    The reference negotiates this through the controller and surfaces an
+    ERROR response on every rank (controller.cc:497 ConstructResponse); in
+    the SPMD path shape agreement is a compile-time property, so this is
+    raised eagerly at trace time.
+    """

@@ -1,0 +1,156 @@
+"""Environment-variable configuration knobs.
+
+The reference parses ~50 `HOROVOD_*` env knobs in C++
+(/root/reference/horovod/common/common.h:115-148,
+/root/reference/horovod/common/utils/env_parser.cc). This module is the
+TPU-native equivalent: one typed registry, parsed once at `init()` and
+re-readable at runtime. Knobs keep the `HOROVOD_` prefix so reference users'
+launch scripts keep working; each knob also accepts an `HVD_TPU_` prefix
+which takes priority.
+
+Knobs that only make sense for CUDA stream machinery (e.g.
+HOROVOD_NUM_NCCL_STREAMS) are intentionally absent; XLA owns scheduling on
+TPU. Knobs controlling fusion/cache/cycle survive because the eager
+(non-jit) path still uses a background-negotiation runtime, and the jit path
+uses the fusion threshold for gradient bucketing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """HVD_TPU_X beats HOROVOD_X beats default."""
+    for prefix in ("HVD_TPU_", "HOROVOD_"):
+        v = os.environ.get(prefix + name)
+        if v is not None:
+            return v
+    return default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = _env(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = _env(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = _env(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Knobs:
+    """Typed snapshot of all runtime knobs.
+
+    Defaults mirror the reference where the concept carries over
+    (fusion 128 MB: operations.cc:507; cycle time 1 ms: operations.cc:515;
+    cache capacity 1024: global_state.h:89; stall warning 60 s:
+    stall_inspector.h:75-83).
+    """
+
+    # --- fusion / bucketing (controller.cc:830 FuseResponses analog) ---
+    fusion_threshold_bytes: int = 128 * 1024 * 1024
+    batch_d2d_memcopies: bool = True
+
+    # --- background/eager runtime (operations.cc:515) ---
+    cycle_time_ms: float = 1.0
+    cache_capacity: int = 1024
+    cache_enabled: bool = True
+
+    # --- stall inspector (stall_inspector.h:75-83) ---
+    stall_check_enabled: bool = True
+    stall_warning_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0  # 0 = never shut down
+
+    # --- timeline (timeline.h, operations.cc:1048) ---
+    timeline_filename: str = ""
+    timeline_mark_cycles: bool = False
+
+    # --- autotune (parameter_manager.h:42) ---
+    autotune: bool = False
+    autotune_log: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+
+    # --- numerics / wire format ---
+    # fp16 ("compression") on the wire: reference torch/compression.py:20.
+    # On TPU the native wire type is bfloat16.
+    compression_wire_dtype: str = ""  # "", "bfloat16", "float16"
+
+    # --- hierarchy (operations.cc:551-565) ---
+    # On TPU: "hierarchical" = reduce-scatter over ICI within a slice, then
+    # all-reduce across slices over DCN, then all-gather over ICI.
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    # --- elastic ---
+    elastic_timeout_seconds: float = 600.0
+    reset_limit: int = 0  # 0 = unlimited
+
+    # --- process sets ---
+    dynamic_process_sets: bool = False
+
+    # --- logging ---
+    log_level: str = "WARNING"
+    log_hide_timestamp: bool = False
+
+    # --- mesh / topology overrides ---
+    # Comma-separated axis spec, e.g. "dp=8" or "dp=4,tp=2"; empty = one
+    # flat data-parallel axis over all devices.
+    mesh_spec: str = ""
+
+    @staticmethod
+    def from_env() -> "Knobs":
+        return Knobs(
+            fusion_threshold_bytes=_env_int(
+                "FUSION_THRESHOLD", 128 * 1024 * 1024
+            ),
+            batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
+            cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
+            cache_capacity=_env_int("CACHE_CAPACITY", 1024),
+            cache_enabled=_env_int("CACHE_CAPACITY", 1024) > 0,
+            stall_check_enabled=not _env_bool("STALL_CHECK_DISABLE", False),
+            stall_warning_time_seconds=_env_float(
+                "STALL_CHECK_TIME_SECONDS", 60.0
+            ),
+            stall_shutdown_time_seconds=_env_float(
+                "STALL_SHUTDOWN_TIME_SECONDS", 0.0
+            ),
+            timeline_filename=_env("TIMELINE", "") or "",
+            timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
+            autotune=_env_bool("AUTOTUNE", False),
+            autotune_log=_env("AUTOTUNE_LOG", "") or "",
+            autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
+            autotune_steps_per_sample=_env_int(
+                "AUTOTUNE_STEPS_PER_SAMPLE", 10
+            ),
+            compression_wire_dtype=_env("COMPRESSION_WIRE_DTYPE", "") or "",
+            hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
+            hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
+            elastic_timeout_seconds=_env_float("ELASTIC_TIMEOUT", 600.0),
+            reset_limit=_env_int("RESET_LIMIT", 0),
+            dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
+            log_level=_env("LOG_LEVEL", "WARNING") or "WARNING",
+            log_hide_timestamp=_env_bool("LOG_HIDE_TIME", False),
+            mesh_spec=_env("MESH", "") or "",
+        )

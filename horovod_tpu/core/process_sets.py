@@ -1,0 +1,197 @@
+"""Process sets: collectives over subsets of ranks.
+
+Reference: /root/reference/horovod/common/process_set.h:26 (ProcessSet),
+:89 (ProcessSetTable) and the Python surface
+/root/reference/horovod/common/process_sets.py:123 (add_process_set /
+remove_process_set, dynamic sets gated by HOROVOD_DYNAMIC_PROCESS_SETS).
+
+TPU-native design: a process set is a subset of device ranks along the
+data-parallel mesh axis. It carries two execution forms:
+
+  * **SPMD form** — `axis_index_groups` for XLA collectives inside
+    `shard_map`/`pjit`. XLA requires replica groups to partition the axis,
+    so the complement ranks are placed in singleton groups; for ops whose
+    output shape depends on group size (allgather/alltoall) the collective
+    layer falls back to a scatter+psum formulation (see ops/collectives.py).
+  * **Eager form** — a sub-`Mesh` containing only the set's devices, so
+    eager collectives jit a program over exactly those devices; no
+    negotiation with non-members is needed (the reference needs a whole
+    per-set controller + tensor queue for this; on TPU a sub-mesh *is* the
+    communicator).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .exceptions import ProcessSetError
+from .state import global_state
+
+
+class ProcessSet:
+    """A registered subset of device ranks.
+
+    Mirrors the user-facing surface of the reference ProcessSet
+    (process_sets.py: ``ranks``, ``process_set_id``, ``rank()``, ``size()``,
+    ``included()``).
+    """
+
+    def __init__(self, ranks: Sequence[int]):
+        rs = [int(r) for r in ranks]
+        if len(set(rs)) != len(rs):
+            raise ProcessSetError(f"duplicate ranks in process set: {rs}")
+        self.ranks: List[int] = sorted(rs)
+        self.process_set_id: Optional[int] = None  # set on registration
+
+    # -- queries -----------------------------------------------------------
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def included(self, rank: Optional[int] = None) -> bool:
+        if rank is None:
+            from . import basics
+
+            rank = basics.rank()
+        return rank in self.ranks
+
+    def rank(self, global_rank: Optional[int] = None) -> int:
+        """Set-local rank of `global_rank` (or this process's rank)."""
+        if global_rank is None:
+            from . import basics
+
+            global_rank = basics.rank()
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            raise ProcessSetError(
+                f"rank {global_rank} is not a member of process set "
+                f"{self.process_set_id} (ranks={self.ranks})"
+            )
+
+    # -- execution forms ---------------------------------------------------
+
+    def axis_index_groups(self, world_size: int) -> Optional[List[List[int]]]:
+        """Replica groups partitioning [0, world_size): the set as one group,
+        every non-member in its own singleton group. ``None`` for the global
+        set (XLA's default grouping is the whole axis — cheaper HLO)."""
+        if self.ranks == list(range(world_size)):
+            return None
+        members = set(self.ranks)
+        groups = [list(self.ranks)]
+        groups.extend([r] for r in range(world_size) if r not in members)
+        return groups
+
+    def sub_mesh(self):
+        """A 1-D Mesh over exactly this set's devices (eager form)."""
+        from jax.sharding import Mesh
+
+        st = global_state()
+        flat = np.asarray(st.mesh.devices).reshape(-1)
+        devs = flat[np.array(self.ranks, dtype=np.int64)]
+        return Mesh(devs, ("hvd",))
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+class ProcessSetTable:
+    """id -> ProcessSet registry with dynamic add/remove.
+
+    Reference: process_set.h:89 ProcessSetTable; dynamic registration
+    requires HOROVOD_DYNAMIC_PROCESS_SETS=1 there
+    (process_sets.py:123-163) — here dynamic sets are always allowed
+    because there is no background thread to coordinate with; the table is
+    plain controller-process state and the *collective* side is compiled
+    per-set, so "synchronizing registration across ranks" is a non-problem
+    under single-controller SPMD. Multi-controller eager mode broadcasts
+    registrations through the rendezvous KV (runner/rendezvous.py).
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._table: Dict[int, ProcessSet] = {}
+        self._next_id = 0
+        glob = ProcessSet(range(world_size))
+        self._register(glob)  # id 0 = global set, like the reference
+
+    def _register(self, ps: ProcessSet) -> ProcessSet:
+        for existing in self._table.values():
+            if existing.ranks == ps.ranks:
+                raise ProcessSetError(
+                    f"a process set with ranks {ps.ranks} already exists "
+                    f"(id={existing.process_set_id})"
+                )
+        bad = [r for r in ps.ranks if not 0 <= r < self.world_size]
+        if bad:
+            raise ProcessSetError(
+                f"ranks {bad} out of range for world size {self.world_size}"
+            )
+        ps.process_set_id = self._next_id
+        self._table[self._next_id] = ps
+        self._next_id += 1
+        return ps
+
+    def add(self, ps: ProcessSet) -> ProcessSet:
+        return self._register(ps)
+
+    def remove(self, ps_or_id) -> None:
+        pid = ps_or_id.process_set_id if isinstance(ps_or_id, ProcessSet) else int(ps_or_id)
+        if pid == 0:
+            raise ProcessSetError("cannot remove the global process set")
+        ps = self._table.pop(pid, None)
+        if ps is None:
+            raise ProcessSetError(f"no process set with id {pid}")
+        ps.process_set_id = None
+
+    def get(self, pid: int) -> ProcessSet:
+        try:
+            return self._table[pid]
+        except KeyError:
+            raise ProcessSetError(f"no process set with id {pid}")
+
+    def ids(self) -> List[int]:
+        return sorted(self._table)
+
+    @property
+    def global_set(self) -> ProcessSet:
+        return self._table[0]
+
+
+# -- module-level user API (mirrors horovod/common/process_sets.py) --------
+
+def global_process_set() -> ProcessSet:
+    st = global_state()
+    if st.process_set_table is None:
+        raise ProcessSetError("horovod_tpu is not initialized")
+    return st.process_set_table.global_set
+
+
+def add_process_set(ranks_or_set) -> ProcessSet:
+    """Register a new process set (reference: process_sets.py:123)."""
+    st = global_state()
+    if st.process_set_table is None:
+        raise ProcessSetError("horovod_tpu is not initialized")
+    ps = (
+        ranks_or_set
+        if isinstance(ranks_or_set, ProcessSet)
+        else ProcessSet(ranks_or_set)
+    )
+    return st.process_set_table.add(ps)
+
+
+def remove_process_set(ps_or_id) -> None:
+    """Unregister (reference: process_sets.py:147)."""
+    st = global_state()
+    if st.process_set_table is None:
+        raise ProcessSetError("horovod_tpu is not initialized")
+    st.process_set_table.remove(ps_or_id)
+
+
+def get_process_set_by_id(pid: int) -> ProcessSet:
+    st = global_state()
+    if st.process_set_table is None:
+        raise ProcessSetError("horovod_tpu is not initialized")
+    return st.process_set_table.get(pid)

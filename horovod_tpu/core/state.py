@@ -1,0 +1,83 @@
+"""Per-process global state.
+
+TPU-native analog of the reference's `HorovodGlobalState`
+(/root/reference/horovod/common/global_state.h:39). Where the reference
+holds a background-thread handle, fusion buffers and a controller, the SPMD
+path on TPU holds the *device mesh* (the compile-time description of the
+communicator world) plus the process-set table, knobs, timeline and
+autotuner handles. The background runtime only exists for the eager path
+and lives in `horovod_tpu._native` / `horovod_tpu.ops.eager_runtime`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .knobs import Knobs
+
+
+class GlobalState:
+    """Singleton-ish state container (one per controller process).
+
+    Attributes:
+      mesh: the global `jax.sharding.Mesh`. Default topology is one flat
+        data-parallel axis named ``"hvd"`` over every device; hybrid
+        meshes (dp/fsdp/tp/sp/...) come from `horovod_tpu.parallel.make_mesh`
+        or the ``HOROVOD_MESH`` knob.
+      dp_axis: name(s) of the mesh axis treated as the Horovod world for the
+        classic data-parallel API (rank/size/allreduce default axis).
+      knobs: env-parsed configuration.
+      process_set_table: id -> ProcessSet registry (process_sets.py).
+    """
+
+    def __init__(self) -> None:
+        self.initialized: bool = False
+        self.shutdown_requested: bool = False
+        self.mesh: Optional[Any] = None  # jax.sharding.Mesh
+        self.dp_axis: tuple = ("hvd",)
+        self.knobs: Knobs = Knobs()
+        self.process_set_table: Optional[Any] = None  # ProcessSetTable
+        self.timeline: Optional[Any] = None
+        self.parameter_manager: Optional[Any] = None
+        self.eager_runtime: Optional[Any] = None
+        self.lock = threading.RLock()
+        # monotonically increasing init epoch; bumped by elastic re-init so
+        # long-lived objects can detect a world change (reference analog:
+        # elastic reset() tears down and re-runs InitializeHorovodOnce).
+        self.epoch: int = 0
+
+    # -- topology ----------------------------------------------------------
+
+    def device_array(self) -> np.ndarray:
+        if self.mesh is None:
+            raise RuntimeError("mesh not set")
+        return np.asarray(self.mesh.devices)
+
+    def world_size(self) -> int:
+        """Total SPMD ranks = devices along the data-parallel axes."""
+        if self.mesh is None:
+            return 0
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        n = 1
+        for ax in self.dp_axis:
+            n *= sizes[ax]
+        return n
+
+    def reset(self) -> None:
+        self.initialized = False
+        self.mesh = None
+        self.process_set_table = None
+        self.timeline = None
+        self.parameter_manager = None
+        self.eager_runtime = None
+        self.epoch += 1
+
+
+_global_state = GlobalState()
+
+
+def global_state() -> GlobalState:
+    return _global_state

@@ -1,0 +1,8 @@
+"""Elastic fault-tolerant training (hvd.elastic.* namespace).
+
+Reference: /root/reference/horovod/common/elastic.py (State/run),
+runner/elastic/ (driver, discovery, registration). Implemented in
+state.py / driver.py / discovery.py here.
+"""
+
+from .state import ObjectState, State, TpuState, run  # noqa: F401

@@ -1,0 +1,207 @@
+"""Elastic state: commit / restore / sync across world changes.
+
+Reference: /root/reference/horovod/common/elastic.py:26 (`State`: commit,
+check_host_updates, sync, restore; `ObjectState`), torch/elastic/state.py:27
+(`TorchState` with per-handler save/restore/sync), and the `run_fn` wrapper
+(common/elastic.py:151) that catches `HorovodInternalError` (restore +
+reinit) and `HostsUpdatedInterrupt` (commit already done; resync).
+
+TPU-native form: state lives as pytrees on the controller; `commit()`
+snapshots to host memory (device_get — the analog of TorchState's
+deep-copied state dicts), `restore()` puts the snapshot back, `sync()`
+broadcasts from the coordinator after a world change and bumps the global
+epoch so compiled collectives re-specialize to the new mesh.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+
+
+class _HostUpdateFlag:
+    """Worker-side mailbox the driver's notification client sets when the
+    host set changes (reference: WorkerNotificationManager,
+    runner/elastic/worker.py). Single-controller tests set it directly."""
+
+    def __init__(self) -> None:
+        self._updated = threading.Event()
+        self._timestamp = 0
+
+    def signal(self) -> None:
+        self._timestamp += 1
+        self._updated.set()
+
+    def consume(self) -> bool:
+        was = self._updated.is_set()
+        self._updated.clear()
+        return was
+
+
+host_update_flag = _HostUpdateFlag()
+
+
+class State:
+    """Base elastic state (common/elastic.py:26)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._reset_callbacks: List[Callable] = []
+
+    def register_reset_callbacks(self, callbacks: List[Callable]) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self) -> None:
+        """Snapshot state and surface pending host updates
+        (common/elastic.py:60: save + check_host_updates)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        if host_update_flag.consume():
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """Elastic state of plain python attributes (common/elastic.py:118):
+    snapshot by deepcopy, sync by coordinator broadcast_object."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._saved: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._known = list(kwargs)
+        self.save()
+
+    def save(self) -> None:
+        self._saved = {k: copy.deepcopy(getattr(self, k)) for k in self._known}
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        from ..optim.functions import broadcast_object
+
+        values = {k: getattr(self, k) for k in self._known}
+        values = broadcast_object(values, root_rank=0)
+        for k, v in values.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class TpuState(ObjectState):
+    """Elastic state of jax pytrees (params / optimizer state / step),
+    the TorchState analog (torch/elastic/state.py:27).
+
+    Pytree attributes are snapshotted with `jax.device_get` (host copy —
+    survives device failure) and synced by coordinator broadcast so a
+    resized slice starts from identical state.
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._tree_keys = [
+            k for k, v in kwargs.items() if _is_pytree_of_arrays(v)
+        ]
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        self._saved = {}
+        for k in self._known:
+            v = getattr(self, k)
+            if k in self._tree_keys:
+                self._saved[k] = jax.device_get(v)
+            else:
+                self._saved[k] = copy.deepcopy(v)
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            if k in self._tree_keys:
+                setattr(self, k, jax.device_put(v))
+            else:
+                setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        from ..optim.functions import broadcast_object
+        from ..optim import broadcast_parameters
+
+        for k in self._known:
+            v = getattr(self, k)
+            if k in self._tree_keys:
+                setattr(self, k, broadcast_parameters(v, root_rank=0))
+            else:
+                setattr(self, k, broadcast_object(v, root_rank=0))
+        self.save()
+
+
+def _is_pytree_of_arrays(v: Any) -> bool:
+    leaves = jax.tree_util.tree_leaves(v)
+    return bool(leaves) and all(hasattr(l, "dtype") for l in leaves)
+
+
+def run(func: Callable) -> Callable:
+    """Elastic run wrapper (common/elastic.py:151 run_fn).
+
+    ``@hvd.elastic.run`` around a `train(state, ...)` function: on
+    `HorovodInternalError` restore committed state, re-init the world and
+    retry; on `HostsUpdatedInterrupt` just re-sync and continue. The world
+    re-init path asks the runtime to rebuild its mesh (slice resize).
+    """
+
+    def wrapper(state: State, *args: Any, **kwargs: Any):
+        from ..core import basics
+        from ..core.state import global_state
+
+        reset_limit = global_state().knobs.reset_limit
+        resets = 0
+        notify_needed = False
+        while True:
+            try:
+                if notify_needed:
+                    state.on_reset()
+                    notify_needed = False
+                state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                _reinitialize()
+                notify_needed = True
+            except HostsUpdatedInterrupt as e:
+                if not e.skip_sync:
+                    _reinitialize()
+                notify_needed = True
+            resets += 1
+            if reset_limit and resets >= reset_limit:
+                raise RuntimeError(
+                    f"elastic reset limit {reset_limit} reached"
+                )
+
+    return wrapper
+
+
+def _reinitialize() -> None:
+    """Tear down and re-init on the (possibly resized) device world —
+    the analog of elastic.py:171-173 (shutdown + re-init Horovod)."""
+    from ..core import basics
+
+    basics.shutdown()
+    basics.init()

@@ -1,0 +1,101 @@
+"""Adasum: adaptive summation all-reduce.
+
+Reference: /root/reference/horovod/common/ops/adasum/adasum.h:38 —
+recursive vector-halving distance-doubling where each combine of partial
+gradients a, b is
+
+    adasum(a, b) = (1 - a·b / (2‖a‖²)) a + (1 - a·b / (2‖b‖²)) b
+
+which keeps the update convergent without LR rescaling when gradients are
+correlated (docs/adasum_user_guide.rst). The GPU variant
+(adasum_gpu_operations.cc) does NCCL reduce-scatter within a node, MPI
+Adasum across nodes, NCCL allgather back.
+
+TPU-native form: a log2(n)-level recursive-doubling combine inside
+shard_map. Each level exchanges the current partial with the partner rank
+via `lax.ppermute` (one ICI neighbor exchange), computes dot/norms locally
+in float32, and combines. The hierarchical (ICI×DCN) variant mirrors the
+GPU one: reduce-scatter over the intra-slice axis, Adasum over the
+cross-slice axis, all-gather back — see `hierarchical_adasum`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import basics
+from ..core.exceptions import HorovodInternalError
+
+
+def _combine(a, b):
+    """One Adasum combine in float32 accumulation (adasum.h:102
+    DispatchComputeDotAndNormSqrds + ScaledAdd)."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.sum(af * bf)
+    na = jnp.sum(af * af)
+    nb = jnp.sum(bf * bf)
+    # guards: zero-norm operands contribute unscaled (adasum.h: if norm==0
+    # the coefficient stays 1, the term is zero anyway)
+    ca = jnp.where(na > 0, 1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)), 1.0)
+    cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)), 1.0)
+    return (ca * af + cb * bf).astype(a.dtype)
+
+
+def adasum_allreduce(x, axis_name: str, process_set=None):
+    """Adasum-reduce `x` across the named axis (power-of-two sizes).
+
+    Must be called inside shard_map with `axis_name` bound. Non-power-of-two
+    worlds use the reference's strategy of a plain pre-average for the
+    remainder ranks folded into the nearest power of two — here simplified:
+    raise, directing users to pad the mesh (TPU slices are power-of-two).
+    """
+    sizes = basics.bound_axis_sizes()
+    if axis_name not in sizes:
+        raise HorovodInternalError(
+            f"adasum_allreduce requires axis {axis_name!r} bound in shard_map"
+        )
+    if process_set is not None and process_set.process_set_id != 0:
+        raise HorovodInternalError(
+            "adasum over a process subset: use the set's sub-mesh"
+        )
+    n = sizes[axis_name]
+    if n & (n - 1):
+        raise HorovodInternalError(
+            f"adasum requires a power-of-two world, got {n}; TPU slices are "
+            "power-of-two — shard over the full slice or use op=Average"
+        )
+    a = x
+    dist = 1
+    while dist < n:
+        perm = [(r, r ^ dist) for r in range(n)]
+        b = lax.ppermute(a, axis_name, perm)
+        a = _combine(a, b)
+        dist *= 2
+    return a
+
+
+def hierarchical_adasum(x, cross_axis: str, local_axis: str):
+    """ICI×DCN hierarchical Adasum (adasum_gpu_operations.cc:1-401 analog):
+
+      1. reduce-scatter + average over `local_axis` (intra-slice, ICI)
+      2. Adasum over `cross_axis` (inter-slice, DCN)
+      3. all-gather over `local_axis`
+
+    Input is this rank's gradient; all axes must be bound in shard_map.
+    dim 0 must divide the local axis size for the scatter.
+    """
+    sizes = basics.bound_axis_sizes()
+    nloc = sizes[local_axis]
+    if x.shape[0] % nloc:
+        raise HorovodInternalError(
+            f"hierarchical_adasum: dim0 {x.shape[0]} % local size {nloc} != 0"
+        )
+    shard = lax.psum_scatter(x, local_axis, scatter_dimension=0, tiled=True)
+    shard = (shard / nloc).astype(x.dtype)
+    shard = adasum_allreduce(shard, cross_axis)
+    return lax.all_gather(shard, local_axis, tiled=True)

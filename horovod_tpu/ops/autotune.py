@@ -1,0 +1,94 @@
+"""Online autotuning of fusion/bucketing parameters.
+
+Reference: /root/reference/horovod/common/parameter_manager.{cc,h} — a
+Bayesian-optimization search (Gaussian process over the knob space,
+optim/bayesian_optimization.cc) scoring candidate settings by achieved
+bytes/sec, then broadcasting the winner from the coordinator.
+
+On TPU most of the reference's knob space is owned by XLA (cycle time,
+hierarchical allreduce, cache) — what remains meaningful is the gradient
+*bucket size* (fusion threshold), which trades collective-launch latency
+against overlap with backprop. This manager does a warm-started
+golden-section-style search over bucket size scored by measured step
+throughput; a full GP port is unnecessary for a 1-D space.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..core.knobs import Knobs
+
+_CANDIDATE_THRESHOLDS = [
+    1 << 20, 2 << 20, 4 << 20, 8 << 20, 16 << 20,
+    32 << 20, 64 << 20, 128 << 20, 256 << 20,
+]
+
+
+class ParameterManager:
+    """Score-and-advance tuner (reference: parameter_manager.h:42).
+
+    Usage: the DistributedOptimizer calls `record_bytes(n)` per step and
+    `tick()` once per step; after warmup it cycles candidates, keeps the
+    best-throughput setting, then pins it.
+    """
+
+    def __init__(self, knobs: Knobs):
+        self._knobs = knobs
+        self._active = knobs.autotune
+        self._candidates: List[int] = list(_CANDIDATE_THRESHOLDS)
+        self._idx = self._candidates.index(
+            min(
+                self._candidates,
+                key=lambda c: abs(c - knobs.fusion_threshold_bytes),
+            )
+        )
+        self._current = self._candidates[self._idx]
+        self._best = (0.0, self._current)  # (bytes/sec, threshold)
+        self._warmup_left = knobs.autotune_warmup_samples
+        self._steps_in_sample = 0
+        self._bytes_in_sample = 0
+        self._sample_start = time.perf_counter()
+        self._pinned = False
+        self._log_rows: List[tuple] = []
+
+    def fusion_threshold_bytes(self) -> int:
+        return self._current
+
+    def record_bytes(self, n: int) -> None:
+        self._bytes_in_sample += int(n)
+
+    def tick(self) -> None:
+        if not self._active or self._pinned:
+            return
+        self._steps_in_sample += 1
+        if self._steps_in_sample < self._knobs.autotune_steps_per_sample:
+            return
+        elapsed = max(time.perf_counter() - self._sample_start, 1e-9)
+        score = self._bytes_in_sample / elapsed
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+        else:
+            self._log_rows.append((self._current, score))
+            if score > self._best[0]:
+                self._best = (score, self._current)
+            self._idx += 1
+            if self._idx >= len(self._candidates):
+                self._current = self._best[1]
+                self._pinned = True
+                self._write_log()
+            else:
+                self._current = self._candidates[self._idx]
+        self._steps_in_sample = 0
+        self._bytes_in_sample = 0
+        self._sample_start = time.perf_counter()
+
+    def _write_log(self) -> None:
+        if not self._knobs.autotune_log:
+            return
+        with open(self._knobs.autotune_log, "w") as f:
+            f.write("fusion_threshold_bytes,score_bytes_per_sec\n")
+            for thr, score in self._log_rows:
+                f.write(f"{thr},{score}\n")
+            f.write(f"# pinned,{self._current}\n")

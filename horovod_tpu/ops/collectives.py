@@ -1,0 +1,786 @@
+"""Collective operations: the TPU data plane.
+
+Reference surface: /root/reference/horovod/torch/mpi_ops.py (allreduce /
+allgather / broadcast / alltoall / reducescatter, grouped + async variants,
+prescale/postscale factors, process sets) executed through the C++ op layer
+(/root/reference/horovod/common/ops/collective_operations.h:38-351,
+nccl_operations.cc:175-246).
+
+TPU-native architecture
+-----------------------
+There is no background proxy thread and no NCCL stream machinery here. A
+collective has two execution forms:
+
+* **SPMD form** (primary, the performance path): called inside
+  ``shard_map``/``pjit`` with the data-parallel mesh axis bound, each op is
+  a single XLA collective HLO (`lax.psum`, `lax.all_gather`,
+  `lax.psum_scatter`, `lax.all_to_all`, `lax.ppermute`) that XLA schedules
+  directly onto ICI — the role NCCL plays in the reference, minus the
+  callback detour the reference needs for its XLA path
+  (xla_mpi_ops.cc:195-603; SURVEY.md §3.5 notes the TPU build should lower
+  natively — this is that lowering).
+
+* **Eager form**: called on concrete ``jax.Array``s at top level. The op
+  jit-compiles a tiny shard_map program over the (sub-)mesh and runs it
+  immediately. Compilations are cached by (op, shape, dtype, set), playing
+  the role of the reference's ResponseCache steady-state fast path
+  (response_cache.h:45): the first call of a signature pays negotiation
+  (here: compilation), subsequent calls are cheap dispatches.
+
+Process sets map to ``axis_index_groups`` (SPMD form) or sub-meshes (eager
+form) — see core/process_sets.py. Ops whose XLA form requires equal-size
+replica groups (allgather/alltoall/reducescatter) use a scatter+psum
+formulation for proper-subset process sets.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import basics
+from ..core.exceptions import HorovodInternalError
+from ..core.process_sets import ProcessSet, global_process_set
+from ..core.state import global_state
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction op ids, value-compatible with the reference
+    (horovod/torch/mpi_ops.py:60-66: Average=0, Sum=1, Adasum=2, Min=3,
+    Max=4, Product=5)."""
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+# ---------------------------------------------------------------------------
+# axis / process-set plumbing
+# ---------------------------------------------------------------------------
+
+def _default_axis() -> Tuple[str, ...]:
+    st = global_state()
+    if st.initialized:
+        return st.dp_axis
+    return ("hvd",)
+
+
+def _resolve_axis(axis_name) -> Tuple[str, ...]:
+    if axis_name is None:
+        axes = _default_axis()
+    elif isinstance(axis_name, str):
+        axes = (axis_name,)
+    else:
+        axes = tuple(axis_name)
+    return axes
+
+
+def _bound_axes(axes: Tuple[str, ...]) -> Tuple[str, ...]:
+    sizes = basics.bound_axis_sizes()
+    return tuple(ax for ax in axes if ax in sizes)
+
+
+def _axis_size(axes: Tuple[str, ...]) -> int:
+    sizes = basics.bound_axis_sizes()
+    n = 1
+    for ax in axes:
+        n *= sizes[ax]
+    return n
+
+
+def _set_groups(ps: Optional[ProcessSet], world: int):
+    if ps is None:
+        return None, world
+    groups = ps.axis_index_groups(world)
+    return groups, ps.size()
+
+
+def _set_local_index(ps: ProcessSet, axis: str):
+    """Traced set-local rank for the current device; 0 for non-members."""
+    world = _axis_size((axis,))
+    table = np.zeros((world,), dtype=np.int32)
+    for i, r in enumerate(ps.ranks):
+        table[r] = i
+    return jnp.asarray(table)[lax.axis_index(axis)]
+
+
+# ---------------------------------------------------------------------------
+# SPMD-form primitives (inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _spmd_allreduce_leaf(x, op, axes, ps, prescale, postscale):
+    world = _axis_size(axes)
+    groups, nset = _set_groups(ps, world)
+    if groups is not None and len(axes) > 1:
+        raise HorovodInternalError(
+            "process sets require a single data-parallel axis"
+        )
+    axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
+    if prescale != 1.0:
+        x = x * jnp.asarray(prescale, dtype=x.dtype)
+    if op in (ReduceOp.SUM, ReduceOp.AVERAGE, ReduceOp.ADASUM):
+        # ADASUM at the lax level degenerates to a sum here; the adaptive
+        # combining lives in ops/adasum.py and is dispatched by allreduce()
+        # before reaching this leaf.
+        y = lax.psum(x, axis_arg, axis_index_groups=groups)
+        if op == ReduceOp.AVERAGE:
+            y = (y / nset).astype(x.dtype)
+    elif op == ReduceOp.MIN:
+        y = lax.pmin(x, axis_arg, axis_index_groups=groups)
+    elif op == ReduceOp.MAX:
+        y = lax.pmax(x, axis_arg, axis_index_groups=groups)
+    elif op == ReduceOp.PRODUCT:
+        # No pprod HLO; gather then reduce locally, then a masked psum from
+        # each group's root re-establishes replication (jax's VMA checker
+        # tracks all_gather outputs as device-varying). PRODUCT is a rare
+        # op (parity item from torch/mpi_ops.py:60, not a hot path).
+        g = lax.all_gather(x, axis_arg, axis_index_groups=groups)
+        y = jnp.prod(g, axis=0).astype(x.dtype)
+        if len(axes) == 1:
+            idx = lax.axis_index(axes[0])
+        else:
+            sizes = basics.bound_axis_sizes()
+            idx = lax.axis_index(axes[0])
+            for ax in axes[1:]:
+                idx = idx * sizes[ax] + lax.axis_index(ax)
+        if groups is None:
+            root_of = jnp.zeros((world,), dtype=jnp.int32)
+        else:
+            table = np.zeros((world,), dtype=np.int32)
+            for grp in groups:
+                for r in grp:
+                    table[r] = grp[0]
+            root_of = jnp.asarray(table)
+        mask = (idx == root_of[idx]).astype(y.dtype)
+        y = lax.psum(y * mask, axis_arg, axis_index_groups=groups)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+    if postscale != 1.0:
+        y = y * jnp.asarray(postscale, dtype=y.dtype)
+    return y
+
+
+def _spmd_allgather_leaf(x, axes, ps):
+    world = _axis_size(axes)
+    groups, nset = _set_groups(ps, world)
+    axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
+    if groups is None:
+        # NOTE: the result is replicated in value but jax's VMA checker
+        # types all_gather output as device-varying; callers returning it
+        # through shard_map out_specs=P() should pass check_vma=False or
+        # psum-mask it (see the PRODUCT branch of _spmd_allreduce_leaf).
+        return lax.all_gather(x, axis_arg, tiled=True)
+    # Proper subset: XLA all-gather wants equal-size groups; emulate with
+    # scatter-into-zeros + group psum (constant extra FLOPs, one collective).
+    d0 = x.shape[0]
+    out = jnp.zeros((nset * d0,) + x.shape[1:], dtype=x.dtype)
+    idx = _set_local_index(ps, axes[0])
+    out = lax.dynamic_update_slice_in_dim(out, x, idx * d0, axis=0)
+    return lax.psum(out, axes[0], axis_index_groups=groups)
+
+
+def _spmd_broadcast_leaf(x, root_rank, axes, ps):
+    world = _axis_size(axes)
+    groups, _ = _set_groups(ps, world)
+    axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
+    if len(axes) == 1:
+        idx = lax.axis_index(axes[0])
+    else:
+        sizes = basics.bound_axis_sizes()
+        idx = lax.axis_index(axes[0])
+        for ax in axes[1:]:
+            idx = idx * sizes[ax] + lax.axis_index(ax)
+    mask = (idx == root_rank).astype(x.dtype)
+    return lax.psum(x * mask, axis_arg, axis_index_groups=groups)
+
+
+def _spmd_reducescatter_leaf(x, op, axes, ps, prescale, postscale):
+    world = _axis_size(axes)
+    groups, nset = _set_groups(ps, world)
+    axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
+    if x.shape[0] % nset:
+        raise HorovodInternalError(
+            f"reducescatter dim0 {x.shape[0]} not divisible by set size {nset}"
+        )
+    if prescale != 1.0:
+        x = x * jnp.asarray(prescale, dtype=x.dtype)
+    if op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        raise ValueError("reducescatter supports Sum and Average (as the reference: collective_operations.h:342)")
+    if groups is None:
+        y = lax.psum_scatter(x, axis_arg, scatter_dimension=0, tiled=True)
+    else:
+        # subset form: group psum, then slice own chunk
+        full = lax.psum(x, axes[0], axis_index_groups=groups)
+        chunk = x.shape[0] // nset
+        idx = _set_local_index(ps, axes[0])
+        y = lax.dynamic_slice_in_dim(full, idx * chunk, chunk, axis=0)
+    if op == ReduceOp.AVERAGE:
+        y = (y / nset).astype(x.dtype)
+    if postscale != 1.0:
+        y = y * jnp.asarray(postscale, dtype=y.dtype)
+    return y
+
+
+def _spmd_alltoall_leaf(x, axes, ps):
+    world = _axis_size(axes)
+    groups, nset = _set_groups(ps, world)
+    axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
+    if x.shape[0] % nset:
+        raise HorovodInternalError(
+            f"alltoall dim0 {x.shape[0]} not divisible by set size {nset}"
+        )
+    if groups is None:
+        return lax.all_to_all(
+            x, axis_arg, split_axis=0, concat_axis=0, tiled=True
+        )
+    # Subset alltoall via one-hot matrix exchange: build [nset, chunk, ...]
+    # where slot j holds the chunk destined to set-member j, rotate via
+    # psum of masked scatter. One collective; complement ranks unaffected.
+    chunk = x.shape[0] // nset
+    parts = x.reshape((nset, chunk) + x.shape[1:])
+    idx = _set_local_index(ps, axes[0])  # my set-local rank
+    # out[j] should receive parts[j] from member j's buffer at slot my idx.
+    # Scatter parts[j] -> buffer[j, my_idx] then psum over the set.
+    buf = jnp.zeros((nset, nset, chunk) + x.shape[1:], dtype=x.dtype)
+    buf = lax.dynamic_update_slice(
+        buf,
+        parts[:, None],
+        (0, idx) + (0,) * (parts.ndim - 1),
+    )
+    buf = lax.psum(buf, axes[0], axis_index_groups=groups)
+    out = buf[idx]  # [nset, chunk, ...] — chunk j from member j
+    return out.reshape((nset * chunk,) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# eager-form execution (top level, concrete arrays)
+# ---------------------------------------------------------------------------
+#
+# Single-controller semantics: the controller's value stands for every
+# rank's value (all ranks submit identical tensors), so eager SUM == x*n,
+# AVERAGE == x, allgather == n-fold tile. In multi-controller mode
+# (jax.process_count() > 1) each controller contributes its process-local
+# value and the op is a real cross-process collective compiled over the
+# global mesh. The jit cache is keyed by shape/dtype/op — the steady-state
+# fast path analog of the reference's ResponseCache (response_cache.h:45).
+
+@functools.lru_cache(maxsize=4096)
+def _eager_program(op_kind: str, ndev: int, op: int, prescale: float,
+                   postscale: float, root_rank: int, epoch: int):
+    del epoch  # cache-buster across elastic re-init
+    st = global_state()
+    mesh = st.mesh
+    from jax.sharding import PartitionSpec as P
+
+    axes = ("hvd",) if mesh is None else tuple(mesh.axis_names)
+
+    # The per-rank stack is laid out [world, ...] and sharded on dim 0, so
+    # each device's shard_map block is [1, ...]: squeeze it so the leaf sees
+    # exactly "this rank's tensor", like a Horovod process would.
+    if op_kind == "allreduce":
+        def fn(x):
+            return _spmd_allreduce_leaf(
+                x[0], ReduceOp(op), axes, None, prescale, postscale
+            )
+        in_spec, out_spec = P(axes), P()
+    elif op_kind == "allgather":
+        def fn(x):
+            return _spmd_allgather_leaf(x[0], axes, None)
+        in_spec, out_spec = P(axes), P()
+    elif op_kind == "broadcast":
+        def fn(x):
+            return _spmd_broadcast_leaf(x[0], root_rank, axes, None)
+        in_spec, out_spec = P(axes), P()
+    elif op_kind == "reducescatter":
+        def fn(x):
+            return _spmd_reducescatter_leaf(
+                x[0], ReduceOp(op), axes, None, prescale, postscale
+            )
+        in_spec, out_spec = P(axes), P(axes)
+    elif op_kind == "alltoall":
+        def fn(x):
+            return _spmd_alltoall_leaf(x[0], axes, None)
+        in_spec, out_spec = P(axes), P(axes)
+    else:
+        raise ValueError(op_kind)
+
+    from jax import shard_map
+
+    return jax.jit(
+        shard_map(
+            fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+            # allgather/broadcast outputs are value-replicated but typed
+            # device-varying by the VMA checker; these programs are
+            # framework-internal, so skip the static check.
+            check_vma=False,
+        )
+    )
+
+
+def _eager_perrank(op_kind: str, stacked, op=ReduceOp.SUM, prescale=1.0,
+                   postscale=1.0, root_rank=0):
+    """Run a collective treating ``stacked[i]`` as rank i's tensor.
+
+    The tensor is laid out [world, ...] and sharded one-slice-per-device
+    along the mesh; the shard_map body then sees exactly rank i's tensor on
+    device i — the precise analog of N processes each submitting a tensor.
+    Used by eager ops, tests and broadcast_parameters.
+    """
+    st = global_state()
+    mesh = st.mesh
+    ndev = int(np.prod(mesh.devices.shape))
+    prog = _eager_program(
+        op_kind, ndev, int(op), float(prescale), float(postscale),
+        int(root_rank), st.epoch,
+    )
+    return prog(stacked)
+
+
+def _is_perrank(x, nset: int) -> bool:
+    return hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == nset
+
+
+def _eager_collective(op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
+                      postscale=1.0, root_rank=0, process_set=None):
+    st = global_state()
+    ps = process_set
+    if ps is not None and ps.process_set_id == 0:
+        ps = None
+    n = st.world_size() if ps is None else ps.size()
+
+    if ps is not None:
+        # Eager subset ops run over the sub-mesh — a real communicator of
+        # exactly the member devices, no groups needed.
+        raise HorovodInternalError(
+            "eager process-set collectives: use ops inside shard_map or "
+            "ProcessSet.sub_mesh(); top-level eager subset execution lands "
+            "with the eager runtime (see ops/eager_runtime.py)"
+        )
+
+    x = jnp.asarray(tensor)
+    # Replicated single-controller semantics: synthesize the per-rank stack.
+    if op_kind in ("allreduce", "allgather", "broadcast"):
+        stacked = jnp.broadcast_to(x[None], (n,) + x.shape)
+        out = _eager_perrank(op_kind, stacked, op, prescale, postscale, root_rank)
+        return out
+    elif op_kind == "reducescatter":
+        stacked = jnp.broadcast_to(x[None], (n,) + x.shape)
+        out = _eager_perrank(op_kind, stacked, op, prescale, postscale)
+        # out is [world * (d0/world), ...] sharded; controller returns the
+        # rank-0 chunk to match per-process semantics.
+        chunk = x.shape[0] // n
+        return out[:chunk]
+    elif op_kind == "alltoall":
+        stacked = jnp.broadcast_to(x[None], (n,) + x.shape)
+        out = _eager_perrank(op_kind, stacked)
+        return out[: x.shape[0]]
+    raise ValueError(op_kind)
+
+
+# ---------------------------------------------------------------------------
+# public API — allreduce family
+# ---------------------------------------------------------------------------
+
+def _dispatch(tensor, spmd_fn, eager_fn, axes):
+    """Route to SPMD form when the dp axis is bound, else eager form."""
+    live = _bound_axes(axes)
+    if live:
+        return jax.tree_util.tree_map(lambda x: spmd_fn(x, live), tensor)
+    return jax.tree_util.tree_map(eager_fn, tensor)
+
+
+def allreduce(
+    tensor,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name=None,
+):
+    """All-reduce a tensor (or pytree) across the data-parallel world.
+
+    API parity: horovod/torch/mpi_ops.py:255 (allreduce) — `average` is the
+    deprecated bool alias for op=Average/Sum, `name` is accepted for
+    compatibility (XLA names come from jaxpr provenance), prescale/postscale
+    mirror the fused scalar multiplies (collective_operations.h:91
+    ScaleBuffer), and `process_set` restricts participation.
+    """
+    if op is None:
+        op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
+    elif average is not None:
+        raise ValueError("specify either average= or op=, not both")
+    del name
+    if op == ReduceOp.ADASUM:
+        from .adasum import adasum_allreduce
+
+        axes = _resolve_axis(axis_name)
+        live = _bound_axes(axes)
+        if live:
+            return jax.tree_util.tree_map(
+                lambda x: adasum_allreduce(
+                    x, live[0], process_set=process_set
+                ),
+                tensor,
+            )
+        # eager single-controller: identical tensors ⇒ adasum(a,a) == a
+        return tensor
+
+    axes = _resolve_axis(axis_name)
+    ps = process_set
+
+    def spmd(x, live):
+        return _spmd_allreduce_leaf(
+            x, op, live, ps, prescale_factor, postscale_factor
+        )
+
+    def eager(x):
+        return _eager_collective(
+            "allreduce", x, op, prescale_factor, postscale_factor,
+            process_set=ps,
+        )
+
+    return _dispatch(tensor, spmd, eager, axes)
+
+
+def grouped_allreduce(
+    tensors: Sequence,
+    average: Optional[bool] = None,
+    name: Optional[str] = None,
+    op: Optional[ReduceOp] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name=None,
+) -> List:
+    """Fused all-reduce of a list of tensors.
+
+    Reference: torch/mpi_ops.py:555 grouped_allreduce + the fusion buffer
+    (FuseResponses controller.cc:830, fusion_buffer_manager.h:30). Here the
+    fusion is explicit and compile-time: tensors are flattened and packed
+    into per-dtype buckets bounded by HOROVOD_FUSION_THRESHOLD, one XLA
+    collective per bucket, then unpacked. See ops/fusion.py.
+    """
+    from .fusion import fuse_apply
+
+    if op is None:
+        op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
+    del name
+
+    def reducer(flat_bucket):
+        return allreduce(
+            flat_bucket,
+            op=ReduceOp.SUM if op == ReduceOp.AVERAGE else op,
+            prescale_factor=prescale_factor,
+            postscale_factor=(
+                postscale_factor / _group_size(process_set, axis_name)
+                if op == ReduceOp.AVERAGE
+                else postscale_factor
+            ),
+            process_set=process_set,
+            axis_name=axis_name,
+        )
+
+    return fuse_apply(list(tensors), reducer)
+
+
+def _group_size(ps: Optional[ProcessSet], axis_name) -> int:
+    if ps is not None and ps.process_set_id != 0:
+        return ps.size()
+    axes = _resolve_axis(axis_name)
+    live = _bound_axes(axes)
+    if live:
+        return _axis_size(live)
+    return global_state().world_size()
+
+
+def allgather(
+    tensor,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name=None,
+):
+    """Concatenate each rank's tensor along dim 0
+    (torch/mpi_ops.py:752 allgather). SPMD shapes are rank-uniform by
+    construction; ragged first dims are an eager-runtime feature
+    (ops/eager_runtime.py)."""
+    del name
+    axes = _resolve_axis(axis_name)
+    ps = process_set
+
+    def spmd(x, live):
+        return _spmd_allgather_leaf(x, live, ps)
+
+    def eager(x):
+        return _eager_collective("allgather", x, process_set=ps)
+
+    return _dispatch(tensor, spmd, eager, axes)
+
+
+def broadcast(
+    tensor,
+    root_rank: int = 0,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name=None,
+):
+    """Broadcast root_rank's tensor to every rank
+    (torch/mpi_ops.py:858). root_rank is a *global* rank, also for process
+    sets (matching the reference's semantics)."""
+    del name
+    axes = _resolve_axis(axis_name)
+    ps = process_set
+    if ps is not None and ps.process_set_id != 0 and root_rank not in ps.ranks:
+        raise HorovodInternalError(
+            f"broadcast root {root_rank} not in process set {ps.ranks}"
+        )
+
+    def spmd(x, live):
+        return _spmd_broadcast_leaf(x, root_rank, live, ps)
+
+    def eager(x):
+        return _eager_collective("broadcast", x, root_rank=root_rank,
+                                 process_set=ps)
+
+    return _dispatch(tensor, spmd, eager, axes)
+
+
+def reducescatter(
+    tensor,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    name: Optional[str] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    axis_name=None,
+):
+    """Reduce then scatter chunks of dim 0 (torch/mpi_ops.py:1022);
+    rank i receives chunk i. Default op is Average like the reference."""
+    del name
+    axes = _resolve_axis(axis_name)
+    ps = process_set
+
+    def spmd(x, live):
+        return _spmd_reducescatter_leaf(
+            x, op, live, ps, prescale_factor, postscale_factor
+        )
+
+    def eager(x):
+        return _eager_collective(
+            "reducescatter", x, op, prescale_factor, postscale_factor,
+            process_set=ps,
+        )
+
+    return _dispatch(tensor, spmd, eager, axes)
+
+
+def grouped_reducescatter(tensors, op=ReduceOp.AVERAGE, **kw):
+    """Grouped variant (torch/mpi_ops.py grouped_reducescatter)."""
+    return [reducescatter(t, op=op, **kw) for t in tensors]
+
+
+def grouped_allgather(tensors, **kw):
+    return [allgather(t, **kw) for t in tensors]
+
+
+def alltoall(
+    tensor,
+    splits=None,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+    axis_name=None,
+):
+    """Exchange dim-0 chunks between ranks (torch/mpi_ops.py:1102).
+
+    Equal splits (splits=None): one XLA all-to-all HLO — dim 0 must divide
+    by the set size. Uneven `splits` are supported in the eager runtime
+    (true ragged exchange, ops/eager_runtime.py) and via the padded SPMD
+    helper `horovod_tpu.parallel.ulysses.padded_alltoall` — SPMD programs
+    are shape-uniform across ranks, so raggedness needs an explicit static
+    bound there (SURVEY.md §5.7).
+
+    Returns the exchanged tensor; with `splits` also returns
+    received_splits, matching the reference's (output, received_splits).
+    """
+    del name
+    axes = _resolve_axis(axis_name)
+    ps = process_set
+
+    if splits is not None:
+        splits = jnp.asarray(splits, dtype=jnp.int32)
+        live = _bound_axes(axes)
+        if live:
+            raise HorovodInternalError(
+                "uneven alltoall inside SPMD requires "
+                "parallel.ulysses.padded_alltoall (static max chunk); "
+                "equal-split alltoall lowers to one HLO"
+            )
+        # eager single-controller: every rank sends `splits` → receives the
+        # per-source chunk sizes = splits[rank] each... identical tensors ⇒
+        # received_splits[j] = splits[my_index] for each source j. At the
+        # controller (rank 0 view): received chunks are each rank's chunk 0.
+        received_splits = jnp.full((_group_size(ps, axis_name),), splits[0])
+        out = jnp.asarray(tensor)[: int(splits[0]) * _group_size(ps, axis_name)]
+        return out, received_splits
+
+    def spmd(x, live):
+        return _spmd_alltoall_leaf(x, live, ps)
+
+    def eager(x):
+        return _eager_collective("alltoall", x, process_set=ps)
+
+    return _dispatch(tensor, spmd, eager, axes)
+
+
+def alltoall_splits_exchange(splits, live, ps):
+    """Exchange split sizes (row i of the implied matrix): each rank learns
+    how much every peer will send it. One small all_to_all."""
+    return _spmd_alltoall_leaf(splits.reshape(-1, 1), live, ps).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# join / barrier
+# ---------------------------------------------------------------------------
+
+def join(device=None) -> int:
+    """Ragged-end data parallelism (torch/mpi_ops.py:1250, JoinOp
+    collective_operations.h:325): ranks that exhausted their data "join";
+    the others keep all-reducing with zero contributions from joined ranks.
+
+    Under single-controller SPMD there are no raggedly-finishing processes —
+    uneven data is handled *inside* the step via masking (see
+    `masked_allreduce`), the idiomatic XLA form. Eagerly this is therefore
+    a synchronization no-op returning the last joined rank (0). The
+    multi-controller eager runtime implements true join accounting.
+    """
+    del device
+    barrier()
+    return 0
+
+
+def masked_allreduce(tensor, valid, axis_name=None, process_set=None):
+    """SPMD-native 'join': average over only the ranks where `valid` is
+    true. ``out = psum(x*valid) / psum(valid)`` — equivalent to the
+    reference's join-with-zero-contribution + recount semantics."""
+    axes = _bound_axes(_resolve_axis(axis_name))
+    if not axes:
+        return tensor
+    v = jnp.asarray(valid)
+
+    def leaf(x):
+        num = _spmd_allreduce_leaf(
+            x * v.astype(x.dtype), ReduceOp.SUM, axes, process_set, 1.0, 1.0
+        )
+        den = _spmd_allreduce_leaf(
+            v.astype(jnp.float32), ReduceOp.SUM, axes, process_set, 1.0, 1.0
+        )
+        return (num / jnp.maximum(den, 1.0).astype(x.dtype)).astype(x.dtype)
+
+    return jax.tree_util.tree_map(leaf, tensor)
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    """Block until all ranks arrive (torch/mpi_ops.py:1330, BarrierOp).
+    Eager: a scalar psum across the mesh, blocked on. SPMD: XLA's program
+    order already synchronizes; emit an optimization barrier no-op."""
+    if basics.in_spmd_context():
+        return
+    st = global_state()
+    if not st.initialized:
+        return
+    out = _eager_collective("allreduce", jnp.zeros(()), ReduceOp.SUM,
+                            process_set=process_set)
+    jax.block_until_ready(out)
+
+
+# ---------------------------------------------------------------------------
+# async handles
+# ---------------------------------------------------------------------------
+#
+# JAX dispatch is asynchronous by construction: every eager op above
+# returns immediately with a future-backed Array. The handle layer exists
+# for API parity with torch/mpi_ops.py:107-151 (allreduce_async_ →
+# handle → synchronize/poll) and handle_manager.h:31.
+
+class _HandleManager:
+    def __init__(self):
+        self._next = 0
+        self._values = {}
+
+    def allocate(self, value) -> int:
+        h = self._next
+        self._next += 1
+        self._values[h] = value
+        return h
+
+    def get(self, h: int):
+        return self._values[h]
+
+    def release(self, h: int):
+        return self._values.pop(h)
+
+
+_handles = _HandleManager()
+
+
+def _async(fn, *args, **kw) -> int:
+    return _handles.allocate(fn(*args, **kw))
+
+
+def allreduce_async(tensor, *a, **kw) -> int:
+    return _async(allreduce, tensor, *a, **kw)
+
+
+def allgather_async(tensor, *a, **kw) -> int:
+    return _async(allgather, tensor, *a, **kw)
+
+
+def broadcast_async(tensor, *a, **kw) -> int:
+    return _async(broadcast, tensor, *a, **kw)
+
+
+def alltoall_async(tensor, *a, **kw) -> int:
+    return _async(alltoall, tensor, *a, **kw)
+
+
+def reducescatter_async(tensor, *a, **kw) -> int:
+    return _async(reducescatter, tensor, *a, **kw)
+
+
+def grouped_allreduce_async(tensors, *a, **kw) -> int:
+    return _async(grouped_allreduce, tensors, *a, **kw)
+
+
+def poll(handle: int) -> bool:
+    """True if the async op completed (torch/mpi_ops.py:1210)."""
+    v = _handles.get(handle)
+    try:
+        leaves = jax.tree_util.tree_leaves(v)
+        return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
+    except Exception:
+        return True
+
+
+def synchronize(handle: int):
+    """Wait for and return the result (torch/mpi_ops.py:1226)."""
+    v = _handles.release(handle)
+    jax.block_until_ready(v)
+    return v

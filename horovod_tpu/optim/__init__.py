@@ -1,0 +1,12 @@
+from .compression import Compression  # noqa: F401
+from .distributed import (  # noqa: F401
+    DistributedGradientTape,
+    DistributedOptimizer,
+    distributed_value_and_grad,
+)
+from .functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)

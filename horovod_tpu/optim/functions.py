@@ -1,0 +1,118 @@
+"""Parameter/object broadcast and gather helpers.
+
+Reference: /root/reference/horovod/torch/functions.py:30
+(broadcast_parameters), :62 (broadcast_optimizer_state), :191
+(broadcast_object), :236 (allgather_object);
+tensorflow/functions.py:220 (broadcast_object/allgather_object).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import collectives
+
+
+def broadcast_parameters(params, root_rank: int = 0, process_set=None,
+                         axis_name=None):
+    """Broadcast a parameter pytree from root_rank to all ranks
+    (torch/functions.py:30). Under single-controller SPMD parameters are
+    born replicated, so this is an identity that *asserts replication* —
+    it re-broadcasts only when ranks could have diverged (multi-controller
+    eager mode, elastic re-init)."""
+    return jax.tree_util.tree_map(
+        lambda p: collectives.broadcast(
+            p, root_rank=root_rank, process_set=process_set,
+            axis_name=axis_name,
+        ),
+        params,
+    )
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0,
+                              process_set=None, axis_name=None):
+    """Broadcast optimizer state (torch/functions.py:62). optax state is a
+    pytree of arrays — no dict surgery needed (the reference has to walk
+    torch param groups)."""
+    return jax.tree_util.tree_map(
+        lambda p: (
+            collectives.broadcast(
+                p, root_rank=root_rank, process_set=process_set,
+                axis_name=axis_name,
+            )
+            if hasattr(p, "dtype")
+            else p
+        ),
+        opt_state,
+    )
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: Optional[str] = None,
+                     process_set=None) -> Any:
+    """Pickle-and-broadcast an arbitrary python object
+    (torch/functions.py:191): serialize on root, broadcast the length then
+    the byte buffer, unpickle everywhere. Eager-only (objects are host
+    state)."""
+    del name
+    from ..core import basics
+
+    if basics.in_spmd_context():
+        raise RuntimeError("broadcast_object is host-side; call it outside jit")
+
+    if basics.cross_size() == 1:
+        # single controller: all ranks trivially share the object
+        return obj
+
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    data = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    length = collectives.broadcast(
+        jnp.asarray([data.size], dtype=jnp.int32), root_rank=root_rank,
+        process_set=process_set,
+    )
+    payload = jnp.zeros((int(length[0]),), dtype=jnp.uint8)
+    if True:  # every rank contributes; root's bytes win the broadcast
+        n = min(int(length[0]), data.size)
+        payload = payload.at[:n].set(jnp.asarray(data[:n]))
+    payload = collectives.broadcast(payload, root_rank=root_rank,
+                                    process_set=process_set)
+    return pickle.loads(np.asarray(payload).tobytes())
+
+
+def allgather_object(obj: Any, name: Optional[str] = None,
+                     process_set=None) -> list:
+    """Pickle-and-allgather arbitrary objects (torch/functions.py:236):
+    returns a list with every rank's object."""
+    del name
+    from ..core import basics
+
+    if basics.in_spmd_context():
+        raise RuntimeError("allgather_object is host-side; call it outside jit")
+
+    n = basics.size() if process_set is None else process_set.size()
+    if basics.cross_size() == 1:
+        return [obj] * n
+
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    data = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    sizes = collectives.allgather(
+        jnp.asarray([data.size], dtype=jnp.int32), process_set=process_set
+    )
+    max_size = int(np.max(np.asarray(sizes)))
+    padded = np.zeros((max_size,), dtype=np.uint8)
+    padded[: data.size] = data
+    gathered = collectives.allgather(
+        jnp.asarray(padded), process_set=process_set
+    )
+    out = []
+    g = np.asarray(gathered).reshape(n, max_size)
+    for i in range(n):
+        out.append(pickle.loads(g[i, : int(sizes[i])].tobytes()))
+    return out

@@ -1,0 +1,52 @@
+"""Leveled, rank-prefixed logging.
+
+Reference: /root/reference/horovod/common/logging.{cc,h} — C++ macro logger
+with levels TRACE/DEBUG/INFO/WARNING/ERROR/FATAL, env-configured via
+HOROVOD_LOG_LEVEL and HOROVOD_LOG_HIDE_TIME. Python logging is the natural
+host here; the C++ native runtime (horovod_tpu/_native) has its own
+mirror-image logger for the background thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_LEVELS = {
+    "trace": logging.DEBUG,  # python has no TRACE; map to DEBUG
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+LOGGER = logging.getLogger("horovod_tpu")
+
+
+class _RankFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            import jax
+
+            record.hvd_rank = jax.process_index()
+        except Exception:
+            record.hvd_rank = -1
+        return True
+
+
+def configure_logging(level: str = "WARNING", hide_timestamp: bool = False) -> None:
+    LOGGER.setLevel(_LEVELS.get(level.strip().lower(), logging.WARNING))
+    if not LOGGER.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        fmt = "[%(hvd_rank)s]<%(levelname)s> %(message)s"
+        if not hide_timestamp:
+            fmt = "%(asctime)s " + fmt
+        h.setFormatter(logging.Formatter(fmt))
+        h.addFilter(_RankFilter())
+        LOGGER.addHandler(h)
+        LOGGER.propagate = False
+
+
+def get_logger() -> logging.Logger:
+    return LOGGER

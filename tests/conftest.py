@@ -1,0 +1,41 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's tier-2 strategy (SURVEY.md §4): op-correctness
+suites run in a multi-rank world without real multi-chip hardware. On TPU
+that world is `--xla_force_host_platform_device_count=8` CPU devices; the
+same SPMD programs compile unchanged for real TPU meshes.
+"""
+
+import os
+import sys
+
+# Must happen before any jax backend initialization.
+_FLAG = "--xla_force_host_platform_device_count=8"
+_existing = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _existing:
+    os.environ["XLA_FLAGS"] = (_existing + " " + _FLAG).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hvd():
+    """Each test gets a freshly-initialized world."""
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    yield
+    hvd.shutdown()
+
+
+@pytest.fixture
+def hvd8():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert hvd.size() == 8, "test harness expects 8 virtual devices"
+    return hvd

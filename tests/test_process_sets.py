@@ -1,0 +1,163 @@
+"""Process-set collectives.
+
+Reference analog: test/parallel/test_process_sets_static.py and the
+process-set sweeps inside test_torch.py (reduce/gather/broadcast restricted
+to subsets of ranks, with non-members unaffected).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.core.process_sets import ProcessSet
+
+
+def run_spmd(body, per_rank_in, out_spec=P("hvd")):
+    mesh = hvd.mesh()
+    wrapped = lambda x: body(x[0])
+    return jax.jit(
+        shard_map(
+            wrapped, mesh=mesh, in_specs=P("hvd"), out_specs=out_spec,
+            check_vma=False,
+        )
+    )(per_rank_in)
+
+
+def test_registration(hvd8):
+    ps = hvd.add_process_set([0, 2, 4])
+    assert ps.process_set_id == 1
+    assert ps.size() == 3
+    assert ps.included(2) and not ps.included(1)
+    assert ps.rank(4) == 2
+    assert hvd.get_process_set_by_id(1) is ps
+    hvd.remove_process_set(ps)
+    with pytest.raises(hvd.ProcessSetError):
+        hvd.get_process_set_by_id(1)
+
+
+def test_global_set_is_id_zero(hvd8):
+    g = hvd.global_process_set()
+    assert g.process_set_id == 0
+    assert g.ranks == list(range(8))
+
+
+def test_duplicate_set_rejected(hvd8):
+    hvd.add_process_set([1, 3])
+    with pytest.raises(hvd.ProcessSetError):
+        hvd.add_process_set([3, 1])
+
+
+def test_cannot_remove_global(hvd8):
+    with pytest.raises(hvd.ProcessSetError):
+        hvd.remove_process_set(0)
+
+
+def test_out_of_range_ranks_rejected(hvd8):
+    with pytest.raises(hvd.ProcessSetError):
+        hvd.add_process_set([0, 99])
+
+
+def test_allreduce_subset(hvd8):
+    ps = hvd.add_process_set([1, 3, 5])
+    x = jnp.arange(8.0).reshape(8, 1)  # rank r holds value r
+
+    out = run_spmd(
+        lambda t: hvd.allreduce(t, op=hvd.Sum, process_set=ps), x
+    )
+    got = np.asarray(out).reshape(8)
+    # members get 1+3+5=9; non-members reduce alone (identity)
+    expect = np.array([0.0, 9.0, 2.0, 9.0, 4.0, 9.0, 6.0, 7.0])
+    np.testing.assert_allclose(got, expect)
+
+
+def test_allreduce_subset_average(hvd8):
+    ps = hvd.add_process_set([0, 4])
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = run_spmd(
+        lambda t: hvd.allreduce(t, op=hvd.Average, process_set=ps), x
+    )
+    got = np.asarray(out).reshape(8)
+    # members hold the set-average; non-member outputs are unspecified
+    # (the reference raises on non-member submission; SPMD programs are
+    # uniform so non-members compute a don't-care value)
+    assert got[0] == got[4] == 2.0  # (0+4)/2
+
+
+def test_allgather_subset(hvd8):
+    ps = hvd.add_process_set([2, 5, 7])
+    x = (jnp.arange(8.0)[:, None, None] * jnp.ones((8, 2, 3))).astype(
+        jnp.float32
+    )
+
+    out = run_spmd(
+        lambda t: hvd.allgather(t, process_set=ps), x, out_spec=P("hvd")
+    )
+    # each member receives [6, 3] = concat of members' [2, 3] blocks
+    got = np.asarray(out).reshape(8, 6, 3)
+    expect_member = np.concatenate(
+        [np.full((2, 3), r, dtype=np.float32) for r in (2, 5, 7)]
+    )
+    for r in (2, 5, 7):
+        np.testing.assert_array_equal(got[r], expect_member)
+
+
+def test_broadcast_subset(hvd8):
+    ps = hvd.add_process_set([1, 2, 6])
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = run_spmd(
+        lambda t: hvd.broadcast(t, root_rank=2, process_set=ps), x
+    )
+    got = np.asarray(out).reshape(8)
+    for r in (1, 2, 6):
+        assert got[r] == 2.0
+
+
+def test_broadcast_subset_root_must_be_member(hvd8):
+    ps = hvd.add_process_set([1, 2, 6])
+    with pytest.raises(hvd.HorovodInternalError):
+        run_spmd(
+            lambda t: hvd.broadcast(t, root_rank=0, process_set=ps),
+            jnp.zeros((8, 1)),
+        )
+
+
+def test_reducescatter_subset(hvd8):
+    ps = hvd.add_process_set([0, 3])
+    # dim0=4 divides set size 2: each member gets a [2]-chunk
+    x = jnp.stack([jnp.full((4,), float(r)) for r in range(8)])
+    out = run_spmd(
+        lambda t: hvd.reducescatter(t, op=hvd.Sum, process_set=ps),
+        x,
+        out_spec=P("hvd"),
+    )
+    got = np.asarray(out).reshape(8, 2)
+    np.testing.assert_array_equal(got[0], [3.0, 3.0])  # chunk 0 of 0+3
+    np.testing.assert_array_equal(got[3], [3.0, 3.0])  # chunk 1 of 0+3
+
+
+def test_alltoall_subset(hvd8):
+    ps = hvd.add_process_set([1, 4])
+    # member r sends chunk j to set-member j; values encode (src, chunk)
+    x = jnp.stack(
+        [jnp.asarray([10.0 * r, 10.0 * r + 1]) for r in range(8)]
+    )  # [8, 2]: chunk j = 10r+j
+    out = run_spmd(
+        lambda t: hvd.alltoall(t, process_set=ps), x, out_spec=P("hvd")
+    )
+    got = np.asarray(out).reshape(8, 2)
+    # member 1 (set idx 0) receives chunk 0 from members 1,4 -> [10, 40]
+    np.testing.assert_array_equal(got[1], [10.0, 40.0])
+    # member 4 (set idx 1) receives chunk 1 from members 1,4 -> [11, 41]
+    np.testing.assert_array_equal(got[4], [11.0, 41.0])
+
+
+def test_sub_mesh(hvd8):
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    sub = ps.sub_mesh()
+    assert sub.devices.shape == (4,)
+    assert sub.axis_names == ("hvd",)
+
