@@ -1,0 +1,52 @@
+"""MNIST MLP/ConvNet — the smoke-test model family.
+
+Reference vehicle: /root/reference/examples/pytorch/pytorch_mnist.py
+(BASELINE.json configs[0]): a 2-conv + 2-fc net trained with
+hvd.DistributedOptimizer. Implemented in flax.linen with NHWC layout and
+bf16-friendly defaults (TPU conv/matmul native layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Plain MLP for quick numerics tests."""
+
+    features: Sequence[int] = (128, 64, 10)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+class MnistNet(nn.Module):
+    """The reference MNIST model shape (pytorch_mnist.py Net: conv 10/20 +
+    fc 50/10), NHWC + bf16-compute variant."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: [B, 28, 28, 1]
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50, dtype=self.dtype)(x))
+        x = nn.Dense(10, dtype=self.dtype)(x)
+        return x

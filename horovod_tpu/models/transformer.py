@@ -1,0 +1,324 @@
+"""Transformer model family: GPT-2, BERT-Large, Llama.
+
+Benchmark vehicles from BASELINE.json configs: BERT-Large pretraining
+(tokens/sec/chip), Adasum on Llama-2-7B, elastic GPT-2. The reference
+repo has no transformer implementations of its own (it wraps torchvision /
+keras / user models) — these are TPU-first implementations built for this
+framework's benchmarks and examples.
+
+TPU-first choices:
+  * bfloat16 activations/weights with float32 layernorm + logits
+  * shapes padded to MXU tiles (head_dim multiples of 128 recommended)
+  * pluggable attention: `attention_fn` lets the parallel layer swap in
+    ring attention (parallel/ring_attention.py) or Ulysses all-to-all
+    (parallel/ulysses.py) without touching model code
+  * optional per-block remat (`jax.checkpoint`) for HBM-bound configs
+  * params stay plain arrays; tensor/FSDP sharding rules live externally
+    in parallel/sharding.py (path-pattern → PartitionSpec over dp/fsdp/tp
+    axes) so pjit shards them and XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # GQA; None = MHA
+    hidden_size: int = 768
+    mlp_ratio: float = 4.0
+    max_seq_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    # architecture switches
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    position: str = "learned"  # "learned" | "rope" | "none"
+    activation: str = "gelu"  # "gelu" | "swiglu"
+    causal: bool = True
+    tie_embeddings: bool = True
+    remat: bool = False
+    rope_theta: float = 10000.0
+    layernorm_epsilon: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return int(self.hidden_size * self.mlp_ratio)
+
+
+# -- named configs ----------------------------------------------------------
+
+GPT2_SMALL = TransformerConfig(
+    vocab_size=50257, num_layers=12, num_heads=12, hidden_size=768,
+    max_seq_len=1024,
+)
+GPT2_MEDIUM = dataclasses.replace(
+    GPT2_SMALL, num_layers=24, num_heads=16, hidden_size=1024
+)
+GPT2_LARGE = dataclasses.replace(
+    GPT2_SMALL, num_layers=36, num_heads=20, hidden_size=1280
+)
+BERT_BASE = TransformerConfig(
+    vocab_size=30522, num_layers=12, num_heads=12, hidden_size=768,
+    max_seq_len=512, causal=False,
+)
+BERT_LARGE = dataclasses.replace(
+    BERT_BASE, num_layers=24, num_heads=16, hidden_size=1024
+)
+LLAMA2_7B = TransformerConfig(
+    vocab_size=32000, num_layers=32, num_heads=32, hidden_size=4096,
+    mlp_ratio=11008 / 4096, max_seq_len=4096, norm="rmsnorm",
+    position="rope", activation="swiglu", tie_embeddings=False,
+)
+LLAMA3_8B = TransformerConfig(
+    vocab_size=128256, num_layers=32, num_heads=32, num_kv_heads=8,
+    hidden_size=4096, mlp_ratio=14336 / 4096, max_seq_len=8192,
+    norm="rmsnorm", position="rope", activation="swiglu",
+    tie_embeddings=False, rope_theta=500000.0,
+)
+
+
+# -- building blocks --------------------------------------------------------
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        xf = x.astype(jnp.float32)
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), jnp.float32
+        )
+        y = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + self.epsilon
+        )
+        return (y * scale).astype(self.dtype)
+
+
+def _norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype,
+                       name=name)
+    return nn.LayerNorm(epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name=name)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_len)
+    freqs = np.outer(t, inv)  # [T, D/2]
+    return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
+
+
+def apply_rope(x, cos, sin, positions):
+    """x: [B, T, H, D]; positions: [B, T] absolute positions (so sequence-
+    parallel shards pass their global offsets)."""
+    c = cos[positions][:, :, None, :]  # [B, T, 1, D/2]
+    s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def dot_product_attention(q, k, v, *, causal: bool, mask=None):
+    """Default attention: q,k,v [B, T, H, D] -> [B, T, H, D].
+
+    float32 softmax accumulation on bf16 inputs (TPU-stable). Swappable via
+    `attention_fn` for ring/Ulysses sequence parallelism.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    # GQA: repeat kv heads
+    if k.shape[2] != H:
+        rep = H // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        cm = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+        logits = jnp.where(cm[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions, mask=None):
+        cfg = self.cfg
+        B, T, _ = x.shape
+        H, KH, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        dense = functools.partial(
+            nn.DenseGeneral, dtype=cfg.dtype, param_dtype=jnp.float32,
+            use_bias=cfg.norm == "layernorm",
+        )
+        q = dense(features=(H, D), name="query",
+                  kernel_init=nn.initializers.xavier_uniform())(x)
+        k = dense(features=(KH, D), name="key",
+                  kernel_init=nn.initializers.xavier_uniform())(x)
+        v = dense(features=(KH, D), name="value",
+                  kernel_init=nn.initializers.xavier_uniform())(x)
+        if cfg.position == "rope":
+            cos, sin = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+        attn = self.attention_fn or functools.partial(
+            dot_product_attention, causal=cfg.causal
+        )
+        if self.attention_fn is None:
+            out = attn(q, k, v, mask=mask)
+        else:
+            out = attn(q, k, v)
+        out = nn.DenseGeneral(
+            features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, use_bias=cfg.norm == "layernorm",
+            name="out",
+            kernel_init=nn.initializers.xavier_uniform(),
+        )(out)
+        return out
+
+
+class Mlp(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = functools.partial(
+            nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32,
+            use_bias=cfg.norm == "layernorm",
+        )
+        if cfg.activation == "swiglu":
+            gate = dense(cfg.mlp_dim, name="gate",
+                         kernel_init=nn.initializers.xavier_uniform())(x)
+            up = dense(cfg.mlp_dim, name="up",
+                       kernel_init=nn.initializers.xavier_uniform())(x)
+            h = nn.silu(gate) * up
+        else:
+            h = dense(cfg.mlp_dim, name="fc1",
+                      kernel_init=nn.initializers.xavier_uniform())(x)
+            h = nn.gelu(h)
+        return dense(cfg.hidden_size, name="fc2",
+                     kernel_init=nn.initializers.xavier_uniform())(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, positions, mask=None):
+        cfg = self.cfg
+        y = _norm(cfg, "ln_attn")(x)
+        x = x + Attention(cfg, attention_fn=self.attention_fn,
+                          name="attn")(y, positions, mask)
+        y = _norm(cfg, "ln_mlp")(x)
+        x = x + Mlp(cfg, name="mlp")(y)
+        return x
+
+
+class Transformer(nn.Module):
+    """Decoder/encoder stack with LM head; covers GPT-2 (causal + learned
+    pos), BERT (bidirectional) and Llama (causal + rope/rms/swiglu)."""
+
+    cfg: TransformerConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, mask=None):
+        cfg = self.cfg
+        B, T = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="tok_emb",
+            embedding_init=nn.initializers.normal(0.02),
+        )
+        x = emb(tokens)
+        if cfg.position == "learned":
+            pos_emb = self.param(
+                "pos_emb",
+                nn.initializers.normal(0.02),
+                (cfg.max_seq_len, cfg.hidden_size),
+                jnp.float32,
+            )
+            x = x + pos_emb[positions].astype(cfg.dtype)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = block(cfg, attention_fn=self.attention_fn,
+                      name=f"block_{i}")(x, positions, mask)
+        x = _norm(cfg, "ln_final")(x)
+        if cfg.tie_embeddings:
+            logits = emb.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                param_dtype=jnp.float32, name="lm_head",
+                kernel_init=nn.initializers.normal(0.02),
+            )(x)
+        return logits
+
+
+# -- task heads / losses ----------------------------------------------------
+
+def causal_lm_loss(logits, tokens, ignore_index: int = -1):
+    """Next-token cross-entropy; returns (loss, n_tokens). float32."""
+    targets = tokens[:, 1:]
+    lg = logits[:, :-1].astype(jnp.float32)
+    valid = targets != ignore_index
+    onehot = jax.nn.one_hot(targets, lg.shape[-1], dtype=jnp.float32)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    nll = -jnp.sum(onehot * logp, axis=-1)
+    nll = jnp.where(valid, nll, 0.0)
+    n = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll) / n, n
+
+
+def mlm_loss(logits, labels, mask_positions):
+    """BERT masked-LM loss: `labels` at `mask_positions` (bool [B,T])."""
+    lg = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(lg, axis=-1)
+    onehot = jax.nn.one_hot(labels, lg.shape[-1], dtype=jnp.float32)
+    nll = -jnp.sum(onehot * logp, axis=-1)
+    nll = jnp.where(mask_positions, nll, 0.0)
+    n = jnp.maximum(jnp.sum(mask_positions), 1)
+    return jnp.sum(nll) / n, n
+
+
+def GPT2(cfg: TransformerConfig = GPT2_SMALL, **kw) -> Transformer:
+    return Transformer(cfg, **kw)
+
+
+def Bert(cfg: TransformerConfig = BERT_LARGE, **kw) -> Transformer:
+    return Transformer(cfg, **kw)
+
+
+def Llama(cfg: TransformerConfig = LLAMA2_7B, **kw) -> Transformer:
+    return Transformer(cfg, **kw)

@@ -59,6 +59,12 @@ class ParameterManager:
     def record_bytes(self, n: int) -> None:
         self._bytes_in_sample += int(n)
 
+    def observe(self, nbytes: int) -> None:
+        """One executed training step moved `nbytes` over the wire
+        (io_callback target — see optim/distributed.py)."""
+        self.record_bytes(nbytes)
+        self.tick()
+
     def tick(self) -> None:
         if not self._active or self._pinned:
             return

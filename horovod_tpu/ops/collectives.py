@@ -121,6 +121,22 @@ def _set_local_index(ps: ProcessSet, axis: str):
     return jnp.asarray(table)[lax.axis_index(axis)]
 
 
+def _member_mask(ps: ProcessSet, axis: str):
+    """Traced bool: is the current device a member of the set?"""
+    world = _axis_size((axis,))
+    table = np.zeros((world,), dtype=bool)
+    for r in ps.ranks:
+        table[r] = True
+    return jnp.asarray(table)[lax.axis_index(axis)]
+
+
+def _check_subset_axes(groups, axes):
+    if groups is not None and len(axes) > 1:
+        raise HorovodInternalError(
+            "process sets require a single data-parallel axis"
+        )
+
+
 # ---------------------------------------------------------------------------
 # SPMD-form primitives (inside shard_map)
 # ---------------------------------------------------------------------------
@@ -128,10 +144,7 @@ def _set_local_index(ps: ProcessSet, axis: str):
 def _spmd_allreduce_leaf(x, op, axes, ps, prescale, postscale):
     world = _axis_size(axes)
     groups, nset = _set_groups(ps, world)
-    if groups is not None and len(axes) > 1:
-        raise HorovodInternalError(
-            "process sets require a single data-parallel axis"
-        )
+    _check_subset_axes(groups, axes)
     axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
     if prescale != 1.0:
         x = x * jnp.asarray(prescale, dtype=x.dtype)
@@ -141,7 +154,13 @@ def _spmd_allreduce_leaf(x, op, axes, ps, prescale, postscale):
         # before reaching this leaf.
         y = lax.psum(x, axis_arg, axis_index_groups=groups)
         if op == ReduceOp.AVERAGE:
-            y = (y / nset).astype(x.dtype)
+            if groups is None:
+                y = (y / nset).astype(x.dtype)
+            else:
+                # non-members (singleton groups) keep their input unchanged
+                # rather than dividing their own value by the set size
+                div = jnp.where(_member_mask(ps, axes[0]), nset, 1)
+                y = (y / div).astype(x.dtype)
     elif op == ReduceOp.MIN:
         y = lax.pmin(x, axis_arg, axis_index_groups=groups)
     elif op == ReduceOp.MAX:
@@ -180,6 +199,7 @@ def _spmd_allreduce_leaf(x, op, axes, ps, prescale, postscale):
 def _spmd_allgather_leaf(x, axes, ps):
     world = _axis_size(axes)
     groups, nset = _set_groups(ps, world)
+    _check_subset_axes(groups, axes)
     axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
     if groups is None:
         # NOTE: the result is replicated in value but jax's VMA checker
@@ -199,6 +219,7 @@ def _spmd_allgather_leaf(x, axes, ps):
 def _spmd_broadcast_leaf(x, root_rank, axes, ps):
     world = _axis_size(axes)
     groups, _ = _set_groups(ps, world)
+    _check_subset_axes(groups, axes)
     axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
     if len(axes) == 1:
         idx = lax.axis_index(axes[0])
@@ -208,12 +229,17 @@ def _spmd_broadcast_leaf(x, root_rank, axes, ps):
         for ax in axes[1:]:
             idx = idx * sizes[ax] + lax.axis_index(ax)
     mask = (idx == root_rank).astype(x.dtype)
-    return lax.psum(x * mask, axis_arg, axis_index_groups=groups)
+    y = lax.psum(x * mask, axis_arg, axis_index_groups=groups)
+    if groups is not None:
+        # non-members' singleton-group psum is zero; keep their input
+        y = jnp.where(_member_mask(ps, axes[0]), y, x)
+    return y
 
 
 def _spmd_reducescatter_leaf(x, op, axes, ps, prescale, postscale):
     world = _axis_size(axes)
     groups, nset = _set_groups(ps, world)
+    _check_subset_axes(groups, axes)
     axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
     if x.shape[0] % nset:
         raise HorovodInternalError(
@@ -241,6 +267,7 @@ def _spmd_reducescatter_leaf(x, op, axes, ps, prescale, postscale):
 def _spmd_alltoall_leaf(x, axes, ps):
     world = _axis_size(axes)
     groups, nset = _set_groups(ps, world)
+    _check_subset_axes(groups, axes)
     axis_arg = axes[0] if len(axes) == 1 else tuple(axes)
     if x.shape[0] % nset:
         raise HorovodInternalError(
@@ -633,13 +660,15 @@ def alltoall(
                 "parallel.ulysses.padded_alltoall (static max chunk); "
                 "equal-split alltoall lowers to one HLO"
             )
-        # eager single-controller: every rank sends `splits` → receives the
-        # per-source chunk sizes = splits[rank] each... identical tensors ⇒
-        # received_splits[j] = splits[my_index] for each source j. At the
-        # controller (rank 0 view): received chunks are each rank's chunk 0.
-        received_splits = jnp.full((_group_size(ps, axis_name),), splits[0])
-        out = jnp.asarray(tensor)[: int(splits[0]) * _group_size(ps, axis_name)]
-        return out, received_splits
+        # eager single-controller: all ranks hold identical tensors, so the
+        # rank-0 view receives each peer's chunk-0 = tensor[:splits[0]],
+        # i.e. that chunk tiled n times (consistent with the equal-split
+        # eager path, which produces the same via the real all_to_all).
+        n = _group_size(ps, axis_name)
+        chunk0 = jnp.asarray(tensor)[: int(splits[0])]
+        reps = (n,) + (1,) * (chunk0.ndim - 1)
+        received_splits = jnp.full((n,), splits[0])
+        return jnp.tile(chunk0, reps), received_splits
 
     def spmd(x, live):
         return _spmd_alltoall_leaf(x, live, ps)

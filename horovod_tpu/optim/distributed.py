@@ -26,6 +26,7 @@ supports Average/Sum/Adasum and process sets.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, NamedTuple, Optional
 
 import jax
@@ -77,9 +78,15 @@ def _reduce_grad_tree(
         reduced.append(compression.decompress(red, ctx))
     pm = global_state().parameter_manager
     if pm is not None:
-        for b in buckets:
-            pm.record_bytes(b.size * b.dtype.itemsize)
-        pm.tick()
+        # io_callback fires at *execution* time, once per real step, so the
+        # tuner observes actual throughput even inside a jitted train step
+        # (a bare call here would only run once, at trace time). Note: an
+        # already-compiled step keeps its bucket structure; the tuned
+        # threshold applies to eager ops and subsequent compilations.
+        total = sum(int(b.size) * b.dtype.itemsize for b in buckets)
+        from jax.experimental import io_callback
+
+        io_callback(functools.partial(pm.observe, total), None)
     return unflatten(reduced)
 
 

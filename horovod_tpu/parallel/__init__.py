@@ -1,0 +1,16 @@
+from .mesh import AXIS_ORDER, data_axes, make_mesh  # noqa: F401
+from .ring_attention import make_ring_attention_fn, ring_attention  # noqa: F401
+from .sharding import (  # noqa: F401
+    RESNET_RULES,
+    TRANSFORMER_RULES,
+    batch_sharding,
+    make_param_shardings,
+    shard_params,
+    spec_for_path,
+)
+from .train import make_lm_train_step, sp_attention_fn  # noqa: F401
+from .ulysses import (  # noqa: F401
+    make_ulysses_attention_fn,
+    padded_alltoall,
+    ulysses_attention,
+)

@@ -1,0 +1,114 @@
+"""Ring attention: sequence-parallel exact attention over the ICI ring.
+
+The reference has NO sequence parallelism (SURVEY.md §5.7) — its building
+blocks (alltoall, process sets) leave long-context scaling to the user.
+Here it is first-class: each sp-rank holds a sequence shard
+[B, T/n, H, D]; key/value blocks rotate around the ring via
+`lax.ppermute` (one ICI neighbor hop per step, bandwidth-optimal) while a
+flash-style online softmax accumulates exact attention (Liu et al., Ring
+Attention; blockwise softmax per Rabe & Staats / FlashAttention).
+
+Causal scheduling: block (i queries, j keys) contributes only when
+j <= i; the contribution mask is computed from global positions, so
+rotations still run a full ring (static schedule, XLA-friendly) and
+masked blocks cost only the (fused, cheap) elementwise work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import basics
+from ..core.exceptions import HorovodInternalError
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q, k, v, *, axis_name: str = "sp", causal: bool = True,
+    query_offset=None,
+):
+    """Exact attention over sequence shards rotating kv on the ring.
+
+    Args:
+      q, k, v: [B, T_local, H, D] (kv heads may be fewer — GQA repeat is
+        applied locally).
+      axis_name: the bound sequence-parallel mesh axis.
+      causal: apply causal masking using *global* positions.
+      query_offset: [B] or scalar global position of this shard's first
+        query token; default = sp_rank * T_local (contiguous layout).
+
+    Returns [B, T_local, H, D].
+    """
+    sizes = basics.bound_axis_sizes()
+    if axis_name not in sizes:
+        raise HorovodInternalError(
+            f"ring_attention requires axis {axis_name!r} bound in shard_map"
+        )
+    n = sizes[axis_name]
+    idx = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    KH = k.shape[2]
+    if KH != H:
+        rep = H // KH
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    if query_offset is None:
+        q_start = idx * T
+    else:
+        q_start = query_offset
+    q_pos = q_start + jnp.arange(T)  # [T] global query positions
+
+    scale = 1.0 / np.sqrt(D)
+    qf = (q.astype(jnp.float32) * scale)
+
+    perm = [(r, (r + 1) % n) for r in range(n)]
+
+    def step(s, carry):
+        o, m, l, k_cur, v_cur = carry
+        # k_cur originated at rank (idx - s) mod n
+        src = (idx - s) % n
+        k_pos = src * T + jnp.arange(T)  # [T] global key positions
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32)
+        )
+        if causal:
+            cm = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            logits = jnp.where(cm[None, None], logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))  # [B,H,Tq]
+        # guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1
+        m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(jnp.where(m <= NEG_INF / 2, NEG_INF, m) - m_safe)
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, corr)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o_new, m_new, l_new, k_nxt, v_nxt
+
+    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def make_ring_attention_fn(axis_name: str = "sp", causal: bool = True):
+    """attention_fn factory for models.Transformer(attention_fn=...)."""
+
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return fn

@@ -1,0 +1,126 @@
+"""Parameter/batch sharding rules: path-pattern → PartitionSpec.
+
+The jit-path replacement for the reference's runtime negotiation: under
+pjit the "which collective, when" question is answered at compile time by
+these shardings (SURVEY.md §2.6 TPU equivalent). Rules map parameter path
+substrings to PartitionSpecs over the mesh axes (parallel/mesh.py).
+
+Default transformer rules implement Megatron-style TP + ZeRO-3-style FSDP:
+  qkv kernels   [embed, heads, head_dim] → (fsdp, tp, None)
+  out kernel    [heads, head_dim, embed] → (tp, None, fsdp)
+  mlp in        [embed, mlp]             → (fsdp, tp)
+  mlp out       [mlp, embed]             → (tp, fsdp)
+  embeddings    [vocab, embed]           → (tp, fsdp)
+  norms/bias    replicated
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rule = Tuple[str, P]
+
+TRANSFORMER_RULES: List[Rule] = [
+    (r"(query|key|value)/kernel$", P("fsdp", "tp", None)),
+    (r"attn/out/kernel$", P("tp", None, "fsdp")),
+    (r"(fc1|gate|up)/kernel$", P("fsdp", "tp")),
+    (r"fc2/kernel$", P("tp", "fsdp")),
+    (r"tok_emb/embedding$", P("tp", "fsdp")),
+    (r"lm_head/kernel$", P("fsdp", "tp")),
+    (r"pos_emb$", P(None, "fsdp")),
+    (r".*", P()),  # everything else (norms, biases) replicated
+]
+
+RESNET_RULES: List[Rule] = [
+    # conv kernels [kh, kw, cin, cout]: shard output channels over tp
+    (r"conv[^/]*/kernel$", P(None, None, None, "tp")),
+    (r"Dense_\d+/kernel$", P("fsdp", "tp")),
+    (r".*", P()),
+]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _prune_spec(spec: P, mesh: Mesh, shape) -> P:
+    """Drop axes absent from the mesh or of size 1, and axes that don't
+    divide the dimension (falls back to replication for that dim)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(
+            n for n in names
+            if sizes.get(n, 1) > 1
+        )
+        prod = int(np.prod([sizes[n] for n in kept])) if kept else 1
+        if not kept or (dim < len(shape) and shape[dim] % prod):
+            out.append(None)
+        else:
+            out.append(kept if len(kept) > 1 else kept[0])
+    return P(*out)
+
+
+def spec_for_path(path: str, rules: Sequence[Rule]) -> P:
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return P()
+
+
+def make_param_shardings(params, mesh: Mesh,
+                         rules: Sequence[Rule] = None):
+    """Pytree of NamedSharding matching `params`, per the rules."""
+    rules = TRANSFORMER_RULES if rules is None else rules
+
+    def leaf(path, x):
+        spec = spec_for_path(_path_str(path), rules)
+        spec = _prune_spec(spec, mesh, np.shape(x))
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def shard_params(params, mesh: Mesh, rules: Sequence[Rule] = None):
+    """Place `params` onto the mesh per the rules (device_put)."""
+    sh = make_param_shardings(params, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, params, sh)
+
+
+def batch_sharding(mesh: Mesh, *, seq_axis: Optional[int] = None):
+    """Batch spec: batch dim over (dp, fsdp); optionally the sequence dim
+    over sp (sequence parallelism)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    batch_axes = tuple(
+        a for a in ("dp", "fsdp") if sizes.get(a, 1) > 1
+    ) or ("dp",)
+    entries: List = [batch_axes if len(batch_axes) > 1 else batch_axes[0]]
+    if seq_axis is not None:
+        while len(entries) < seq_axis:
+            entries.append(None)
+        entries.append("sp" if sizes.get("sp", 1) > 1 else None)
+    return NamedSharding(mesh, P(*entries))
+
+
+def logical_rules_to_shardings(*args, **kw):  # pragma: no cover
+    raise NotImplementedError(
+        "flax logical-axis metadata is intentionally unused; see "
+        "TRANSFORMER_RULES path-pattern rules instead"
+    )

@@ -1,0 +1,138 @@
+"""Assembled distributed training steps (pjit auto-partitioning + manual
+sequence-parallel attention).
+
+This is the jit-mode answer to the reference's runtime pipeline
+(SURVEY.md §3.2): where Horovod negotiates readiness and fuses tensors in
+a background thread per step, the TPU path compiles the *entire* training
+step once — shardings from parallel/sharding.py tell XLA's SPMD
+partitioner where tensors live, and it inserts/fuses the collectives
+(gradient psums ride the dp/fsdp axes; tp collectives stay inside layers;
+sp attention is manual ring/Ulysses via nested shard_map).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import Transformer, TransformerConfig, causal_lm_loss
+from . import sharding as sharding_lib
+from .mesh import data_axes, make_mesh
+from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
+
+
+def sp_attention_fn(mesh: Mesh, kind: str = "ring", causal: bool = True):
+    """Attention fn running manually over the 'sp' axis, nested inside an
+    otherwise auto-partitioned jit (shard_map axis_names={'sp'})."""
+
+    def inner(q, k, v):
+        if kind == "ring":
+            return ring_attention(q, k, v, axis_name="sp", causal=causal)
+        return ulysses_attention(q, k, v, axis_name="sp", causal=causal)
+
+    spec = P(None, "sp", None, None)
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names=frozenset({"sp"}),
+        check_vma=False,
+    )
+
+
+def make_lm_train_step(
+    cfg: TransformerConfig,
+    optimizer,
+    mesh: Mesh,
+    rules: Optional[Sequence] = None,
+    sequence_parallel: Optional[str] = None,  # None | "ring" | "ulysses"
+    donate: bool = True,
+):
+    """Build (init_fn, step_fn, batch_sharding) for causal-LM training.
+
+    step_fn(params, opt_state, tokens) -> (params, opt_state, loss) is
+    jitted with parameter shardings from the rules; tokens are sharded
+    [batch over dp/fsdp, seq over sp].
+    """
+    rules = sharding_lib.TRANSFORMER_RULES if rules is None else rules
+    attention_fn = (
+        sp_attention_fn(mesh, sequence_parallel, cfg.causal)
+        if sequence_parallel
+        else None
+    )
+    model = Transformer(cfg, attention_fn=attention_fn)
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    batch_axes = data_axes(mesh)
+    batch_spec_entries: list = [batch_axes if batch_axes else None]
+    if sizes.get("sp", 1) > 1:
+        batch_spec_entries.append("sp")
+    batch_spec = P(*batch_spec_entries)
+    batch_sharding = NamedSharding(mesh, batch_spec)
+
+    def init_fn(rng, sample_tokens):
+        # Shape-infer first, then jit-init directly into the target
+        # shardings: parameters materialize sharded, never resident on one
+        # device (required for >HBM models like Llama-7B).
+        abs_params = jax.eval_shape(
+            lambda r, s: model.init(r, s)["params"], rng, sample_tokens
+        )
+        shardings = sharding_lib.make_param_shardings(abs_params, mesh, rules)
+        abs_opt = jax.eval_shape(optimizer.init, abs_params)
+        opt_shardings = _opt_state_shardings(
+            abs_opt, abs_params, shardings, mesh
+        )
+
+        @functools.partial(
+            jax.jit, out_shardings=(shardings, opt_shardings)
+        )
+        def _init(r, s):
+            params = model.init(r, s)["params"]
+            return params, optimizer.init(params)
+
+        return _init(rng, sample_tokens)
+
+    def loss_fn(params, tokens):
+        B, T = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        logits = model.apply({"params": params}, tokens, positions)
+        loss, _ = causal_lm_loss(logits, tokens)
+        return loss
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    step_fn = jax.jit(step, donate_argnums=donate_argnums)
+    return init_fn, step_fn, batch_sharding
+
+
+def _opt_state_shardings(opt_state, params, param_shardings, mesh):
+    """Match optimizer-state leaves that mirror params (momentum etc.) to
+    the param shardings; everything else replicated."""
+    # shape-based matching: leaves with a param's shape get its sharding
+    shape_map = {}
+    for l, s in zip(
+        jax.tree_util.tree_leaves(params),
+        jax.tree_util.tree_leaves(param_shardings),
+    ):
+        shape_map.setdefault(np.shape(l), s)
+    rep = NamedSharding(mesh, P())
+
+    def leaf(x):
+        return shape_map.get(np.shape(x), rep)
+
+    return jax.tree_util.tree_map(leaf, opt_state)

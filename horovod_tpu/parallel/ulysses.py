@@ -1,0 +1,104 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism via all-to-all.
+
+The head↔sequence exchange: shards hold [B, T/n, H, D]; one all-to-all
+re-partitions to [B, T, H/n, D] (full sequence, subset of heads), local
+exact attention runs per head group, and the inverse all-to-all restores
+sequence sharding. Two all-to-alls per attention vs ring's n ppermutes:
+better for moderate T with fast ICI all-to-all; ring wins at very long T
+(memory) — both provided, selected per config.
+
+The reference's `alltoall` with uneven splits (operations.cc:1858) is its
+closest primitive (SURVEY.md §5.7 names it the Ulysses building block);
+`padded_alltoall` below is the SPMD form of the uneven-splits capability.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import basics
+from ..core.exceptions import HorovodInternalError
+from ..models.transformer import dot_product_attention
+
+
+def ulysses_attention(
+    q, k, v, *, axis_name: str = "sp", causal: bool = True
+):
+    """[B, T/n, H, D] shards -> exact attention -> [B, T/n, H, D]."""
+    sizes = basics.bound_axis_sizes()
+    if axis_name not in sizes:
+        raise HorovodInternalError(
+            f"ulysses_attention requires axis {axis_name!r} bound"
+        )
+    n = sizes[axis_name]
+    H = q.shape[2]
+    if H % n:
+        raise HorovodInternalError(
+            f"ulysses requires heads ({H}) divisible by sp size ({n})"
+        )
+    kh = k.shape[2]
+    if kh % n:
+        # GQA head count not divisible by the sp axis: expand kv to the
+        # full query head count (H % n == 0 was checked above), the only
+        # repeat factor guaranteed to divide evenly.
+        k = jnp.repeat(k, H // kh, axis=2)
+        v = jnp.repeat(v, H // kh, axis=2)
+
+    def seq2head(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    out = dot_product_attention(qg, kg, vg, causal=causal)
+    return head2seq(out)
+
+
+def make_ulysses_attention_fn(axis_name: str = "sp", causal: bool = True):
+    def fn(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return fn
+
+
+def padded_alltoall(x, splits, max_split: int, *, axis_name: str):
+    """Uneven all-to-all inside SPMD via a static per-peer budget.
+
+    The SPMD spelling of the reference's uneven-splits alltoall
+    (operations.cc:1858): `splits[j]` rows go to peer j, padded to the
+    static `max_split`; returns (received [n*max_split, ...],
+    received_splits [n]) — rows beyond received_splits[j] within peer j's
+    block are padding.
+    """
+    sizes = basics.bound_axis_sizes()
+    n = sizes[axis_name]
+    splits = jnp.asarray(splits, dtype=jnp.int32)
+
+    # pack: gather rows for peer j into slot j of a [n, max_split, ...] buf
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(splits)[:-1]]
+    )
+    rest_shape = x.shape[1:]
+    buf = jnp.zeros((n, max_split) + rest_shape, x.dtype)
+    row_ids = offsets[:, None] + jnp.arange(max_split)[None, :]  # [n, max]
+    valid = jnp.arange(max_split)[None, :] < splits[:, None]
+    safe_ids = jnp.clip(row_ids, 0, x.shape[0] - 1)
+    gathered = x[safe_ids.reshape(-1)].reshape((n, max_split) + rest_shape)
+    buf = jnp.where(
+        valid.reshape((n, max_split) + (1,) * len(rest_shape)), gathered, 0
+    )
+
+    exchanged = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                               tiled=True)
+    received_splits = lax.all_to_all(
+        splits.reshape(-1, 1), axis_name, split_axis=0, concat_axis=0,
+        tiled=True,
+    ).reshape(-1)
+    return exchanged.reshape((n * max_split,) + rest_shape), received_splits

@@ -317,3 +317,13 @@ def test_eager_broadcast(hvd8):
     x = jnp.arange(5.0)
     out = hvd.broadcast(x, root_rank=3)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_eager_alltoall_uneven_splits(hvd8):
+    """Review fix: identical-tensor semantics mean the received data is
+    each peer's chunk-0 tiled, not a prefix slice."""
+    x = jnp.arange(16.0).reshape(16, 1)
+    out, received = hvd.alltoall(x, splits=[2] + [2] * 7)
+    np.testing.assert_array_equal(np.asarray(received), np.full(8, 2))
+    expect = np.tile(np.arange(2.0).reshape(2, 1), (8, 1))
+    np.testing.assert_array_equal(np.asarray(out), expect)

@@ -1,0 +1,144 @@
+"""Model family shape/numerics smoke tests + distributed training step."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import (
+    GPT2,
+    Bert,
+    Llama,
+    MnistNet,
+    ResNet50,
+    Transformer,
+    TransformerConfig,
+    causal_lm_loss,
+    mlm_loss,
+)
+
+TINY_GPT = TransformerConfig(
+    vocab_size=128, num_layers=2, num_heads=4, hidden_size=64,
+    max_seq_len=32, dtype=jnp.float32,
+)
+TINY_LLAMA = dataclasses.replace(
+    TINY_GPT, norm="rmsnorm", position="rope", activation="swiglu",
+    tie_embeddings=False, num_kv_heads=2,
+)
+TINY_BERT = dataclasses.replace(TINY_GPT, causal=False)
+
+
+def test_mnist_net_shapes():
+    m = MnistNet()
+    x = jnp.zeros((4, 28, 28, 1))
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    assert out.shape == (4, 10)
+
+
+def test_resnet50_shapes():
+    m = ResNet50(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 64, 64, 3))
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    out = m.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert "batch_stats" in variables
+
+
+def test_gpt2_forward_and_loss():
+    m = Transformer(TINY_GPT)
+    toks = jnp.ones((2, 16), dtype=jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks)
+    logits = m.apply(params, toks)
+    assert logits.shape == (2, 16, 128)
+    loss, n = causal_lm_loss(logits, toks)
+    assert np.isfinite(float(loss))
+
+
+def test_llama_forward():
+    m = Transformer(TINY_LLAMA)
+    toks = jnp.ones((2, 16), dtype=jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks)
+    logits = m.apply(params, toks)
+    assert logits.shape == (2, 16, 128)
+    # GQA params: kv heads = 2
+    k_kernel = params["params"]["block_0"]["attn"]["key"]["kernel"]
+    assert k_kernel.shape == (64, 2, 16)
+
+
+def test_bert_mlm():
+    m = Transformer(TINY_BERT)
+    toks = jnp.ones((2, 16), dtype=jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks)
+    logits = m.apply(params, toks)
+    mask = jnp.zeros((2, 16), dtype=bool).at[:, 3].set(True)
+    loss, n = mlm_loss(logits, toks, mask)
+    assert np.isfinite(float(loss))
+    assert int(n) == 2
+
+
+def test_causality():
+    """Future tokens must not influence past logits in causal mode."""
+    m = Transformer(TINY_GPT)
+    t1 = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], dtype=jnp.int32)
+    t2 = t1.at[0, -1].set(99)
+    params = m.init(jax.random.PRNGKey(0), t1)
+    l1 = m.apply(params, t1)
+    l2 = m.apply(params, t2)
+    np.testing.assert_allclose(
+        np.asarray(l1[0, :-1]), np.asarray(l2[0, :-1]), rtol=1e-5
+    )
+
+
+def test_remat_matches_no_remat():
+    cfg_r = dataclasses.replace(TINY_GPT, remat=True)
+    toks = jnp.ones((2, 8), dtype=jnp.int32)
+    m1, m2 = Transformer(TINY_GPT), Transformer(cfg_r)
+    params = m1.init(jax.random.PRNGKey(0), toks)
+    np.testing.assert_allclose(
+        np.asarray(m1.apply(params, toks)),
+        np.asarray(m2.apply(params, toks)),
+        rtol=1e-5,
+    )
+
+
+def test_distributed_gpt2_train_step(hvd8):
+    """End-to-end: tiny GPT-2 DP training step across the 8-device mesh
+    with DistributedOptimizer — loss decreases."""
+    m = Transformer(TINY_GPT)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 128, size=(16, 16)), dtype=jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks[:2])
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+    opt_state = opt.init(params)
+
+    def step(p, s, batch):
+        def loss_fn(p):
+            logits = m.apply(p, batch)
+            loss, _ = causal_lm_loss(logits, batch)
+            return loss
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        upd, s = opt.update(g, s, p)
+        p = optax.apply_updates(p, upd)
+        return p, s, hvd.allreduce(loss)
+
+    jstep = jax.jit(
+        shard_map(
+            step, mesh=hvd.mesh(),
+            in_specs=(P(), P(), P("hvd")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = jstep(params, opt_state, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]

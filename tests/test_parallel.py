@@ -1,0 +1,224 @@
+"""Sequence parallelism + sharding rules tests.
+
+Ring attention and Ulysses must reproduce dense attention exactly
+(same math, different schedule) — the long-context capability the
+reference lacks (SURVEY.md §5.7).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models.transformer import dot_product_attention
+from horovod_tpu.parallel import (
+    make_lm_train_step,
+    make_mesh,
+    make_param_shardings,
+    padded_alltoall,
+    ring_attention,
+    ulysses_attention,
+)
+from horovod_tpu.models import TransformerConfig
+
+
+def _qkv(B=2, T=32, H=4, D=8, seed=0, kv_heads=None):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, kv_heads or H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, kv_heads or H, D).astype(np.float32))
+    return q, k, v
+
+
+def _sp_mesh():
+    import jax
+
+    devs = np.asarray(jax.devices())
+    return Mesh(devs.reshape(8), ("sp",))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(hvd8, causal):
+    q, k, v = _qkv()
+    mesh = _sp_mesh()
+    spec = P(None, "sp", None, None)
+    out = jax.jit(
+        shard_map(
+            lambda a, b, c: ring_attention(a, b, c, causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    )(q, k, v)
+    expect = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expect), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_ring_attention_gqa(hvd8):
+    q, k, v = _qkv(kv_heads=2)
+    mesh = _sp_mesh()
+    spec = P(None, "sp", None, None)
+    out = jax.jit(
+        shard_map(
+            lambda a, b, c: ring_attention(a, b, c, causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    )(q, k, v)
+    expect = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expect), rtol=2e-4, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(hvd8, causal):
+    q, k, v = _qkv(H=8)  # heads divisible by sp=8
+    mesh = _sp_mesh()
+    spec = P(None, "sp", None, None)
+    out = jax.jit(
+        shard_map(
+            lambda a, b, c: ulysses_attention(a, b, c, causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    )(q, k, v)
+    expect = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expect), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_padded_alltoall(hvd8):
+    mesh = _sp_mesh()
+    # every rank sends j rows to peer j (row value = 100*src + dst)
+    splits = jnp.arange(8, dtype=jnp.int32)  # rank-independent splits
+
+    def body(x):
+        out, rsplits = padded_alltoall(x[0], splits, max_split=8,
+                                       axis_name="sp")
+        return out[None], rsplits[None]
+
+    total = int(np.sum(np.arange(8)))
+    x = np.zeros((8, total, 1), np.float32)
+    for src in range(8):
+        off = 0
+        for dst in range(8):
+            x[src, off : off + dst] = 100 * src + dst
+            off += dst
+    out, rsplits = jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=P("sp"),
+            out_specs=(P("sp"), P("sp")), check_vma=False,
+        )
+    )(jnp.asarray(x))
+    out = np.asarray(out).reshape(8, 8, 8)  # [dst, src, max_split]
+    rsplits = np.asarray(rsplits).reshape(8, 8)
+    for dst in range(8):
+        # every peer sent `dst` rows to dst
+        np.testing.assert_array_equal(rsplits[dst], np.full(8, dst))
+        for src in range(8):
+            valid = out[dst, src, :dst]
+            np.testing.assert_array_equal(
+                valid, np.full((dst, 1), 100 * src + dst).reshape(-1)
+                if dst else valid
+            )
+
+
+def test_make_param_shardings_tp_rules(hvd8):
+    mesh = make_mesh(dp=2, tp=4)
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=1, num_heads=4, hidden_size=32,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    from horovod_tpu.models import Transformer
+
+    m = Transformer(cfg)
+    toks = jnp.ones((2, 8), dtype=jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks)["params"]
+    sh = make_param_shardings(params, mesh)
+    q_spec = sh["block_0"]["attn"]["query"]["kernel"].spec
+    assert "tp" in str(q_spec)
+    ln_spec = sh["ln_final"]["scale"].spec
+    assert ln_spec == P()
+
+
+def test_full_dp_tp_train_step(hvd8):
+    """End-to-end pjit train step on a dp=2 × tp=4 mesh."""
+    mesh = make_mesh(dp=2, tp=4)
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=2, num_heads=4, hidden_size=32,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    opt = optax.adam(1e-3)
+    init_fn, step_fn, batch_sh = make_lm_train_step(cfg, opt, mesh)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (8, 16)), dtype=jnp.int32
+    )
+    toks = jax.device_put(toks, batch_sh)
+    params, opt_state = init_fn(jax.random.PRNGKey(0), toks[:2])
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step_fn(params, opt_state, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    # param sharding actually applied: query kernel is sharded over tp
+    q = params["block_0"]["attn"]["query"]["kernel"]
+    assert "tp" in str(q.sharding.spec)
+
+
+def test_full_dp_sp_ring_train_step(hvd8):
+    """dp=2 × sp=4 with manual ring attention nested in the jit step."""
+    mesh = make_mesh(dp=2, sp=4)
+    cfg = TransformerConfig(
+        vocab_size=64, num_layers=1, num_heads=4, hidden_size=32,
+        max_seq_len=32, dtype=jnp.float32,
+    )
+    opt = optax.adam(1e-3)
+    init_fn, step_fn, batch_sh = make_lm_train_step(
+        cfg, opt, mesh, sequence_parallel="ring"
+    )
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (4, 32)), dtype=jnp.int32
+    )
+    toks = jax.device_put(toks, batch_sh)
+    params, opt_state = init_fn(jax.random.PRNGKey(0), toks[:2])
+    losses = []
+    for _ in range(4):
+        params, opt_state, loss = step_fn(params, opt_state, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_ulysses_gqa_indivisible_kv_heads(hvd8):
+    """Review fix: kh=4 with sp=8 must expand kv to full head count."""
+    q, k, v = _qkv(H=8, kv_heads=4)
+    mesh = _sp_mesh()
+    spec = P(None, "sp", None, None)
+    out = jax.jit(
+        shard_map(
+            lambda a, b, c: ulysses_attention(a, b, c, causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    )(q, k, v)
+    expect = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(expect), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_data_axes_helper(hvd8):
+    from horovod_tpu.parallel import data_axes
+
+    assert data_axes(make_mesh(dp=8)) == ("dp",)
+    assert data_axes(make_mesh(dp=2, tp=4)) == ("dp",)
+    assert data_axes(make_mesh(dp=2, fsdp=2, tp=2)) == ("dp", "fsdp")
+    assert data_axes(make_mesh(dp=1, tp=8)) == ()

@@ -161,3 +161,27 @@ def test_sub_mesh(hvd8):
     assert sub.devices.shape == (4,)
     assert sub.axis_names == ("hvd",)
 
+
+
+def test_broadcast_subset_preserves_nonmembers(hvd8):
+    """Non-members keep their input (review fix: singleton-group psum used
+    to zero them)."""
+    ps = hvd.add_process_set([1, 2, 6])
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = run_spmd(
+        lambda t: hvd.broadcast(t, root_rank=2, process_set=ps), x
+    )
+    got = np.asarray(out).reshape(8)
+    expect = np.array([0.0, 2.0, 2.0, 3.0, 4.0, 5.0, 2.0, 7.0])
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_average_subset_preserves_nonmembers(hvd8):
+    ps = hvd.add_process_set([0, 4])
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = run_spmd(
+        lambda t: hvd.allreduce(t, op=hvd.Average, process_set=ps), x
+    )
+    got = np.asarray(out).reshape(8)
+    expect = np.array([2.0, 1.0, 2.0, 3.0, 2.0, 5.0, 6.0, 7.0])
+    np.testing.assert_array_equal(got, expect)
