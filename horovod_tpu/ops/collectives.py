@@ -377,7 +377,16 @@ def _eager_perrank(op_kind: str, stacked, op=ReduceOp.SUM, prescale=1.0,
         op_kind, ndev, int(op), float(prescale), float(postscale),
         int(root_rank), st.epoch,
     )
-    return prog(stacked)
+    out = prog(stacked)
+    if jax.default_backend() == "cpu":
+        # On the virtual CPU mesh two concurrently-executing multi-partition
+        # programs can starve each other's collective rendezvous when the
+        # host has fewer cores than devices (XLA InProcessCommunicator needs
+        # all partitions running at once). Blocking eager results before
+        # returning serializes eager collectives against subsequent jit
+        # dispatches. TPU streams don't have this hazard; no cost there.
+        jax.block_until_ready(out)
+    return out
 
 
 def _is_perrank(x, nset: int) -> bool:

@@ -1,0 +1,22 @@
+"""Launcher layer (L6): `hvdrun` CLI and the in-process `run()` API.
+
+Reference surface: /root/reference/horovod/runner/ — `horovodrun`
+(launch.py:286,583,676), `horovod.run()` (runner/__init__.py:94), gloo_run
+slot spawning (gloo_run.py:242), HTTP rendezvous (http/http_server.py:192),
+elastic driver (elastic/driver.py:69).
+
+TPU-native shape: a slot is a *host process* (one JAX controller driving
+all local chips), not a per-accelerator process. The launcher assigns
+SlotInfo (rank/local_rank/cross_rank) for API parity, starts a rendezvous /
+KV server for bootstrap, and points every worker at the JAX coordination
+service (jax.distributed) instead of MPI/Gloo.
+"""
+
+from .launch import parse_args, run_commandline  # noqa: F401
+from .api import run  # noqa: F401
+from .util.hosts import (  # noqa: F401
+    HostInfo,
+    SlotInfo,
+    get_host_assignments,
+    parse_hosts,
+)

@@ -1,0 +1,16 @@
+"""Elastic launcher: dynamic world size with fault tolerance.
+
+Reference: /root/reference/horovod/runner/elastic/ — ElasticDriver
+(driver.py:69), host discovery + blacklist (discovery.py), worker state
+registry (registration.py), worker notification protocol (worker.py).
+The worker-side state commit/restore/sync lives in horovod_tpu/elastic/.
+"""
+
+from .discovery import (  # noqa: F401
+    FixedHosts,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+)
+from .driver import ElasticDriver  # noqa: F401
+from .settings import ElasticSettings  # noqa: F401

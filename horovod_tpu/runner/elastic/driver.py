@@ -1,0 +1,254 @@
+"""Elastic driver: discovery loop, rank-stable reassignment, fault rounds.
+
+Reference: /root/reference/horovod/runner/elastic/driver.py:69
+(`ElasticDriver`) — a discovery thread polls
+`HostManager.update_available_hosts` every second (:102); host-set changes
+push notifications to workers (:210); `_update_host_assignments` (:240)
+recomputes SlotInfo preserving surviving ranks; `WorkerStateRegistry`
+barriers trigger `resume()`; failing hosts are blacklisted; `reset_limit`
+bounds total resets.
+
+TPU adaptation: a *reset* respawns worker processes on the new host set
+(the JAX runtime re-initializes its coordination service + device mesh at
+startup; in-process slice resize is not supported by XLA). Worker-side
+state continuity across resets is the elastic State's job: commit()
+snapshots survive in the coordinator's memory or on disk
+(horovod_tpu/elastic/state.py), and on respawn `state.sync()` restores
+from rank 0. Between resets, in-flight workers are notified of host
+changes through WorkerNotificationClient so they can commit early.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..exec_run import launch_slots
+from ..http.http_server import RendezvousServer
+from ..util.hosts import SlotInfo, get_host_assignments
+from ..util.network import get_local_host_addresses
+from ..util.secret import ENV_SECRET, make_secret_key
+from .discovery import NO_UPDATE, HostManager
+from .registration import FAILURE, SUCCESS, WorkerStateRegistry
+from .settings import ElasticSettings
+from .worker import get_worker_client
+
+LOG = logging.getLogger("horovod_tpu.elastic")
+
+
+class ElasticDriver:
+    def __init__(
+        self,
+        host_manager: HostManager,
+        settings: ElasticSettings,
+        command: List[str],
+        env: Dict[str, str],
+        exec_fn: Optional[Callable] = None,
+    ):
+        self._host_manager = host_manager
+        self._settings = settings
+        self._command = list(command)
+        self._env = dict(env)
+        if ENV_SECRET not in self._env:
+            self._env[ENV_SECRET] = make_secret_key().decode()
+        self._exec_fn = exec_fn
+
+        self._registry = WorkerStateRegistry(self._on_barrier)
+        self._rendezvous = RendezvousServer()
+        self._rank_assignments: Dict[str, List[int]] = {}
+        self._assignments: List[SlotInfo] = []
+
+        self._shutdown = threading.Event()
+        self._barrier_states: Optional[Dict[str, str]] = None
+        self._barrier_event = threading.Event()
+        self._worker_failure = threading.Event()
+        self._notify_timestamp = 0
+        self._discovery_thread: Optional[threading.Thread] = None
+        self._resets = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Begin host discovery (reference driver.py:102)."""
+        self._host_manager.update_available_hosts()
+        self._discovery_thread = threading.Thread(
+            target=self._discovery_loop, daemon=True, name="elastic-discovery"
+        )
+        self._discovery_thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        if self._discovery_thread is not None:
+            self._discovery_thread.join(timeout=5)
+        self._rendezvous.shutdown_server()
+
+    def wait_for_available_slots(
+        self, min_np: int, timeout_s: Optional[float] = None
+    ) -> int:
+        """Block until discovery reports >= min_np slots
+        (reference driver.py:153)."""
+        timeout_s = (
+            timeout_s if timeout_s is not None else self._settings.timeout_s
+        )
+        deadline = time.time() + timeout_s
+        while time.time() < deadline and not self._shutdown.is_set():
+            n = self._host_manager.current_hosts.count_available_slots()
+            if n >= min_np:
+                return n
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"timed out waiting for {min_np} slots "
+            f"(have {self._host_manager.current_hosts.count_available_slots()})"
+        )
+
+    # ------------------------------------------------------------- main loop
+
+    def run(self) -> int:
+        """Run elastic rounds until global success or unrecoverable failure."""
+        self.start()
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    self.wait_for_available_slots(self._settings.min_np)
+                except TimeoutError as e:
+                    LOG.error("elastic job cannot continue: %s", e)
+                    return 1
+                states = self._run_round()
+                if states is None:
+                    continue  # round aborted (host change mid-spawn)
+                if all(s == SUCCESS for s in states.values()):
+                    return 0
+                self._resets += 1
+                if (
+                    self._settings.reset_limit
+                    and self._resets >= self._settings.reset_limit
+                ):
+                    LOG.error(
+                        "elastic reset limit %d reached",
+                        self._settings.reset_limit,
+                    )
+                    return 1
+            return 1
+        finally:
+            self.stop()
+
+    def _run_round(self) -> Optional[Dict[str, str]]:
+        assignments = self._update_host_assignments()
+        self._assignments = assignments
+        self._registry.reset(len(assignments))
+        self._barrier_event.clear()
+        self._worker_failure.clear()
+        self._rendezvous.init(assignments)
+
+        spawn_done = threading.Event()
+
+        def spawn():
+            try:
+                launch_slots(
+                    self._command,
+                    assignments,
+                    self._env,
+                    rendezvous=self._rendezvous,
+                    exec_fn=self._wrap_exec(),
+                )
+            finally:
+                spawn_done.set()
+
+        threading.Thread(target=spawn, daemon=True).start()
+        self._barrier_event.wait()
+        spawn_done.wait(timeout=30)
+        states = self._barrier_states
+        if states:
+            for key, state in states.items():
+                if state == FAILURE:
+                    host = key.rsplit(":", 1)[0]
+                    self._host_manager.blacklist(host)
+                    LOG.warning("blacklisting failed host %s", host)
+            self._host_manager.update_available_hosts()
+        return states
+
+    def _wrap_exec(self) -> Callable:
+        """Exec wrapper recording worker exit states into the registry
+        (reference driver.py:304 _handle_worker_exit)."""
+        inner = self._exec_fn
+
+        def exec_and_record(command, env, slot, events):
+            self._registry.record_ready(slot.hostname, slot.local_rank)
+            try:
+                if inner is not None:
+                    code = inner(command, env, slot, events)
+                else:
+                    from ..exec_run import _exec_local, _exec_ssh
+
+                    local = set(get_local_host_addresses() + ["localhost"])
+                    fn = _exec_local if slot.hostname in local else _exec_ssh
+                    code = fn(command, env, slot, events)
+            except Exception as e:
+                # an exec that raises (bad command, ssh failure) must still
+                # reach a terminal state or the round barrier never fires
+                LOG.warning(
+                    "worker exec for rank %d raised: %s", slot.rank, e
+                )
+                code = 1
+            if code == 0:
+                self._registry.record_success(slot.hostname, slot.local_rank)
+            else:
+                self._registry.record_failure(slot.hostname, slot.local_rank)
+                self._worker_failure.set()
+            return code
+
+        return exec_and_record
+
+    def _on_barrier(self, states: Dict[str, str]) -> None:
+        self._barrier_states = states
+        self._barrier_event.set()
+
+    # ------------------------------------------------------- host management
+
+    def _update_host_assignments(self) -> List[SlotInfo]:
+        """Recompute slot assignments, keeping surviving hosts' ranks
+        (reference driver.py:240-283)."""
+        hosts = self._host_manager.current_hosts.host_infos()
+        assignments = get_host_assignments(
+            hosts,
+            self._settings.min_np,
+            self._settings.max_np,
+            rank_assignments=self._rank_assignments,
+        )
+        new_ranks: Dict[str, List[int]] = {}
+        for slot in assignments:
+            new_ranks.setdefault(slot.hostname, []).append(slot.rank)
+        self._rank_assignments = new_ranks
+        return assignments
+
+    def _discovery_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                result = self._host_manager.update_available_hosts()
+            except Exception as e:  # discovery script hiccup: warn, retry
+                LOG.warning("host discovery failed: %s", e)
+                result = NO_UPDATE
+            if result != NO_UPDATE:
+                self._notify_workers_host_changes(result)
+            self._shutdown.wait(self._settings.discovery_interval_s)
+
+    def _notify_workers_host_changes(self, update_result: int) -> None:
+        """Push HostsUpdatedRequest to every registered worker
+        (reference driver.py:210)."""
+        self._notify_timestamp += 1
+        addrs = get_local_host_addresses()
+        port = self._rendezvous.port
+        key = self._env[ENV_SECRET].encode()
+        for slot in self._assignments:
+            try:
+                client = get_worker_client(
+                    addrs[-1], port, slot.rank, key
+                )
+                if client is not None:
+                    client.notify_hosts_updated(
+                        self._notify_timestamp, update_result
+                    )
+            except Exception as e:
+                LOG.debug("notify rank %d failed: %s", slot.rank, e)

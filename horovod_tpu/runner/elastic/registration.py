@@ -1,0 +1,68 @@
+"""Per-slot worker state registry.
+
+Reference: /root/reference/horovod/runner/elastic/registration.py:28
+(`WorkerStateRegistry`) — collects READY/SUCCESS/FAILURE reports per slot
+for the current rendezvous round; when every slot of the round has
+reported, fires the driver's barrier callback (driver.resume or shutdown).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+READY = "READY"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+
+class WorkerStateRegistry:
+    def __init__(self, on_barrier: Callable[[Dict[str, str]], None]):
+        self._on_barrier = on_barrier
+        self._lock = threading.Lock()
+        self._expected = 0
+        self._round = 0
+        self._states: Dict[str, str] = {}  # "host:local_rank" → state
+
+    def reset(self, expected_workers: int) -> None:
+        """New rendezvous round (reference registration.py reset)."""
+        with self._lock:
+            self._expected = expected_workers
+            self._states = {}
+            self._round += 1
+
+    @property
+    def round(self) -> int:
+        return self._round
+
+    def _record(self, key: str, state: str) -> None:
+        fire: Optional[Dict[str, str]] = None
+        with self._lock:
+            # first terminal state wins (a FAILURE then exit-0 is FAILURE)
+            if self._states.get(key) in (SUCCESS, FAILURE):
+                return
+            self._states[key] = state
+            terminal = [
+                s for s in self._states.values() if s in (SUCCESS, FAILURE)
+            ]
+            if self._expected and len(terminal) >= self._expected:
+                fire = dict(self._states)
+        if fire is not None:
+            self._on_barrier(fire)
+
+    def record_ready(self, host: str, local_rank: int) -> None:
+        self._record(f"{host}:{local_rank}", READY)
+
+    def record_success(self, host: str, local_rank: int) -> None:
+        self._record(f"{host}:{local_rank}", SUCCESS)
+
+    def record_failure(self, host: str, local_rank: int) -> None:
+        self._record(f"{host}:{local_rank}", FAILURE)
+
+    def count(self, state: str) -> int:
+        with self._lock:
+            return sum(1 for s in self._states.values() if s == state)
+
+    def get(self, host: str, local_rank: int) -> Optional[str]:
+        with self._lock:
+            return self._states.get(f"{host}:{local_rank}")

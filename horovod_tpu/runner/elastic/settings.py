@@ -1,0 +1,26 @@
+"""Elastic job settings (reference runner/elastic/settings.py,
+constants.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+ELASTIC_TIMEOUT_SECS_DEFAULT = 600.0
+DISCOVERY_INTERVAL_SECS = 1.0
+
+
+@dataclasses.dataclass
+class ElasticSettings:
+    min_np: int
+    max_np: Optional[int] = None
+    timeout_s: float = ELASTIC_TIMEOUT_SECS_DEFAULT
+    reset_limit: int = 0  # 0 = unlimited resets
+    cooldown_range: Optional[Tuple[float, float]] = None
+    discovery_interval_s: float = DISCOVERY_INTERVAL_SECS
+
+    def __post_init__(self):
+        if self.min_np < 1:
+            raise ValueError("min_np must be >= 1")
+        if self.max_np is not None and self.max_np < self.min_np:
+            raise ValueError("max_np must be >= min_np")

@@ -1,0 +1,120 @@
+"""Worker notification protocol: driver → workers on host-set changes.
+
+Reference: /root/reference/horovod/runner/elastic/worker.py —
+`WorkerNotificationService` runs inside each worker process; the driver
+holds a `WorkerNotificationClient` per worker and pushes
+`HostsUpdatedRequest` when discovery sees a change; the worker-side
+`WorkerNotificationManager` flips the host-update flag that
+`State.commit()/check_host_updates()` converts into a
+`HostsUpdatedInterrupt` (common/elastic.py:57-99).
+
+Workers register their service address in the rendezvous KV store under
+scope `workers`, key `rank_{rank}` (the reference registers through the
+driver's own service; the KV store is our single bootstrap channel).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+from ..http import http_client
+from ..util.network import AckResponse, BasicClient, BasicService
+from ..util.secret import ENV_SECRET, secret_from_env
+
+WORKERS_SCOPE = "workers"
+SERVICE_NAME = "worker-notification"
+
+
+class HostsUpdatedRequest:
+    def __init__(self, timestamp: int, update_result: int):
+        self.timestamp = timestamp
+        self.update_result = update_result
+
+
+class WorkerNotificationService(BasicService):
+    """In-worker TCP service receiving host-update pushes."""
+
+    def __init__(self, key: bytes, manager: "WorkerNotificationManager"):
+        super().__init__(SERVICE_NAME, key)
+        self._manager = manager
+
+    def _handle(self, req, client_address):
+        if isinstance(req, HostsUpdatedRequest):
+            self._manager.handle_hosts_updated(
+                req.timestamp, req.update_result
+            )
+            return AckResponse()
+        return super()._handle(req, client_address)
+
+
+class WorkerNotificationClient(BasicClient):
+    """Driver-side client to one worker's notification service."""
+
+    def __init__(self, addresses: List[Tuple[str, int]], key: bytes):
+        super().__init__(SERVICE_NAME, addresses, key)
+
+    def notify_hosts_updated(self, timestamp: int, update_result: int) -> None:
+        self.request(HostsUpdatedRequest(timestamp, update_result))
+
+
+class WorkerNotificationManager:
+    """Worker-side singleton: starts the service, registers its address,
+    relays pushes into the elastic state flag
+    (reference worker.py WorkerNotificationManager)."""
+
+    def __init__(self) -> None:
+        self._service: Optional[WorkerNotificationService] = None
+        self._timestamp = 0
+
+    def init(self) -> None:
+        if self._service is not None:
+            return
+        rendezvous_addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+        if not rendezvous_addr:
+            return  # not launched by hvdrun; notifications disabled
+        key = secret_from_env()
+        self._service = WorkerNotificationService(key, self)
+        rank = os.environ.get("HVD_TPU_RANK", "0")
+        port = int(os.environ["HVD_TPU_RENDEZVOUS_PORT"])
+        payload = json.dumps(self._service.addresses()).encode()
+        http_client.put(
+            rendezvous_addr, port, WORKERS_SCOPE, f"rank_{rank}", payload
+        )
+
+    def handle_hosts_updated(self, timestamp: int, update_result: int) -> None:
+        if timestamp <= self._timestamp:
+            return
+        self._timestamp = timestamp
+        from ...elastic.state import host_update_flag
+
+        host_update_flag.signal()
+
+    def shutdown(self) -> None:
+        if self._service is not None:
+            self._service.shutdown()
+            self._service = None
+
+
+notification_manager = WorkerNotificationManager()
+
+
+def get_worker_client(
+    rendezvous_addr: str,
+    rendezvous_port: int,
+    rank: int,
+    key: bytes,
+    timeout_s: float = 10.0,
+) -> Optional[WorkerNotificationClient]:
+    """Driver-side: look up a worker's registered address and connect."""
+    raw = http_client.get(
+        rendezvous_addr, rendezvous_port, WORKERS_SCOPE, f"rank_{rank}"
+    )
+    if raw is None:
+        return None
+    addresses = [tuple(a) for a in json.loads(raw.decode())]
+    try:
+        return WorkerNotificationClient(addresses, key)
+    except ConnectionError:
+        return None

@@ -1,0 +1,177 @@
+"""Static-run slot spawning (the gloo_run analog).
+
+Reference: /root/reference/horovod/runner/gloo_run.py — `launch_gloo`
+(:242): start an in-proc RendezvousServer, compute SlotInfo assignments,
+build per-slot env (HOROVOD_RANK/SIZE/... :66-101), spawn each slot via
+local exec or ssh in a thread pool, and kill everything if any slot fails
+(:137-199).
+
+TPU mapping: one slot per *host process*; the first assigned host doubles
+as the JAX coordination-service coordinator (jax.distributed), published to
+all workers via env. Per-slot env keeps the HOROVOD_* names so reference
+scripts run unmodified, plus HVD_TPU_* equivalents.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import sys
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .http.http_server import RendezvousServer
+from .util import safe_shell_exec
+from .util.hosts import HostInfo, SlotInfo, get_host_assignments
+from .util.network import (
+    find_free_port,
+    get_local_host_addresses,
+    routable_host_address,
+)
+from .util.secret import ENV_SECRET
+
+JAX_COORD_PORT_OFFSET = 19  # coordinator port = rendezvous port + offset
+
+
+def slot_env(
+    slot: SlotInfo,
+    base_env: Dict[str, str],
+    rendezvous_addr: str,
+    rendezvous_port: int,
+    coordinator_address: str,
+) -> Dict[str, str]:
+    """Per-slot worker environment (reference gloo_run.py:66-101)."""
+    env = dict(base_env)
+    pairs = {
+        "RANK": slot.rank,
+        "SIZE": slot.size,
+        "LOCAL_RANK": slot.local_rank,
+        "LOCAL_SIZE": slot.local_size,
+        "CROSS_RANK": slot.cross_rank,
+        "CROSS_SIZE": slot.cross_size,
+    }
+    for name, v in pairs.items():
+        env[f"HOROVOD_{name}"] = str(v)
+        env[f"HVD_TPU_{name}"] = str(v)
+    env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = rendezvous_addr
+    env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(rendezvous_port)
+    env["HVD_TPU_RENDEZVOUS_ADDR"] = rendezvous_addr
+    env["HVD_TPU_RENDEZVOUS_PORT"] = str(rendezvous_port)
+    env["HOROVOD_CONTROLLER"] = "xla"
+    env["HOROVOD_CPU_OPERATIONS"] = "xla"
+    # JAX coordination service (the DCN control plane; SURVEY.md §2.6).
+    # Each slot is one JAX process: on TPU pods that is one host driving
+    # all its local chips (hosts listed as "host:1"); in CPU test worlds a
+    # host may carry several single-device processes.
+    env["HVD_TPU_COORDINATOR_ADDRESS"] = coordinator_address
+    env["HVD_TPU_NUM_PROCESSES"] = str(slot.size)
+    env["HVD_TPU_PROCESS_ID"] = str(slot.rank)
+    return env
+
+
+def _exec_local(command: List[str], env, slot: SlotInfo, events) -> int:
+    return safe_shell_exec.execute(
+        command, env=env, prefix=f"{slot.rank}", events=events
+    )
+
+
+def _exec_ssh(command: List[str], env, slot: SlotInfo, events) -> int:
+    exported = " ".join(
+        f"{k}={shlex.quote(v)}"
+        for k, v in env.items()
+        if k.startswith(("HOROVOD_", "HVD_TPU_", "PYTHON")) or k == "PATH"
+    )
+    remote = f"cd {shlex.quote(os.getcwd())} && env {exported} " + " ".join(
+        shlex.quote(c) for c in command
+    )
+    ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote]
+    return safe_shell_exec.execute(
+        ssh_cmd, env=dict(os.environ), prefix=f"{slot.rank}", events=events
+    )
+
+
+def launch_slots(
+    command: List[str],
+    assignments: List[SlotInfo],
+    env: Dict[str, str],
+    rendezvous: Optional[RendezvousServer] = None,
+    exec_fn: Optional[Callable] = None,
+    local_hosts: Optional[List[str]] = None,
+) -> List[int]:
+    """Spawn one worker per slot; any failure terminates all others.
+
+    Returns per-slot exit codes. `exec_fn(command, env, slot, events)` is
+    injectable for tests (reference pattern: mocked ssh in test_run.py).
+    """
+    own = rendezvous is None
+    if rendezvous is None:
+        rendezvous = RendezvousServer()
+        port = rendezvous.init(assignments)
+    else:
+        # caller (elastic driver) already published this round's
+        # assignments; don't double-publish / double-bump the round
+        port = rendezvous.port
+    local = set(local_hosts or get_local_host_addresses() + ["localhost"])
+    rendezvous_addr = routable_host_address()
+    # The JAX coordination service runs inside the rank-0 *worker*, so the
+    # coordinator address must name rank 0's host, not the launcher. For a
+    # local rank-0 we can probe a free port; for a remote one use a
+    # deterministic port derived from the rendezvous port.
+    rank0_host = assignments[0].hostname
+    if rank0_host in ("localhost", *get_local_host_addresses()):
+        coordinator = f"{rendezvous_addr}:{find_free_port()}"
+    else:
+        coordinator = f"{rank0_host}:{port + JAX_COORD_PORT_OFFSET}"
+
+    if ENV_SECRET not in env:
+        from .util.secret import make_secret_key
+
+        env = dict(env)
+        env[ENV_SECRET] = make_secret_key().decode()
+
+    failure = threading.Event()
+    codes: List[Optional[int]] = [None] * len(assignments)
+
+    def run_slot(i: int, slot: SlotInfo):
+        wenv = slot_env(slot, env, rendezvous_addr, port, coordinator)
+        fn = exec_fn
+        if fn is None:
+            fn = (
+                _exec_local
+                if slot.hostname in local
+                else _exec_ssh
+            )
+        try:
+            codes[i] = fn(command, wenv, slot, [failure])
+        except BaseException:
+            codes[i] = 1
+            raise
+        finally:
+            if codes[i] != 0:
+                failure.set()
+
+    threads = [
+        threading.Thread(target=run_slot, args=(i, s), daemon=True)
+        for i, s in enumerate(assignments)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if own:
+        rendezvous.shutdown_server()
+    return [c if c is not None else 1 for c in codes]
+
+
+def run_static(
+    command: List[str],
+    hosts: List[HostInfo],
+    np: int,
+    env: Optional[Dict[str, str]] = None,
+    exec_fn: Optional[Callable] = None,
+) -> List[int]:
+    """Static (non-elastic) launch: assignments once, run to completion."""
+    assignments = get_host_assignments(hosts, np, np)
+    return launch_slots(
+        command, assignments, dict(env or os.environ), exec_fn=exec_fn
+    )

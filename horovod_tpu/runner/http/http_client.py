@@ -1,0 +1,48 @@
+"""Client for the rendezvous/KV HTTP store (reference runner/http/http_client.py)."""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+
+def put(addr: str, port: int, scope: str, key: str, value: bytes) -> None:
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{scope}/{key}", data=value, method="PUT"
+    )
+    with urllib.request.urlopen(req, timeout=10):
+        pass
+
+
+def get(addr: str, port: int, scope: str, key: str) -> Optional[bytes]:
+    try:
+        with urllib.request.urlopen(
+            f"http://{addr}:{port}/{scope}/{key}", timeout=10
+        ) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def wait_for_key(
+    addr: str, port: int, scope: str, key: str, timeout_s: float = 60.0
+) -> bytes:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        v = get(addr, port, scope, key)
+        if v is not None:
+            return v
+        time.sleep(0.2)
+    raise TimeoutError(f"key {scope}/{key} not published within {timeout_s}s")
+
+
+def delete(addr: str, port: int, scope: str, key: str) -> None:
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{scope}/{key}", method="DELETE"
+    )
+    with urllib.request.urlopen(req, timeout=10):
+        pass

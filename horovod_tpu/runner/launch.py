@@ -1,0 +1,204 @@
+"""`hvdrun` — the horovodrun-equivalent CLI.
+
+Reference: /root/reference/horovod/runner/launch.py — parse_args (:286),
+`_run_static` (:583), `_run_elastic` (:676), `run_controller` (:734). The
+controller-selection matrix (gloo/mpi/jsrun) collapses on TPU: the data
+plane is always XLA collectives and bootstrap is always the rendezvous
+HTTP store + JAX coordination service, so the remaining choice is
+static vs elastic.
+
+Usage:
+    hvdrun -np 4 -H host1:1,host2:1,host3:1,host4:1 python train.py
+    hvdrun -np 8 --min-np 4 --max-np 12 --host-discovery-script ./d.sh \
+        python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from .util import config_parser
+from .util.hosts import HostInfo, parse_host_files, parse_hosts
+
+
+def parse_args(argv: Optional[List[str]] = None):
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu training job.",
+    )
+    p.add_argument("-v", "--version", action="store_true")
+    p.add_argument(
+        "-np", "--num-proc", dest="np", type=int,
+        help="Total number of worker processes (slots).",
+    )
+    p.add_argument(
+        "-H", "--hosts", dest="hosts",
+        help="Comma-separated host:slots list, e.g. h1:1,h2:1.",
+    )
+    p.add_argument(
+        "-hostfile", "--hostfile", dest="hostfile",
+        help="Hostfile with `host slots=N` lines.",
+    )
+    p.add_argument("--verbose", action="count", default=0)
+    p.add_argument("--config-file", dest="config_file")
+
+    # elastic (reference launch.py:676)
+    p.add_argument("--min-np", dest="min_np", type=int)
+    p.add_argument("--max-np", dest="max_np", type=int)
+    p.add_argument(
+        "--host-discovery-script", dest="host_discovery_script",
+        help="Executable printing the current host:slots list, one per line.",
+    )
+    p.add_argument("--slots-per-host", dest="slots", type=int, default=1)
+    p.add_argument("--elastic-timeout", dest="elastic_timeout", type=float)
+    p.add_argument("--reset-limit", dest="reset_limit", type=int)
+    p.add_argument(
+        "--blacklist-cooldown-range", dest="cooldown_range", nargs=2,
+        type=float, metavar=("MIN_S", "MAX_S"),
+    )
+
+    # runtime knobs → env (reference launch.py:286-580, config_parser)
+    p.add_argument("--fusion-threshold-mb", dest="fusion_threshold_mb",
+                   type=int)
+    p.add_argument("--cycle-time-ms", dest="cycle_time_ms", type=float)
+    p.add_argument("--cache-capacity", dest="cache_capacity", type=int)
+    p.add_argument("--timeline-filename", dest="timeline_filename")
+    p.add_argument("--timeline-mark-cycles", dest="timeline_mark_cycles",
+                   action="store_true", default=None)
+    p.add_argument("--autotune", dest="autotune", action="store_true",
+                   default=None)
+    p.add_argument("--autotune-log", dest="autotune_log")
+    p.add_argument("--compression-wire-dtype",
+                   dest="compression_wire_dtype",
+                   choices=["bfloat16", "float16"])
+    p.add_argument("--fp16-allreduce", dest="compression_wire_dtype",
+                   action="store_const", const="bfloat16",
+                   help="bf16-on-the-wire gradient compression (TPU-native "
+                        "form of the reference's fp16 allreduce).")
+    p.add_argument("--hierarchical-allreduce",
+                   dest="hierarchical_allreduce", action="store_true",
+                   default=None)
+    p.add_argument("--hierarchical-allgather",
+                   dest="hierarchical_allgather", action="store_true",
+                   default=None)
+    p.add_argument("--stall-check-disable", dest="stall_check_disable",
+                   action="store_true", default=None)
+    p.add_argument("--stall-warning-time-seconds",
+                   dest="stall_warning_time_seconds", type=float)
+    p.add_argument("--stall-shutdown-time-seconds",
+                   dest="stall_shutdown_time_seconds", type=float)
+    p.add_argument("--log-level", dest="log_level",
+                   choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "FATAL"])
+    p.add_argument("--mesh", dest="mesh",
+                   help='Mesh axis spec for workers, e.g. "dp=4,tp=2".')
+    p.add_argument(
+        "--network-interface", dest="nics",
+        help="Comma-separated NICs to bind (recorded in env; XLA/DCN "
+             "transport selection is automatic on TPU).",
+    )
+
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="Training command to run on every slot.")
+
+    args = p.parse_args(argv)
+
+    if args.config_file:
+        explicit = _explicit_dests(argv if argv is not None else sys.argv[1:], p)
+        config_parser.apply_config_file(args, args.config_file, explicit)
+    return args
+
+
+def _explicit_dests(argv, parser) -> set:
+    """Dests the user set on the command line (beat the config file)."""
+    explicit = set()
+    for action in parser._actions:
+        for opt in action.option_strings:
+            if any(a == opt or a.startswith(opt + "=") for a in argv):
+                explicit.add(action.dest)
+    return explicit
+
+
+def _resolve_hosts(args) -> List[HostInfo]:
+    if args.hostfile:
+        return parse_hosts(parse_host_files(args.hostfile))
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    np = args.np or 1
+    return [HostInfo("localhost", np)]
+
+
+def is_elastic(args) -> bool:
+    return bool(args.host_discovery_script or args.min_np or args.max_np)
+
+
+def _run_static(args) -> int:
+    from .exec_run import run_static
+
+    hosts = _resolve_hosts(args)
+    if args.np is None:
+        args.np = sum(h.slots for h in hosts)
+    env = config_parser.env_from_args(args, dict(os.environ))
+    codes = run_static(args.command, hosts, args.np, env=env)
+    # signal-killed workers report negative codes; any nonzero is failure
+    failed = [c for c in codes if c != 0]
+    return abs(failed[0]) if failed else (0 if codes else 1)
+
+
+def _run_elastic(args) -> int:
+    from .elastic.driver import ElasticDriver
+    from .elastic.discovery import HostDiscoveryScript, HostManager
+    from .elastic.settings import ElasticSettings
+
+    if not args.host_discovery_script:
+        raise ValueError(
+            "elastic mode requires --host-discovery-script "
+            "(reference launch.py:676)"
+        )
+    settings = ElasticSettings(
+        min_np=args.min_np or args.np or 1,
+        max_np=args.max_np,
+        timeout_s=args.elastic_timeout or 600.0,
+        reset_limit=args.reset_limit or 0,
+        cooldown_range=tuple(args.cooldown_range)
+        if args.cooldown_range else None,
+    )
+    discovery = HostDiscoveryScript(
+        args.host_discovery_script, args.slots
+    )
+    env = config_parser.env_from_args(args, dict(os.environ))
+    driver = ElasticDriver(
+        HostManager(discovery, settings.cooldown_range),
+        settings,
+        command=args.command,
+        env=env,
+    )
+    return driver.run()
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.version:
+        from .. import __version__
+
+        print(__version__)
+        return 0
+    if not args.command:
+        print("hvdrun: no command given", file=sys.stderr)
+        return 2
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    if is_elastic(args):
+        return _run_elastic(args)
+    return _run_static(args)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

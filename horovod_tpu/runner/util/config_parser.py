@@ -1,0 +1,104 @@
+"""CLI/YAML config → environment-variable knobs.
+
+Reference: /root/reference/horovod/runner/common/util/config_parser.py +
+launch.py:286-580 — every launcher flag maps onto a `HOROVOD_*` env var
+that the in-process runtime (core/knobs.py) reads. YAML config files set
+the same keys; explicit CLI flags win over the file.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# flag name (argparse dest) → env var set for workers
+ARG_TO_ENV = {
+    "fusion_threshold_mb": "HOROVOD_FUSION_THRESHOLD",
+    "cycle_time_ms": "HOROVOD_CYCLE_TIME",
+    "cache_capacity": "HOROVOD_CACHE_CAPACITY",
+    "timeline_filename": "HOROVOD_TIMELINE",
+    "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
+    "autotune": "HOROVOD_AUTOTUNE",
+    "autotune_log": "HOROVOD_AUTOTUNE_LOG",
+    "compression_wire_dtype": "HOROVOD_COMPRESSION_WIRE_DTYPE",
+    "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
+    "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
+    "elastic_timeout": "HOROVOD_ELASTIC_TIMEOUT",
+    "reset_limit": "HOROVOD_RESET_LIMIT",
+    "stall_check_disable": "HOROVOD_STALL_CHECK_DISABLE",
+    "stall_warning_time_seconds": "HOROVOD_STALL_CHECK_TIME_SECONDS",
+    "stall_shutdown_time_seconds": "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
+    "log_level": "HOROVOD_LOG_LEVEL",
+    "mesh": "HOROVOD_MESH",
+}
+
+
+def _to_env_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    return str(v)
+
+
+def env_from_args(args, env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Collect worker env vars from parsed CLI args (None values skipped)."""
+    env = dict(env or {})
+    for dest, var in ARG_TO_ENV.items():
+        v = getattr(args, dest, None)
+        if v is None or v is False or v == "":
+            continue
+        if dest == "fusion_threshold_mb":
+            v = int(v) * 1024 * 1024
+        env[var] = _to_env_value(v)
+    return env
+
+
+def load_config_file(path: str) -> Dict[str, object]:
+    """YAML (or key: value) config file → {argparse dest: value}."""
+    try:
+        import yaml  # type: ignore
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+    except ImportError:
+        data = {}
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line or ":" not in line:
+                    continue
+                k, _, v = line.partition(":")
+                data[k.strip()] = _parse_scalar(v.strip())
+    flat: Dict[str, object] = {}
+    _flatten(data, flat)
+    return {k.replace("-", "_"): v for k, v in flat.items()}
+
+
+def _flatten(d, out, prefix=""):
+    for k, v in d.items():
+        if isinstance(v, dict):
+            _flatten(v, out)
+        else:
+            out[k] = v
+
+
+def _parse_scalar(v: str):
+    low = v.lower()
+    if low in ("true", "yes", "on"):
+        return True
+    if low in ("false", "no", "off"):
+        return False
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    return v
+
+
+def apply_config_file(args, path: str, explicit_dests) -> None:
+    """Set args fields from the config file unless given explicitly on the
+    command line (reference config_parser.py behavior)."""
+    for dest, value in load_config_file(path).items():
+        if dest in explicit_dests:
+            continue
+        if hasattr(args, dest):
+            setattr(args, dest, value)

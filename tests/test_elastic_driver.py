@@ -1,0 +1,288 @@
+"""Elastic driver tests with scripted discovery and injected exec — the
+reference's mock-discovery pattern (test/single/test_elastic_driver.py,
+SURVEY.md §4.1): no real hosts, real threads."""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import (
+    ADDED,
+    MIXED,
+    NO_UPDATE,
+    REMOVED,
+    FixedHosts,
+    HostDiscoveryScript,
+    HostManager,
+)
+from horovod_tpu.runner.elastic.driver import ElasticDriver
+from horovod_tpu.runner.elastic.registration import (
+    FAILURE,
+    SUCCESS,
+    WorkerStateRegistry,
+)
+from horovod_tpu.runner.elastic.settings import ElasticSettings
+from horovod_tpu.runner.elastic.worker import (
+    WorkerNotificationClient,
+    WorkerNotificationManager,
+    WorkerNotificationService,
+)
+from horovod_tpu.runner.util.secret import make_secret_key
+
+
+def settings(**kw):
+    kw.setdefault("min_np", 2)
+    kw.setdefault("timeout_s", 10.0)
+    kw.setdefault("discovery_interval_s", 0.05)
+    return ElasticSettings(**kw)
+
+
+# ------------------------------------------------------------- discovery
+
+
+def test_host_manager_classifies_updates():
+    disc = FixedHosts({"h1": 2})
+    mgr = HostManager(disc)
+    assert mgr.update_available_hosts() == ADDED
+    assert mgr.update_available_hosts() == NO_UPDATE
+    disc.set({"h1": 2, "h2": 2})
+    assert mgr.update_available_hosts() == ADDED
+    disc.set({"h2": 2})
+    assert mgr.update_available_hosts() == REMOVED
+    disc.set({"h2": 4})
+    assert mgr.update_available_hosts() == MIXED
+    assert mgr.current_hosts.count_available_slots() == 4
+
+
+def test_host_manager_blacklist_and_cooldown_resurrection():
+    disc = FixedHosts({"h1": 1, "h2": 1})
+    mgr = HostManager(disc, cooldown_range=(0.2, 0.2))
+    mgr.update_available_hosts()
+    mgr.blacklist("h1")
+    mgr.update_available_hosts()
+    assert mgr.current_hosts.available_hosts == {"h2"}
+    assert mgr.is_blacklisted("h1")
+    time.sleep(0.3)  # cooldown expires → resurrection
+    mgr.update_available_hosts()
+    assert mgr.current_hosts.available_hosts == {"h1", "h2"}
+
+
+def test_host_manager_blacklist_permanent_without_cooldown():
+    disc = FixedHosts({"h1": 1})
+    mgr = HostManager(disc)  # no cooldown range → permanent
+    mgr.update_available_hosts()
+    mgr.blacklist("h1")
+    time.sleep(0.1)
+    mgr.update_available_hosts()
+    assert mgr.current_hosts.available_hosts == set()
+
+
+def test_discovery_script(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho h1:2\necho h2\n")
+    script.chmod(0o755)
+    disc = HostDiscoveryScript(str(script), default_slots=3)
+    assert disc.find_available_hosts_and_slots() == {"h1": 2, "h2": 3}
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_barrier_fires_on_all_terminal():
+    fired = []
+    reg = WorkerStateRegistry(lambda states: fired.append(states))
+    reg.reset(2)
+    reg.record_ready("h1", 0)
+    reg.record_ready("h1", 1)
+    assert not fired
+    reg.record_success("h1", 0)
+    assert not fired
+    reg.record_failure("h1", 1)
+    assert len(fired) == 1
+    assert fired[0] == {"h1:0": SUCCESS, "h1:1": FAILURE}
+
+
+def test_registry_first_terminal_state_wins():
+    fired = []
+    reg = WorkerStateRegistry(lambda s: fired.append(s))
+    reg.reset(1)
+    reg.record_failure("h1", 0)
+    reg.record_success("h1", 0)  # ignored
+    assert fired[0] == {"h1:0": FAILURE}
+
+
+# ------------------------------------------------------------- driver
+
+
+class ScriptedExec:
+    """Injected exec: behavior per (round, rank) — exit code or callable."""
+
+    def __init__(self, behavior):
+        self.behavior = behavior  # {(round, rank): code}
+        self.calls = []
+        self.lock = threading.Lock()
+        self.round_of = {}
+        self.round_counter = {}
+
+    def __call__(self, command, env, slot, events):
+        with self.lock:
+            r = self.round_counter.get(slot.rank, 0)
+            self.round_counter[slot.rank] = r + 1
+            self.calls.append((r, slot.rank, slot.hostname))
+        code = self.behavior.get((r, slot.rank), 0)
+        if callable(code):
+            return code(slot, events)
+        return code
+
+
+def test_driver_all_success_single_round():
+    disc = FixedHosts({"h1": 1, "h2": 1})
+    ex = ScriptedExec({})
+    driver = ElasticDriver(
+        HostManager(disc), settings(), ["cmd"], {}, exec_fn=ex
+    )
+    assert driver.run() == 0
+    assert sorted(c[1] for c in ex.calls) == [0, 1]
+
+
+def test_driver_retries_after_failure_and_blacklists():
+    """Round 0: rank on h2 fails → h2 blacklisted; round 1 runs on the
+    remaining hosts and succeeds."""
+    disc = FixedHosts({"h1": 1, "h2": 1, "h3": 1})
+
+    def fail_on_h2(slot, events):
+        return 1 if slot.hostname == "h2" else 0
+
+    ex = ScriptedExec({
+        (0, 0): fail_on_h2, (0, 1): fail_on_h2, (0, 2): fail_on_h2,
+    })
+    driver = ElasticDriver(
+        HostManager(disc), settings(min_np=2), ["cmd"], {}, exec_fn=ex
+    )
+    assert driver.run() == 0
+    hosts_round1 = {c[2] for c in ex.calls if c[0] == 1}
+    assert "h2" not in hosts_round1
+    assert hosts_round1 <= {"h1", "h3"}
+
+
+def test_driver_rank_stability_across_rounds():
+    """Hosts surviving a failure keep their global ranks."""
+    disc = FixedHosts({"h1": 1, "h2": 1, "h3": 1})
+    rank_by_host = {0: {}, 1: {}}
+
+    def record(slot, events):
+        return 0
+
+    def fail_h3(slot, events):
+        return 1 if slot.hostname == "h3" else 0
+
+    class RecordingExec(ScriptedExec):
+        def __call__(self, command, env, slot, events):
+            with self.lock:
+                r = self.round_counter.get(slot.rank, None)
+            # capture mapping before parent increments
+            res = super().__call__(command, env, slot, events)
+            return res
+
+    ex = ScriptedExec({
+        (0, 0): fail_h3, (0, 1): fail_h3, (0, 2): fail_h3,
+    })
+    captured = {}
+    orig_call = ex.__call__
+
+    def capturing(command, env, slot, events):
+        captured.setdefault(slot.hostname, []).append(
+            (int(env["HOROVOD_RANK"]), int(env["HOROVOD_SIZE"]))
+        )
+        return orig_call(command, env, slot, events)
+
+    driver = ElasticDriver(
+        HostManager(disc), settings(min_np=2), ["cmd"], {},
+        exec_fn=capturing,
+    )
+    assert driver.run() == 0
+    # surviving hosts keep their round-0 rank in round 1 (size shrinks 3→2)
+    for host in ("h1", "h2"):
+        ranks = [r for r, _ in captured[host]]
+        assert len(set(ranks)) == 1, f"{host} changed rank: {ranks}"
+    sizes_round1 = {s for host in ("h1", "h2") for _, s in captured[host][1:]}
+    assert sizes_round1 == {2}
+
+
+def test_driver_reset_limit():
+    disc = FixedHosts({"h1": 1, "h2": 1})
+    ex = ScriptedExec({
+        (r, rank): 1 for r in range(10) for rank in range(2)
+    })
+    driver = ElasticDriver(
+        HostManager(disc, cooldown_range=(0.01, 0.02)),
+        settings(min_np=1, reset_limit=2),
+        ["cmd"], {}, exec_fn=ex,
+    )
+    assert driver.run() == 1
+    rounds = {c[0] for c in ex.calls}
+    assert max(rounds) <= 2
+
+
+def test_driver_scale_up_between_rounds():
+    """New host appears after a failed round → next round uses it."""
+    disc = FixedHosts({"h1": 1, "h2": 1})
+
+    def fail_once(slot, events):
+        disc.set({"h1": 1, "h2": 1, "h3": 1})  # h3 joins
+        return 1 if slot.rank == 1 else 0
+
+    ex = ScriptedExec({(0, 0): fail_once, (0, 1): fail_once})
+    driver = ElasticDriver(
+        HostManager(disc), settings(min_np=1), ["cmd"], {}, exec_fn=ex
+    )
+    assert driver.run() == 0
+    hosts_round1 = {c[2] for c in ex.calls if c[0] == 1}
+    assert "h3" in hosts_round1
+
+
+def test_driver_wait_for_available_slots_timeout():
+    disc = FixedHosts({})
+    driver = ElasticDriver(
+        HostManager(disc), settings(min_np=2, timeout_s=0.3),
+        ["cmd"], {}, exec_fn=ScriptedExec({}),
+    )
+    driver.start()
+    try:
+        with pytest.raises(TimeoutError):
+            driver.wait_for_available_slots(2, timeout_s=0.3)
+    finally:
+        driver.stop()
+
+
+# ------------------------------------------------- worker notification
+
+
+def test_worker_notification_roundtrip():
+    """Driver-side client pushes HostsUpdatedRequest; worker-side manager
+    flips the elastic host-update flag (reference worker.py protocol)."""
+    from horovod_tpu.elastic.state import host_update_flag
+
+    host_update_flag.consume()  # clear
+    key = make_secret_key()
+    mgr = WorkerNotificationManager()
+    svc = WorkerNotificationService(key, mgr)
+    try:
+        client = WorkerNotificationClient(svc.addresses(), key)
+        client.notify_hosts_updated(timestamp=1, update_result=ADDED)
+        deadline = time.time() + 2
+        while time.time() < deadline and not host_update_flag.consume():
+            time.sleep(0.01)
+        else:
+            pass
+        # stale timestamp ignored
+        client.notify_hosts_updated(timestamp=1, update_result=ADDED)
+        time.sleep(0.1)
+        assert not host_update_flag.consume()
+        # newer timestamp delivered
+        client.notify_hosts_updated(timestamp=2, update_result=REMOVED)
+        time.sleep(0.1)
+        assert host_update_flag.consume()
+    finally:
+        svc.shutdown()
