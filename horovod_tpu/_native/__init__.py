@@ -1,0 +1,252 @@
+"""ctypes bindings for the native control-plane runtime.
+
+Reference: /root/reference/horovod/common/basics.py:29 (`HorovodBasics`
+loads the compiled C library with ctypes and wraps the C API from
+operations.cc:903-1370). Builds lazily via `make` on first use; the
+pure-Python/XLA SPMD path never needs it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libhvd_tpu_core.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+# OpType values (hvd/common.h)
+OP_ALLREDUCE = 0
+OP_ALLGATHER = 1
+OP_BROADCAST = 2
+OP_ALLTOALL = 3
+OP_REDUCESCATTER = 4
+OP_JOIN = 5
+OP_BARRIER = 6
+OP_ERROR = 7
+
+# DataType values (hvd/common.h)
+_NUMPY_TO_DTYPE = {
+    "uint8": 0, "int8": 1, "uint16": 2, "int16": 3, "int32": 4,
+    "int64": 5, "float16": 6, "float32": 7, "float64": 8, "bool": 9,
+    "bfloat16": 10,
+}
+
+# handle states (operations.cc)
+PENDING = 0
+BATCHED = 1
+DONE = 2
+FAILED = -1
+
+
+def build(force: bool = False) -> str:
+    """Compile libhvd_tpu_core.so (idempotent)."""
+    with _lock:
+        if force or not os.path.exists(_LIB_PATH):
+            subprocess.check_call(
+                ["make", "-C", _DIR] + (["clean", "all"] if force else []),
+                stdout=subprocess.DEVNULL,
+            )
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.hvd_native_init.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_double, ctypes.c_longlong, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double,
+    ]
+    lib.hvd_native_init.restype = ctypes.c_int
+    lib.hvd_native_enqueue.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_double, ctypes.c_double,
+    ]
+    lib.hvd_native_enqueue.restype = ctypes.c_longlong
+    lib.hvd_native_join.restype = ctypes.c_longlong
+    lib.hvd_native_barrier.restype = ctypes.c_longlong
+    lib.hvd_native_poll.argtypes = [ctypes.c_longlong]
+    lib.hvd_native_poll.restype = ctypes.c_int
+    lib.hvd_native_wait.argtypes = [ctypes.c_longlong, ctypes.c_double]
+    lib.hvd_native_wait.restype = ctypes.c_int
+    lib.hvd_native_next_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong, ctypes.c_double,
+    ]
+    lib.hvd_native_next_batch.restype = ctypes.c_longlong
+    lib.hvd_native_batch_done.argtypes = [
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.hvd_native_last_error.restype = ctypes.c_char_p
+    lib.hvd_native_stall_warnings.restype = ctypes.c_longlong
+    lib.hvd_native_cache_hits.restype = ctypes.c_longlong
+    lib.hvd_native_bytes_negotiated.restype = ctypes.c_longlong
+    lib.hvd_native_coordinator_port.restype = ctypes.c_int
+    _lib = lib
+    return lib
+
+
+class ExecutionBatch:
+    """A negotiated, fused batch the data plane must now execute —
+    the Python-side view of a controller Response."""
+
+    def __init__(self, batch_id, op, reduce_op, root_rank, prescale,
+                 postscale, dtype, total_bytes, names, handles, first_shape,
+                 error_reason):
+        self.batch_id = batch_id
+        self.op = op
+        self.reduce_op = reduce_op
+        self.root_rank = root_rank
+        self.prescale = prescale
+        self.postscale = postscale
+        self.dtype = dtype
+        self.total_bytes = total_bytes
+        self.names = names
+        self.handles = handles
+        self.first_shape = first_shape
+        self.error_reason = error_reason
+
+    def __repr__(self):
+        return (f"ExecutionBatch(id={self.batch_id}, op={self.op}, "
+                f"names={self.names})")
+
+
+class _BatchReader:
+    def __init__(self, data: bytes):
+        self._d = data
+        self._p = 0
+
+    def i32(self):
+        import struct
+        v = struct.unpack_from("<i", self._d, self._p)[0]
+        self._p += 4
+        return v
+
+    def i64(self):
+        import struct
+        v = struct.unpack_from("<q", self._d, self._p)[0]
+        self._p += 8
+        return v
+
+    def f64(self):
+        import struct
+        v = struct.unpack_from("<d", self._d, self._p)[0]
+        self._p += 8
+        return v
+
+    def s(self):
+        n = self.i32()
+        v = self._d[self._p:self._p + n].decode()
+        self._p += n
+        return v
+
+    def vec64(self):
+        n = self.i32()
+        return [self.i64() for _ in range(n)]
+
+
+class NativeRuntime:
+    """Typed wrapper over the C API for one process."""
+
+    def __init__(self):
+        self._lib = load()
+
+    def init(self, rank: int, size: int, coordinator_addr: str = "127.0.0.1",
+             coordinator_port: int = 0, cycle_ms: float = 1.0,
+             fusion_threshold: int = 128 << 20, cache_capacity: int = 1024,
+             stall_warning_s: float = 60.0,
+             stall_shutdown_s: float = 0.0) -> None:
+        rc = self._lib.hvd_native_init(
+            rank, size, coordinator_addr.encode(), coordinator_port,
+            cycle_ms, fusion_threshold, cache_capacity, stall_warning_s,
+            stall_shutdown_s,
+        )
+        if rc != 0:
+            raise RuntimeError(
+                f"native runtime init failed: {self.last_error()}"
+            )
+
+    def shutdown(self) -> None:
+        self._lib.hvd_native_shutdown()
+
+    def initialized(self) -> bool:
+        return bool(self._lib.hvd_native_initialized())
+
+    def enqueue(self, name: str, op: int, dtype: str,
+                shape: Sequence[int], reduce_op: int = 1,
+                root_rank: int = 0, prescale: float = 1.0,
+                postscale: float = 1.0) -> int:
+        arr = (ctypes.c_longlong * len(shape))(*shape)
+        h = self._lib.hvd_native_enqueue(
+            name.encode(), op, _NUMPY_TO_DTYPE[dtype], arr, len(shape),
+            reduce_op, root_rank, prescale, postscale,
+        )
+        if h < 0:
+            raise RuntimeError(
+                f"enqueue failed: {self.last_error()}"
+            )
+        return h
+
+    def join(self) -> int:
+        return self._lib.hvd_native_join()
+
+    def barrier(self) -> int:
+        return self._lib.hvd_native_barrier()
+
+    def poll(self, handle: int) -> int:
+        return self._lib.hvd_native_poll(handle)
+
+    def wait(self, handle: int, timeout_s: float = 60.0) -> int:
+        return self._lib.hvd_native_wait(handle, timeout_s)
+
+    def next_batch(self, timeout_s: float = 1.0) -> Optional[ExecutionBatch]:
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.hvd_native_next_batch(buf, len(buf), timeout_s)
+        if n <= 0:
+            return None
+        r = _BatchReader(buf.raw[:n])
+        batch_id = r.i64()
+        op = r.i32()
+        reduce_op = r.i32()
+        root_rank = r.i32()
+        prescale = r.f64()
+        postscale = r.f64()
+        dtype = r.i32()
+        total_bytes = r.i64()
+        names = [r.s() for _ in range(r.i32())]
+        handles = r.vec64()
+        first_shape = r.vec64()
+        error_reason = r.s()
+        return ExecutionBatch(batch_id, op, reduce_op, root_rank, prescale,
+                              postscale, dtype, total_bytes, names, handles,
+                              first_shape, error_reason)
+
+    def batch_done(self, batch: ExecutionBatch, ok: bool = True) -> None:
+        arr = (ctypes.c_longlong * len(batch.handles))(*batch.handles)
+        self._lib.hvd_native_batch_done(
+            batch.batch_id, arr, len(batch.handles), 1 if ok else 0
+        )
+
+    def last_error(self) -> str:
+        return self._lib.hvd_native_last_error().decode()
+
+    def stall_warnings(self) -> int:
+        return self._lib.hvd_native_stall_warnings()
+
+    def cache_hits(self) -> int:
+        return self._lib.hvd_native_cache_hits()
+
+    def bytes_negotiated(self) -> int:
+        return self._lib.hvd_native_bytes_negotiated()
+
+    def coordinator_port(self) -> int:
+        return self._lib.hvd_native_coordinator_port()

@@ -1,0 +1,138 @@
+// Core types for the native control-plane runtime.
+//
+// Reference surface: /root/reference/horovod/common/common.h:318-349
+// (Tensor/OpContext abstractions), message.h:50,159 (Request/Response).
+//
+// TPU-native split: the reference's C++ runtime owns both negotiation
+// (which tensors are globally ready, in what fused order) and execution
+// (NCCL/MPI). Here the data plane is XLA collectives driven from Python,
+// so this runtime is the *control plane only*: readiness negotiation,
+// deterministic fusion order, response caching, stall detection. What it
+// hands back to the caller is an ordered stream of fused execution
+// batches, the exact analog of the reference controller's ResponseList
+// (controller.cc:75 ComputeResponseList).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+enum class DataType : int32_t {
+  kUint8 = 0,
+  kInt8 = 1,
+  kUint16 = 2,
+  kInt16 = 3,
+  kInt32 = 4,
+  kInt64 = 5,
+  kFloat16 = 6,
+  kFloat32 = 7,
+  kFloat64 = 8,
+  kBool = 9,
+  kBFloat16 = 10,  // TPU-native wire type
+};
+
+inline int64_t DataTypeSize(DataType t) {
+  switch (t) {
+    case DataType::kUint8:
+    case DataType::kInt8:
+    case DataType::kBool:
+      return 1;
+    case DataType::kUint16:
+    case DataType::kInt16:
+    case DataType::kFloat16:
+    case DataType::kBFloat16:
+      return 2;
+    case DataType::kInt32:
+    case DataType::kFloat32:
+      return 4;
+    default:
+      return 8;
+  }
+}
+
+enum class OpType : int32_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kAlltoall = 3,
+  kReducescatter = 4,
+  kJoin = 5,
+  kBarrier = 6,
+  kError = 7,  // response-only: negotiation failure delivered to all ranks
+};
+
+enum class StatusType : int32_t {
+  kOk = 0,
+  kUnknownError = 1,
+  kPreconditionError = 2,
+  kAborted = 3,
+  kInvalidArgument = 4,
+  kInProgress = 5,
+};
+
+struct Status {
+  StatusType type = StatusType::kOk;
+  std::string reason;
+  bool ok() const { return type == StatusType::kOk; }
+  static Status OK() { return {}; }
+  static Status Invalid(std::string r) {
+    return {StatusType::kInvalidArgument, std::move(r)};
+  }
+  static Status Error(std::string r) {
+    return {StatusType::kUnknownError, std::move(r)};
+  }
+};
+
+// Worker -> coordinator: "rank R is ready to run op on tensor N"
+// (reference message.h:50).
+struct Request {
+  int32_t rank = 0;
+  OpType op = OpType::kAllreduce;
+  DataType dtype = DataType::kFloat32;
+  std::string name;
+  int32_t root_rank = 0;          // broadcast only
+  int32_t reduce_op = 0;          // ReduceOp id (mpi_ops.py:60 values)
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<int64_t> shape;
+
+  int64_t NumElements() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  int64_t ByteSize() const { return NumElements() * DataTypeSize(dtype); }
+};
+
+// Coordinator -> all ranks: "run this (possibly fused) op now"
+// (reference message.h:159). tensor_names order is the fusion order every
+// rank must follow.
+struct Response {
+  OpType op = OpType::kAllreduce;
+  std::vector<std::string> tensor_names;
+  std::string error_reason;  // op == kError
+  int32_t root_rank = 0;
+  int32_t reduce_op = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  DataType dtype = DataType::kFloat32;
+  int64_t total_bytes = 0;
+  std::vector<int64_t> first_shape;  // representative shape (validation)
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  std::vector<uint64_t> cache_bits;  // bitvector of cache-hit positions
+  bool shutdown = false;
+  bool join = false;
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+  int32_t join_count = 0;
+};
+
+}  // namespace hvd

@@ -1,0 +1,313 @@
+#include "controller.h"
+
+#include <cstdio>
+
+#include "wire.h"
+
+namespace hvd {
+
+TcpController::TcpController(const ControllerOptions& opts)
+    : opts_(opts),
+      stall_inspector_(opts.stall_warning_s, opts.stall_shutdown_s) {}
+
+bool TcpController::Initialize() {
+  if (opts_.size == 1) return true;
+  if (opts_.rank == 0) {
+    if (!listener_.Listen(opts_.coordinator_port)) return false;
+    bound_port_ = listener_.bound_port();
+    worker_socks_.resize(opts_.size - 1);
+    int connected = 0;
+    while (connected < opts_.size - 1) {
+      Socket s = listener_.Accept(opts_.connect_timeout_s);
+      if (!s.valid()) return false;
+      std::vector<uint8_t> frame;
+      if (!s.RecvFrame(&frame) || frame.size() < 4) return false;
+      int32_t rank;
+      std::copy(frame.begin(), frame.begin() + 4,
+                reinterpret_cast<uint8_t*>(&rank));
+      if (rank < 1 || rank >= opts_.size || worker_socks_[rank - 1].valid()) {
+        return false;
+      }
+      worker_socks_[rank - 1] = std::move(s);
+      ++connected;
+    }
+    return true;
+  }
+  if (!coord_sock_.Connect(opts_.coordinator_addr, opts_.coordinator_port,
+                           opts_.connect_timeout_s)) {
+    return false;
+  }
+  std::vector<uint8_t> frame(4);
+  std::copy(reinterpret_cast<uint8_t*>(&opts_.rank),
+            reinterpret_cast<uint8_t*>(&opts_.rank) + 4, frame.begin());
+  return coord_sock_.SendFrame(frame);
+}
+
+ResponseList TcpController::ErrorList(const std::string& reason) {
+  ResponseList rl;
+  Response r;
+  r.op = OpType::kError;
+  r.error_reason = reason;
+  rl.responses.push_back(r);
+  return rl;
+}
+
+ResponseList TcpController::RunCycle(const RequestList& own) {
+  // size==1 runs the coordinator logic with no transport
+  return opts_.rank == 0 ? CoordinatorCycle(own) : WorkerCycle(own);
+}
+
+ResponseList TcpController::WorkerCycle(const RequestList& own) {
+  if (!coord_sock_.SendFrame(SerializeRequestList(own))) {
+    return ErrorList("lost connection to coordinator (send)");
+  }
+  std::vector<uint8_t> frame;
+  if (!coord_sock_.RecvFrame(&frame)) {
+    return ErrorList("lost connection to coordinator (recv)");
+  }
+  ResponseList rl;
+  if (!DeserializeResponseList(frame.data(), frame.size(), &rl)) {
+    return ErrorList("malformed response list");
+  }
+  return rl;
+}
+
+void TcpController::IncrementTensorCount(const Request& req, int32_t rank) {
+  // reference: controller.cc:1006 — first request creates the record;
+  // metadata must agree with what rank 0 of the record submitted
+  auto it = message_table_.find(req.name);
+  if (it == message_table_.end()) {
+    TensorRecord rec;
+    rec.requests[rank] = req;
+    rec.ranks.insert(rank);
+    message_table_[req.name] = std::move(rec);
+    stall_inspector_.RecordRank(req.name, rank);
+    return;
+  }
+  TensorRecord& rec = it->second;
+  if (rec.ranks.count(rank)) {
+    rec.error = "rank " + std::to_string(rank) +
+                " submitted tensor '" + req.name + "' twice in one step";
+  }
+  const Request& first = rec.requests.begin()->second;
+  // validation mirrors ConstructResponse (controller.cc:497): op, dtype
+  // and shape must be consistent; allgather tolerates differing first dim
+  if (req.op != first.op) {
+    rec.error = "mismatched op types for tensor '" + req.name + "'";
+  } else if (req.dtype != first.dtype) {
+    rec.error = "mismatched dtypes for tensor '" + req.name + "'";
+  } else if (req.op == OpType::kBroadcast &&
+             req.root_rank != first.root_rank) {
+    rec.error = "mismatched broadcast root for tensor '" + req.name + "'";
+  } else if (req.op != OpType::kAllgather && req.shape != first.shape) {
+    rec.error = "mismatched shapes for tensor '" + req.name + "'";
+  } else if (req.op == OpType::kAllgather) {
+    if (req.shape.size() != first.shape.size()) {
+      rec.error = "mismatched ranks for allgather tensor '" + req.name + "'";
+    } else {
+      for (size_t d = 1; d < req.shape.size(); ++d) {
+        if (req.shape[d] != first.shape[d]) {
+          rec.error =
+              "mismatched non-first dims for allgather tensor '" +
+              req.name + "'";
+        }
+      }
+    }
+  }
+  rec.requests[rank] = req;
+  rec.ranks.insert(rank);
+  stall_inspector_.RecordRank(req.name, rank);
+}
+
+Response TcpController::ConstructResponse(const std::string& name) {
+  TensorRecord& rec = message_table_[name];
+  const Request& first = rec.requests.begin()->second;
+  Response resp;
+  if (!rec.error.empty()) {
+    resp.op = OpType::kError;
+    resp.error_reason = rec.error;
+    resp.tensor_names = {name};
+    return resp;
+  }
+  resp.op = first.op;
+  resp.tensor_names = {name};
+  resp.root_rank = first.root_rank;
+  resp.reduce_op = first.reduce_op;
+  resp.prescale = first.prescale;
+  resp.postscale = first.postscale;
+  resp.dtype = first.dtype;
+  resp.first_shape = first.shape;
+  // allgather: total bytes sums every rank's first dim
+  if (first.op == OpType::kAllgather) {
+    for (const auto& kv : rec.requests) resp.total_bytes += kv.second.ByteSize();
+  } else {
+    resp.total_bytes = first.ByteSize();
+  }
+  return resp;
+}
+
+std::vector<Response> TcpController::FuseResponses(
+    std::vector<Response> ready) {
+  // reference: controller.cc:830 — merge responses of the same kind up to
+  // the fusion threshold, with lookahead past non-matching entries (a
+  // mixed-dtype tensor between two f32 tensors must not break the f32
+  // bucket). Emitted order = first-constituent order; every rank receives
+  // the fused list verbatim, so fusion is trivially consistent.
+  std::vector<Response> out;
+  // fusion key -> index of the open (not-yet-full) batch in `out`
+  std::map<std::string, size_t> open;
+  for (auto& r : ready) {
+    bool fusable_kind =
+        (r.op == OpType::kAllreduce || r.op == OpType::kAllgather ||
+         r.op == OpType::kReducescatter) &&
+        r.tensor_names.size() == 1;
+    if (!fusable_kind) {
+      out.push_back(std::move(r));
+      continue;
+    }
+    std::string key = std::to_string(static_cast<int>(r.op)) + "/" +
+                      std::to_string(static_cast<int>(r.dtype)) + "/" +
+                      std::to_string(r.reduce_op) + "/" +
+                      std::to_string(r.root_rank) + "/" +
+                      std::to_string(r.prescale) + "/" +
+                      std::to_string(r.postscale);
+    auto it = open.find(key);
+    if (it != open.end() &&
+        out[it->second].total_bytes + r.total_bytes <=
+            opts_.fusion_threshold_bytes) {
+      out[it->second].tensor_names.push_back(r.tensor_names[0]);
+      out[it->second].total_bytes += r.total_bytes;
+    } else {
+      open[key] = out.size();
+      out.push_back(std::move(r));
+    }
+  }
+  return out;
+}
+
+ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
+  // 1. gather every worker's RequestList (rank order; lock-step cycle)
+  std::vector<RequestList> all(opts_.size);
+  all[0] = own;
+  for (int32_t r = 1; r < opts_.size; ++r) {
+    std::vector<uint8_t> frame;
+    if (!worker_socks_[r - 1].RecvFrame(&frame) ||
+        !DeserializeRequestList(frame.data(), frame.size(), &all[r])) {
+      ResponseList err = ErrorList("lost connection to rank " +
+                                   std::to_string(r));
+      err.shutdown = true;
+      for (int32_t w = 1; w < opts_.size; ++w) {
+        if (w != r) {
+          worker_socks_[w - 1].SendFrame(SerializeResponseList(err));
+        }
+      }
+      return err;
+    }
+  }
+
+  bool shutdown = false;
+  for (int32_t r = 0; r < opts_.size; ++r) {
+    shutdown = shutdown || all[r].shutdown;
+    if (all[r].join) joined_ranks_.insert(r);
+  }
+
+  // 2. agreed cache hits: AND of all cache bitvectors; joined ranks agree
+  // with everything (they contribute zeros) — reference response_cache
+  // CacheCoordinator semantics
+  std::vector<uint32_t> agreed_positions;
+  if (cache != nullptr && cache->capacity() > 0) {
+    std::vector<std::vector<uint64_t>> bitsets;
+    for (int32_t r = 0; r < opts_.size; ++r) {
+      if (!joined_ranks_.count(r)) bitsets.push_back(all[r].cache_bits);
+    }
+    if (!bitsets.empty()) {
+      agreed_positions =
+          ResponseCache::BitsToPositions(ResponseCache::Intersect(bitsets));
+    }
+  }
+
+  // 3. count full submissions
+  for (int32_t r = 0; r < opts_.size; ++r) {
+    for (const auto& req : all[r].requests) {
+      if (req.op == OpType::kBarrier) {
+        barrier_ranks_.insert(r);
+        continue;
+      }
+      auto before = message_table_.count(req.name)
+                        ? message_table_[req.name].ranks.size()
+                        : 0;
+      IncrementTensorCount(req, r);
+      (void)before;
+    }
+  }
+
+  // 4. readiness: submitted ∪ joined covers the world
+  std::vector<Response> ready;
+  for (uint32_t pos : agreed_positions) {
+    Response resp = cache->Get(pos);
+    ready.push_back(resp);
+  }
+  std::vector<std::string> done;
+  for (auto& kv : message_table_) {
+    size_t covered = kv.second.ranks.size();
+    for (int32_t jr : joined_ranks_) {
+      if (!kv.second.ranks.count(jr)) ++covered;
+    }
+    if (static_cast<int32_t>(covered) >= opts_.size) {
+      done.push_back(kv.first);
+    }
+  }
+  // deterministic order: sort newly-ready by name (completion order across
+  // a cycle is unordered anyway since all arrive in the same gather)
+  std::sort(done.begin(), done.end());
+  for (const auto& name : done) {
+    ready.push_back(ConstructResponse(name));
+    message_table_.erase(name);
+    stall_inspector_.RemoveTensor(name);
+  }
+
+  // 5. join / barrier completion
+  ResponseList rl;
+  if (static_cast<int32_t>(joined_ranks_.size()) >= opts_.size) {
+    Response j;
+    j.op = OpType::kJoin;
+    rl.join_count = static_cast<int32_t>(joined_ranks_.size());
+    ready.push_back(j);
+    joined_ranks_.clear();
+  }
+  if (static_cast<int32_t>(barrier_ranks_.size()) >= opts_.size) {
+    Response b;
+    b.op = OpType::kBarrier;
+    b.tensor_names = {"__barrier__"};  // resolves the worker-side handle
+    ready.push_back(b);
+    barrier_ranks_.clear();
+  }
+
+  // 6. stall check
+  if (stall_inspector_.enabled()) {
+    bool kill = stall_inspector_.Check(opts_.size, [&](const std::string& m) {
+      ++stall_warnings_;
+      fprintf(stderr, "[hvd_tpu_core] WARNING: %s\n", m.c_str());
+    });
+    if (kill) {
+      ready.clear();
+      Response r;
+      r.op = OpType::kError;
+      r.error_reason = "stall shutdown threshold exceeded";
+      ready.push_back(r);
+      shutdown = true;
+    }
+  }
+
+  rl.responses = FuseResponses(std::move(ready));
+  rl.shutdown = shutdown;
+
+  // 7. broadcast the agreed list
+  auto frame = SerializeResponseList(rl);
+  for (int32_t r = 1; r < opts_.size; ++r) {
+    worker_socks_[r - 1].SendFrame(frame);
+  }
+  return rl;
+}
+
+}  // namespace hvd

@@ -1,0 +1,103 @@
+// Coordinator negotiation protocol + response fusion.
+//
+// Reference: /root/reference/horovod/common/controller.{h,cc} —
+// `ComputeResponseList` (controller.cc:75), `IncrementTensorCount`
+// (:1006), `ConstructResponse` shape/dtype validation (:497),
+// `FuseResponses` (:830), cache coordination (:802); protocol spec
+// controller.h:74-111. Transport here is a TCP star (rank 0 coordinates)
+// rather than MPI/Gloo collectives; the protocol is the same:
+//
+//   worker  -> coordinator : RequestList (new requests + cache-hit bits)
+//   coordinator            : count submissions; tensor ready when every
+//                            rank has submitted (or joined); validate
+//                            metadata; agreed cache hits short-circuit
+//   coordinator -> workers : ResponseList (fused, deterministic order)
+//
+// Every rank executes the ResponseList verbatim — that is what makes
+// asynchronously-submitted ops run as identical fused collectives in
+// identical order on all ranks (SURVEY.md §5.8).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+#include "tcp.h"
+
+namespace hvd {
+
+struct ControllerOptions {
+  int32_t rank = 0;
+  int32_t size = 1;
+  std::string coordinator_addr = "127.0.0.1";
+  int32_t coordinator_port = 0;  // worker: port to connect to;
+                                 // coordinator: 0 = ephemeral
+  double connect_timeout_s = 60.0;
+  int64_t fusion_threshold_bytes = 128ll * 1024 * 1024;
+  double stall_warning_s = 60.0;
+  double stall_shutdown_s = 0.0;
+};
+
+class TcpController {
+ public:
+  explicit TcpController(const ControllerOptions& opts);
+
+  // Coordinator: bind + accept size-1 workers (handshake = rank frame).
+  // Worker: connect + send rank. Returns false on transport failure.
+  bool Initialize();
+
+  // After Initialize on rank 0: the actual port (for ephemeral binds).
+  int bound_port() const { return bound_port_; }
+
+  // One synchronized negotiation cycle. `own` is this rank's drained
+  // requests + cache bits; returns the globally-agreed response list.
+  // On transport failure returns a list with a single kError response.
+  ResponseList RunCycle(const RequestList& own);
+
+  int64_t stall_warnings() const { return stall_warnings_; }
+
+ private:
+  ResponseList CoordinatorCycle(const RequestList& own);
+  ResponseList WorkerCycle(const RequestList& own);
+
+  // --- coordinator-side negotiation state (reference controller.cc) ---
+  void IncrementTensorCount(const Request& req, int32_t rank);
+  Response ConstructResponse(const std::string& name);
+  std::vector<Response> FuseResponses(std::vector<Response> ready);
+  static ResponseList ErrorList(const std::string& reason);
+
+  ControllerOptions opts_;
+  int bound_port_ = 0;
+
+  // transport
+  Listener listener_;                 // coordinator
+  std::vector<Socket> worker_socks_; // coordinator: index = rank-1
+  Socket coord_sock_;                 // worker
+
+  // per-tensor submission table: name -> per-rank request + rank set
+  struct TensorRecord {
+    std::map<int32_t, Request> requests;
+    std::set<int32_t> ranks;
+    std::string error;  // first metadata mismatch
+  };
+  std::unordered_map<std::string, TensorRecord> message_table_;
+  std::set<int32_t> joined_ranks_;
+  std::set<int32_t> barrier_ranks_;
+
+  StallInspector stall_inspector_;
+  int64_t stall_warnings_ = 0;
+
+ public:
+  // The coordinator needs a cache replica to resolve cache-bit positions
+  // to names; set by the runtime which owns the per-rank cache.
+  ResponseCache* cache = nullptr;
+};
+
+}  // namespace hvd

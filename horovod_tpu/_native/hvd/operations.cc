@@ -1,0 +1,442 @@
+// Per-process runtime: background negotiation thread + C API.
+//
+// Reference: /root/reference/horovod/common/operations.cc —
+// `InitializeHorovodOnce` (:827) spawns the background thread,
+// `BackgroundThreadLoop` (:401) / `RunLoopOnce` (:722) drive negotiation
+// cycles, `EnqueueTensorAllreduces` (:1400) is the entry point, and the C
+// API (:903-1370) backs the Python ctypes layer (common/basics.py).
+//
+// TPU split: after negotiation this runtime does NOT execute collectives —
+// it emits ordered *execution batches* that the Python layer runs as XLA
+// collectives over the global mesh (hvd_native_next_batch /
+// hvd_native_batch_done). The background thread owns all communication
+// state; user threads only touch the queue and handle table (the
+// reference's single-proxy-thread design rationale, operations.cc:379-398).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "controller.h"
+#include "response_cache.h"
+#include "tensor_queue.h"
+#include "wire.h"
+
+namespace hvd {
+namespace {
+
+enum HandleState : int {
+  kPending = 0,
+  kBatched = 1,
+  kDone = 2,
+  kFailed = -1,
+};
+
+struct Batch {
+  int64_t id = 0;
+  Response response;
+  std::vector<int64_t> handles;
+};
+
+struct Global {
+  std::unique_ptr<TcpController> controller;
+  TensorQueue tensor_queue;
+  std::unique_ptr<ResponseCache> cache;
+
+  std::thread bg_thread;
+  std::atomic<bool> shutdown{false};
+  std::atomic<bool> broken{false};
+  std::atomic<bool> initialized{false};
+  std::atomic<int64_t> handle_counter{1};
+  std::atomic<int64_t> batch_counter{1};
+  std::atomic<int64_t> cache_hits{0};
+  std::atomic<int64_t> bytes_negotiated{0};
+
+  std::mutex handle_mu;
+  std::condition_variable handle_cv;
+  std::unordered_map<int64_t, int> handle_states;
+
+  std::mutex batch_mu;
+  std::condition_variable batch_cv;
+  std::deque<Batch> batches;
+
+  std::mutex join_mu;
+  std::vector<int64_t> join_handles;
+  std::atomic<bool> join_requested{false};
+
+  // requests held aside because they cache-hit, awaiting global agreement
+  std::unordered_map<std::string, Request> pending_hits;
+
+  double cycle_ms = 1.0;
+  int32_t rank = 0;
+  int32_t size = 1;
+
+  std::mutex err_mu;
+  std::string last_error;
+};
+
+Global* g = nullptr;
+
+void SetError(const std::string& e) {
+  std::lock_guard<std::mutex> l(g->err_mu);
+  g->last_error = e;
+}
+
+void SetHandle(int64_t h, int state) {
+  {
+    std::lock_guard<std::mutex> l(g->handle_mu);
+    g->handle_states[h] = state;
+  }
+  g->handle_cv.notify_all();
+}
+
+void FailHandles(const std::vector<int64_t>& hs, const std::string& why) {
+  if (!why.empty()) SetError(why);
+  {
+    std::lock_guard<std::mutex> l(g->handle_mu);
+    for (int64_t h : hs) g->handle_states[h] = kFailed;
+  }
+  g->handle_cv.notify_all();
+}
+
+void PushBatch(Batch b) {
+  {
+    std::lock_guard<std::mutex> l(g->batch_mu);
+    g->batches.push_back(std::move(b));
+  }
+  g->batch_cv.notify_all();
+}
+
+// One negotiation cycle (reference RunLoopOnce, operations.cc:722).
+// Returns false to stop the loop.
+bool RunLoopOnce() {
+  RequestList own;
+
+  // drain new requests, classify against the cache
+  auto drained = g->tensor_queue.PopMessages(512);
+  bool cache_on = g->cache && g->cache->capacity() > 0;
+  for (auto& req : drained) {
+    if (cache_on) {
+      auto state = g->cache->Lookup(req);
+      if (state == ResponseCache::State::kHit) {
+        g->pending_hits[req.name] = req;
+        g->cache_hits.fetch_add(1);
+        continue;
+      }
+      if (state == ResponseCache::State::kInvalid) {
+        g->cache->Erase(req.name);
+      }
+    }
+    own.requests.push_back(std::move(req));
+  }
+  if (cache_on && !g->pending_hits.empty()) {
+    std::vector<uint32_t> positions;
+    positions.reserve(g->pending_hits.size());
+    for (const auto& kv : g->pending_hits) {
+      positions.push_back(g->cache->Position(kv.first));
+    }
+    own.cache_bits = g->cache->HitBits(positions);
+  }
+  own.join = g->join_requested.load();
+  own.shutdown = g->shutdown.load();
+
+  ResponseList rl = g->controller->RunCycle(own);
+
+  for (auto& resp : rl.responses) {
+    if (resp.op == OpType::kError && resp.tensor_names.empty()) {
+      // global/transport error: fail everything pending
+      auto all = g->tensor_queue.DrainAll();
+      for (const auto& kv : g->pending_hits) {
+        auto hs = g->tensor_queue.PopEntries({kv.first});
+        all.insert(all.end(), hs.begin(), hs.end());
+      }
+      g->pending_hits.clear();
+      g->broken.store(true);
+      FailHandles(all, resp.error_reason);
+      continue;
+    }
+    if (resp.op == OpType::kJoin) {
+      std::vector<int64_t> hs;
+      {
+        std::lock_guard<std::mutex> l(g->join_mu);
+        hs.swap(g->join_handles);
+      }
+      g->join_requested.store(false);
+      Batch b;
+      b.id = g->batch_counter.fetch_add(1);
+      b.response = resp;
+      b.handles = hs;
+      for (int64_t h : hs) SetHandle(h, kBatched);
+      PushBatch(std::move(b));
+      continue;
+    }
+
+    std::vector<int64_t> handles = g->tensor_queue.PopEntries(
+        resp.tensor_names);
+    if (resp.op == OpType::kError) {
+      for (const auto& n : resp.tensor_names) g->pending_hits.erase(n);
+      FailHandles(handles, resp.error_reason);
+      continue;
+    }
+    // refresh/insert cache entries in response order — identical on every
+    // rank, which keeps cache positions replicated (response_cache.h:45)
+    if (cache_on) {
+      for (const auto& name : resp.tensor_names) {
+        Request req;
+        bool have = false;
+        auto hit = g->pending_hits.find(name);
+        if (hit != g->pending_hits.end()) {
+          req = hit->second;
+          g->pending_hits.erase(hit);
+          have = true;
+        } else {
+          // find the request metadata from the response itself
+          req.name = name;
+          req.op = resp.op;
+          req.dtype = resp.dtype;
+          req.reduce_op = resp.reduce_op;
+          req.root_rank = resp.root_rank;
+          req.prescale = resp.prescale;
+          req.postscale = resp.postscale;
+          req.shape = resp.first_shape;
+          have = true;
+        }
+        if (have && resp.op != OpType::kBarrier) {
+          Response single = resp;
+          single.tensor_names = {name};
+          single.total_bytes = req.ByteSize();
+          g->cache->Put(single, req);
+        }
+      }
+    } else {
+      for (const auto& n : resp.tensor_names) g->pending_hits.erase(n);
+    }
+    g->bytes_negotiated.fetch_add(resp.total_bytes);
+    Batch b;
+    b.id = g->batch_counter.fetch_add(1);
+    b.response = resp;
+    b.handles = handles;
+    for (int64_t h : handles) SetHandle(h, kBatched);
+    PushBatch(std::move(b));
+  }
+
+  return !rl.shutdown;
+}
+
+void BackgroundLoop() {
+  auto cycle = std::chrono::duration<double, std::milli>(g->cycle_ms);
+  while (true) {
+    auto start = std::chrono::steady_clock::now();
+    if (!RunLoopOnce()) break;
+    if (g->shutdown.load() && g->tensor_queue.pending() == 0) break;
+    auto elapsed = std::chrono::steady_clock::now() - start;
+    if (elapsed < cycle) {
+      std::this_thread::sleep_for(cycle - elapsed);
+    }
+  }
+  // fail anything still pending so no waiter blocks forever
+  auto rest = g->tensor_queue.DrainAll();
+  FailHandles(rest, rest.empty() ? "" : "runtime shut down");
+  g->batch_cv.notify_all();
+  g->initialized.store(false);
+}
+
+}  // namespace
+}  // namespace hvd
+
+using namespace hvd;
+
+extern "C" {
+
+int hvd_native_init(int rank, int size, const char* coord_addr,
+                    int coord_port, double cycle_ms, long long fusion_bytes,
+                    int cache_capacity, double stall_warning_s,
+                    double stall_shutdown_s) {
+  if (g != nullptr && g->initialized.load()) return 0;
+  delete g;
+  g = new Global();
+  g->rank = rank;
+  g->size = size;
+  g->cycle_ms = cycle_ms;
+  g->cache.reset(new ResponseCache(
+      cache_capacity < 0 ? 0 : static_cast<size_t>(cache_capacity)));
+  ControllerOptions opts;
+  opts.rank = rank;
+  opts.size = size;
+  opts.coordinator_addr = coord_addr ? coord_addr : "127.0.0.1";
+  opts.coordinator_port = coord_port;
+  opts.fusion_threshold_bytes = fusion_bytes;
+  opts.stall_warning_s = stall_warning_s;
+  opts.stall_shutdown_s = stall_shutdown_s;
+  g->controller.reset(new TcpController(opts));
+  g->controller->cache = g->cache.get();
+  if (!g->controller->Initialize()) {
+    SetError("controller transport initialization failed");
+    return -1;
+  }
+  g->initialized.store(true);
+  g->bg_thread = std::thread(BackgroundLoop);
+  return 0;
+}
+
+void hvd_native_shutdown() {
+  if (g == nullptr) return;
+  g->shutdown.store(true);
+  if (g->bg_thread.joinable()) g->bg_thread.join();
+}
+
+int hvd_native_initialized() {
+  return g != nullptr && g->initialized.load() ? 1 : 0;
+}
+
+int hvd_native_rank() { return g ? g->rank : -1; }
+int hvd_native_size() { return g ? g->size : -1; }
+
+long long hvd_native_enqueue(const char* name, int op, int dtype,
+                             const long long* shape, int ndim, int reduce_op,
+                             int root_rank, double prescale,
+                             double postscale) {
+  if (g == nullptr || !g->initialized.load() || g->broken.load()) return -1;
+  Request req;
+  req.rank = g->rank;
+  req.op = static_cast<OpType>(op);
+  req.dtype = static_cast<DataType>(dtype);
+  req.name = name;
+  req.root_rank = root_rank;
+  req.reduce_op = reduce_op;
+  req.prescale = prescale;
+  req.postscale = postscale;
+  for (int i = 0; i < ndim; ++i) req.shape.push_back(shape[i]);
+  int64_t h = g->handle_counter.fetch_add(1);
+  SetHandle(h, kPending);
+  if (!g->tensor_queue.Add(req, h)) {
+    SetError("tensor '" + req.name + "' already pending (duplicate name)");
+    SetHandle(h, kFailed);
+    return h;
+  }
+  return h;
+}
+
+long long hvd_native_join() {
+  if (g == nullptr || !g->initialized.load()) return -1;
+  int64_t h = g->handle_counter.fetch_add(1);
+  SetHandle(h, kPending);
+  {
+    std::lock_guard<std::mutex> l(g->join_mu);
+    g->join_handles.push_back(h);
+  }
+  g->join_requested.store(true);
+  return h;
+}
+
+long long hvd_native_barrier() {
+  long long shape[1] = {0};
+  return hvd_native_enqueue("__barrier__", static_cast<int>(OpType::kBarrier),
+                            0, shape, 0, 0, 0, 1.0, 1.0);
+}
+
+int hvd_native_poll(long long handle) {
+  if (g == nullptr) return kFailed;
+  std::lock_guard<std::mutex> l(g->handle_mu);
+  auto it = g->handle_states.find(handle);
+  return it == g->handle_states.end() ? kFailed : it->second;
+}
+
+int hvd_native_wait(long long handle, double timeout_s) {
+  if (g == nullptr) return kFailed;
+  std::unique_lock<std::mutex> l(g->handle_mu);
+  auto pred = [&] {
+    auto it = g->handle_states.find(handle);
+    return it != g->handle_states.end() &&
+           (it->second == kDone || it->second == kFailed ||
+            it->second == kBatched);
+  };
+  if (!g->handle_cv.wait_for(
+          l, std::chrono::duration<double>(timeout_s), pred)) {
+    return kPending;
+  }
+  return g->handle_states[handle];
+}
+
+// Serialized batch: id, op, reduce_op, root_rank, prescale, postscale,
+// dtype, total_bytes, names, handles, first_shape, error_reason.
+long long hvd_native_next_batch(unsigned char* buf, long long buflen,
+                                double timeout_s) {
+  if (g == nullptr) return -1;
+  Batch b;
+  {
+    std::unique_lock<std::mutex> l(g->batch_mu);
+    if (!g->batch_cv.wait_for(l, std::chrono::duration<double>(timeout_s),
+                              [] { return !g->batches.empty() ||
+                                          !g->initialized.load(); })) {
+      return 0;
+    }
+    if (g->batches.empty()) return 0;
+    b = std::move(g->batches.front());
+    g->batches.pop_front();
+  }
+  Writer w;
+  w.I64(b.id);
+  w.I32(static_cast<int32_t>(b.response.op));
+  w.I32(b.response.reduce_op);
+  w.I32(b.response.root_rank);
+  w.F64(b.response.prescale);
+  w.F64(b.response.postscale);
+  w.I32(static_cast<int32_t>(b.response.dtype));
+  w.I64(b.response.total_bytes);
+  w.I32(static_cast<int32_t>(b.response.tensor_names.size()));
+  for (const auto& n : b.response.tensor_names) w.Str(n);
+  w.Vec(b.handles);
+  w.Vec(b.response.first_shape);
+  w.Str(b.response.error_reason);
+  if (static_cast<long long>(w.data().size()) > buflen) return -1;
+  std::memcpy(buf, w.data().data(), w.data().size());
+  return static_cast<long long>(w.data().size());
+}
+
+void hvd_native_batch_done(long long batch_id, const long long* handles,
+                           int n, int ok) {
+  (void)batch_id;
+  if (g == nullptr) return;
+  {
+    std::lock_guard<std::mutex> l(g->handle_mu);
+    for (int i = 0; i < n; ++i) {
+      g->handle_states[handles[i]] = ok ? kDone : kFailed;
+    }
+  }
+  g->handle_cv.notify_all();
+}
+
+const char* hvd_native_last_error() {
+  static thread_local std::string copy;
+  if (g == nullptr) return "";
+  std::lock_guard<std::mutex> l(g->err_mu);
+  copy = g->last_error;
+  return copy.c_str();
+}
+
+long long hvd_native_stall_warnings() {
+  return g && g->controller ? g->controller->stall_warnings() : 0;
+}
+
+long long hvd_native_cache_hits() { return g ? g->cache_hits.load() : 0; }
+
+long long hvd_native_bytes_negotiated() {
+  return g ? g->bytes_negotiated.load() : 0;
+}
+
+int hvd_native_coordinator_port() {
+  return g && g->controller ? g->controller->bound_port() : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,109 @@
+#include "wire.h"
+
+namespace hvd {
+
+static void WriteRequest(Writer* w, const Request& r) {
+  w->I32(r.rank);
+  w->I32(static_cast<int32_t>(r.op));
+  w->I32(static_cast<int32_t>(r.dtype));
+  w->Str(r.name);
+  w->I32(r.root_rank);
+  w->I32(r.reduce_op);
+  w->F64(r.prescale);
+  w->F64(r.postscale);
+  w->Vec(r.shape);
+}
+
+static Request ReadRequest(Reader* r) {
+  Request q;
+  q.rank = r->I32();
+  q.op = static_cast<OpType>(r->I32());
+  q.dtype = static_cast<DataType>(r->I32());
+  q.name = r->Str();
+  q.root_rank = r->I32();
+  q.reduce_op = r->I32();
+  q.prescale = r->F64();
+  q.postscale = r->F64();
+  q.shape = r->Vec<int64_t>();
+  return q;
+}
+
+std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
+  Writer w;
+  w.U8(rl.shutdown ? 1 : 0);
+  w.U8(rl.join ? 1 : 0);
+  w.Vec(rl.cache_bits);
+  w.I32(static_cast<int32_t>(rl.requests.size()));
+  for (const auto& r : rl.requests) WriteRequest(&w, r);
+  return w.data();
+}
+
+bool DeserializeRequestList(const uint8_t* data, size_t len,
+                            RequestList* rl) {
+  Reader r(data, len);
+  rl->shutdown = r.U8() != 0;
+  rl->join = r.U8() != 0;
+  rl->cache_bits = r.Vec<uint64_t>();
+  int32_t n = r.I32();
+  rl->requests.clear();
+  for (int32_t i = 0; i < n && r.ok(); ++i) {
+    rl->requests.push_back(ReadRequest(&r));
+  }
+  return r.ok();
+}
+
+static void WriteResponse(Writer* w, const Response& resp) {
+  w->I32(static_cast<int32_t>(resp.op));
+  w->I32(static_cast<int32_t>(resp.tensor_names.size()));
+  for (const auto& n : resp.tensor_names) w->Str(n);
+  w->Str(resp.error_reason);
+  w->I32(resp.root_rank);
+  w->I32(resp.reduce_op);
+  w->F64(resp.prescale);
+  w->F64(resp.postscale);
+  w->I32(static_cast<int32_t>(resp.dtype));
+  w->I64(resp.total_bytes);
+  w->Vec(resp.first_shape);
+}
+
+static Response ReadResponse(Reader* r) {
+  Response resp;
+  resp.op = static_cast<OpType>(r->I32());
+  int32_t n = r->I32();
+  for (int32_t i = 0; i < n && r->ok(); ++i) {
+    resp.tensor_names.push_back(r->Str());
+  }
+  resp.error_reason = r->Str();
+  resp.root_rank = r->I32();
+  resp.reduce_op = r->I32();
+  resp.prescale = r->F64();
+  resp.postscale = r->F64();
+  resp.dtype = static_cast<DataType>(r->I32());
+  resp.total_bytes = r->I64();
+  resp.first_shape = r->Vec<int64_t>();
+  return resp;
+}
+
+std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
+  Writer w;
+  w.U8(rl.shutdown ? 1 : 0);
+  w.I32(rl.join_count);
+  w.I32(static_cast<int32_t>(rl.responses.size()));
+  for (const auto& r : rl.responses) WriteResponse(&w, r);
+  return w.data();
+}
+
+bool DeserializeResponseList(const uint8_t* data, size_t len,
+                             ResponseList* rl) {
+  Reader r(data, len);
+  rl->shutdown = r.U8() != 0;
+  rl->join_count = r.I32();
+  int32_t n = r.I32();
+  rl->responses.clear();
+  for (int32_t i = 0; i < n && r.ok(); ++i) {
+    rl->responses.push_back(ReadResponse(&r));
+  }
+  return r.ok();
+}
+
+}  // namespace hvd

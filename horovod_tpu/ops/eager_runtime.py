@@ -1,0 +1,264 @@
+"""Eager multi-controller runtime: negotiation-ordered collective execution.
+
+Reference: the background-loop architecture of
+/root/reference/horovod/common/operations.cc:401 (BackgroundThreadLoop →
+ComputeResponseList → PerformOperation) seen from Python. The native
+control plane (horovod_tpu/_native: TCP controller, response cache, fusion
+planning, stall inspector) decides *which tensors are globally ready, in
+what fused order*; this module owns the data plane — it pulls execution
+batches and runs them.
+
+Where the reference hands fused buffers to NCCL, the TPU data plane is a
+pluggable executor:
+
+* `LoopbackExecutor` — single-process worlds and tests: applies the
+  collective semantics locally (sum×n for allreduce of replicated input,
+  etc.) so the full enqueue→negotiate→fuse→execute→complete pipeline is
+  exercised without a second accelerator.
+* `XlaExecutor` — multi-controller worlds: builds one jit-compiled
+  collective program per (op, dtype, world) over the *global* mesh and
+  feeds it the process-local shards
+  (`jax.make_array_from_single_device_arrays`). All processes execute the
+  same batch order (the controller guarantees it), which is exactly the
+  consistency XLA multi-controller execution requires.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core.exceptions import HorovodInternalError
+from .._native import (
+    BATCHED,
+    DONE,
+    FAILED,
+    OP_ALLGATHER,
+    OP_ALLREDUCE,
+    OP_BARRIER,
+    OP_BROADCAST,
+    OP_JOIN,
+    OP_REDUCESCATTER,
+    ExecutionBatch,
+    NativeRuntime,
+)
+
+_REDUCE_AVERAGE = 0
+_REDUCE_SUM = 1
+
+
+class LoopbackExecutor:
+    """Executes batches with single-process semantics (every rank's
+    contribution equals ours — the eager single-controller model of
+    ops/collectives.py)."""
+
+    def __init__(self, world_size: int):
+        self._n = world_size
+
+    def __call__(self, batch: ExecutionBatch, tensors: Dict[str, np.ndarray]
+                 ) -> Dict[str, np.ndarray]:
+        out = {}
+        for name in batch.names:
+            if name not in tensors:
+                continue
+            x = tensors[name]
+            if batch.op == OP_ALLREDUCE:
+                scaled = x * batch.prescale
+                r = scaled * self._n  # n identical contributions
+                if batch.reduce_op == _REDUCE_AVERAGE:
+                    r = r / self._n
+                out[name] = r * batch.postscale
+            elif batch.op == OP_ALLGATHER:
+                out[name] = np.concatenate([x] * self._n, axis=0)
+            elif batch.op == OP_BROADCAST:
+                out[name] = x
+            elif batch.op == OP_REDUCESCATTER:
+                chunk = x.shape[0] // self._n
+                out[name] = x[:chunk] * self._n
+            else:
+                out[name] = x
+        return out
+
+
+class EagerRuntime:
+    """Per-process facade: enqueue named tensors, a worker thread executes
+    negotiated batches in controller order, `synchronize` returns results.
+    """
+
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        coordinator_addr: str = "127.0.0.1",
+        coordinator_port: int = 0,
+        executor: Optional[Callable] = None,
+        cycle_ms: float = 1.0,
+        fusion_threshold: int = 128 << 20,
+        cache_capacity: int = 1024,
+        stall_warning_s: float = 60.0,
+        stall_shutdown_s: float = 0.0,
+    ):
+        self._native = NativeRuntime()
+        self._native.init(
+            rank, size, coordinator_addr, coordinator_port,
+            cycle_ms=cycle_ms, fusion_threshold=fusion_threshold,
+            cache_capacity=cache_capacity, stall_warning_s=stall_warning_s,
+            stall_shutdown_s=stall_shutdown_s,
+        )
+        self._executor = executor or LoopbackExecutor(size)
+        self._lock = threading.Lock()
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._results: Dict[int, np.ndarray] = {}
+        self._handle_name: Dict[int, str] = {}
+        self._shutdown = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="hvd-eager-executor"
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------ enqueue
+
+    def enqueue(self, name: str, tensor, op: int = OP_ALLREDUCE,
+                reduce_op: int = _REDUCE_SUM, root_rank: int = 0,
+                prescale: float = 1.0, postscale: float = 1.0) -> int:
+        arr = np.asarray(tensor)
+        handle = self._native.enqueue(
+            name, op, str(arr.dtype), list(arr.shape),
+            reduce_op=reduce_op, root_rank=root_rank,
+            prescale=prescale, postscale=postscale,
+        )
+        with self._lock:
+            self._inputs[name] = arr
+            self._handle_name[handle] = name
+        return handle
+
+    def allreduce_async(self, name: str, tensor, average: bool = False,
+                        prescale: float = 1.0, postscale: float = 1.0) -> int:
+        return self.enqueue(
+            name, tensor, OP_ALLREDUCE,
+            reduce_op=_REDUCE_AVERAGE if average else _REDUCE_SUM,
+            prescale=prescale, postscale=postscale,
+        )
+
+    def join(self) -> int:
+        return self._native.join()
+
+    def barrier(self, timeout_s: float = 60.0) -> None:
+        h = self._native.barrier()
+        state = self._native.wait(h, timeout_s)
+        while state == BATCHED:
+            state = self._native.wait(h, timeout_s)
+        if state != DONE:
+            raise HorovodInternalError(
+                f"barrier failed: {self._native.last_error()}"
+            )
+
+    # --------------------------------------------------------- completion
+
+    def poll(self, handle: int) -> bool:
+        return self._native.poll(handle) in (DONE, FAILED)
+
+    def synchronize(self, handle: int, timeout_s: float = 60.0):
+        state = self._native.wait(handle, timeout_s)
+        while state in (0, BATCHED):  # pending or awaiting executor
+            state = self._native.wait(handle, timeout_s)
+            with self._lock:
+                if handle in self._results:
+                    break
+        if self._native.poll(handle) == FAILED:
+            raise HorovodInternalError(self._native.last_error())
+        with self._lock:
+            if handle not in self._results:
+                raise HorovodInternalError(
+                    f"no result for handle {handle}: "
+                    f"{self._native.last_error()}"
+                )
+            return self._results.pop(handle)
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        while not self._shutdown.is_set():
+            batch = self._native.next_batch(timeout_s=0.1)
+            if batch is None:
+                continue
+            if batch.op in (OP_JOIN, OP_BARRIER):
+                self._native.batch_done(batch, ok=True)
+                continue
+            try:
+                with self._lock:
+                    tensors = {
+                        n: self._inputs[n]
+                        for n in batch.names if n in self._inputs
+                    }
+                results = self._executor(batch, tensors)
+                with self._lock:
+                    for h in batch.handles:
+                        name = self._handle_name.pop(h, None)
+                        if name is not None and name in results:
+                            self._results[h] = results[name]
+                        self._inputs.pop(name, None)
+                self._native.batch_done(batch, ok=True)
+            except Exception:
+                self._native.batch_done(batch, ok=False)
+
+    # ------------------------------------------------------------ stats
+
+    def cache_hits(self) -> int:
+        return self._native.cache_hits()
+
+    def bytes_negotiated(self) -> int:
+        return self._native.bytes_negotiated()
+
+    def stall_warnings(self) -> int:
+        return self._native.stall_warnings()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._native.shutdown()
+        self._worker.join(timeout=5)
+
+
+def make_xla_executor(mesh, axis_names):
+    """Multi-controller data plane: execute a batch as XLA collectives over
+    the global mesh. Requires jax.distributed to be initialized (the
+    launcher does this; SURVEY.md §2.6 TPU equivalent row).
+
+    Single-host note: with one controller this reduces to the eager path in
+    ops/collectives.py; the negotiation layer above it is still what keeps
+    multiple *processes* consistent, so this executor is only reached when
+    jax.process_count() > 1.
+    """
+    import jax
+
+    from . import collectives
+
+    def execute(batch: ExecutionBatch, tensors: Dict[str, np.ndarray]):
+        out = {}
+        for name in batch.names:
+            if name not in tensors:
+                continue
+            x = tensors[name]
+            if batch.op == OP_ALLREDUCE:
+                avg = batch.reduce_op == _REDUCE_AVERAGE
+                out[name] = np.asarray(
+                    collectives.allreduce(
+                        x, average=avg, prescale_factor=batch.prescale,
+                        postscale_factor=batch.postscale,
+                    )
+                )
+            elif batch.op == OP_ALLGATHER:
+                out[name] = np.asarray(collectives.allgather(x))
+            elif batch.op == OP_BROADCAST:
+                out[name] = np.asarray(
+                    collectives.broadcast(x, root_rank=batch.root_rank)
+                )
+            elif batch.op == OP_REDUCESCATTER:
+                out[name] = np.asarray(collectives.reducescatter(x))
+            else:
+                out[name] = x
+        return out
+
+    return execute

@@ -63,7 +63,6 @@ class ElasticDriver:
         self._shutdown = threading.Event()
         self._barrier_states: Optional[Dict[str, str]] = None
         self._barrier_event = threading.Event()
-        self._worker_failure = threading.Event()
         self._notify_timestamp = 0
         self._discovery_thread: Optional[threading.Thread] = None
         self._resets = 0
@@ -116,8 +115,6 @@ class ElasticDriver:
                     LOG.error("elastic job cannot continue: %s", e)
                     return 1
                 states = self._run_round()
-                if states is None:
-                    continue  # round aborted (host change mid-spawn)
                 if all(s == SUCCESS for s in states.values()):
                     return 0
                 self._resets += 1
@@ -134,12 +131,11 @@ class ElasticDriver:
         finally:
             self.stop()
 
-    def _run_round(self) -> Optional[Dict[str, str]]:
+    def _run_round(self) -> Dict[str, str]:
         assignments = self._update_host_assignments()
         self._assignments = assignments
         self._registry.reset(len(assignments))
         self._barrier_event.clear()
-        self._worker_failure.clear()
         self._rendezvous.init(assignments)
 
         spawn_done = threading.Event()
@@ -157,7 +153,24 @@ class ElasticDriver:
                 spawn_done.set()
 
         threading.Thread(target=spawn, daemon=True).start()
-        self._barrier_event.wait()
+        # watchdog while waiting on the round barrier: a worker whose exec
+        # hangs (dead host, stuck ssh) never reaches a terminal state on
+        # its own — once discovery stops listing its host, count it failed
+        # so the barrier can complete (reference driver.py:304 handles
+        # this via worker exit; a hung ssh never exits)
+        vanished_since: Dict[str, float] = {}
+        while not self._barrier_event.wait(timeout=1.0):
+            if self._shutdown.is_set():
+                break
+            live = self._host_manager.current_hosts.available_hosts
+            now = time.time()
+            for slot in assignments:
+                if slot.hostname in live:
+                    vanished_since.pop(slot.hostname, None)
+                elif now - vanished_since.setdefault(slot.hostname, now) > 5.0:
+                    self._registry.record_failure(
+                        slot.hostname, slot.local_rank
+                    )
         spawn_done.wait(timeout=30)
         states = self._barrier_states
         if states:
@@ -196,7 +209,6 @@ class ElasticDriver:
                 self._registry.record_success(slot.hostname, slot.local_rank)
             else:
                 self._registry.record_failure(slot.hostname, slot.local_rank)
-                self._worker_failure.set()
             return code
 
         return exec_and_record

@@ -52,8 +52,9 @@ class WorkerNotificationService(BasicService):
 class WorkerNotificationClient(BasicClient):
     """Driver-side client to one worker's notification service."""
 
-    def __init__(self, addresses: List[Tuple[str, int]], key: bytes):
-        super().__init__(SERVICE_NAME, addresses, key)
+    def __init__(self, addresses: List[Tuple[str, int]], key: bytes,
+                 timeout_s: float = 10.0):
+        super().__init__(SERVICE_NAME, addresses, key, timeout_s=timeout_s)
 
     def notify_hosts_updated(self, timestamp: int, update_result: int) -> None:
         self.request(HostsUpdatedRequest(timestamp, update_result))
@@ -115,6 +116,6 @@ def get_worker_client(
         return None
     addresses = [tuple(a) for a in json.loads(raw.decode())]
     try:
-        return WorkerNotificationClient(addresses, key)
+        return WorkerNotificationClient(addresses, key, timeout_s=timeout_s)
     except ConnectionError:
         return None

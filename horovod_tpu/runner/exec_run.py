@@ -26,6 +26,7 @@ from .util.hosts import HostInfo, SlotInfo, get_host_assignments
 from .util.network import (
     find_free_port,
     get_local_host_addresses,
+    is_local_host,
     routable_host_address,
 )
 from .util.secret import ENV_SECRET
@@ -84,7 +85,11 @@ def _exec_ssh(command: List[str], env, slot: SlotInfo, events) -> int:
     remote = f"cd {shlex.quote(os.getcwd())} && env {exported} " + " ".join(
         shlex.quote(c) for c in command
     )
-    ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no", slot.hostname, remote]
+    # -tt allocates a tty so the remote worker gets SIGHUP when the local
+    # ssh client is killed — no orphan trainers holding TPU chips
+    ssh_cmd = [
+        "ssh", "-tt", "-o", "StrictHostKeyChecking=no", slot.hostname, remote,
+    ]
     return safe_shell_exec.execute(
         ssh_cmd, env=dict(os.environ), prefix=f"{slot.rank}", events=events
     )
@@ -111,14 +116,14 @@ def launch_slots(
         # caller (elastic driver) already published this round's
         # assignments; don't double-publish / double-bump the round
         port = rendezvous.port
-    local = set(local_hosts or get_local_host_addresses() + ["localhost"])
+    local = set(local_hosts) if local_hosts else None
     rendezvous_addr = routable_host_address()
     # The JAX coordination service runs inside the rank-0 *worker*, so the
     # coordinator address must name rank 0's host, not the launcher. For a
     # local rank-0 we can probe a free port; for a remote one use a
     # deterministic port derived from the rendezvous port.
     rank0_host = assignments[0].hostname
-    if rank0_host in ("localhost", *get_local_host_addresses()):
+    if local and rank0_host in local or not local and is_local_host(rank0_host):
         coordinator = f"{rendezvous_addr}:{find_free_port()}"
     else:
         coordinator = f"{rank0_host}:{port + JAX_COORD_PORT_OFFSET}"
@@ -136,11 +141,10 @@ def launch_slots(
         wenv = slot_env(slot, env, rendezvous_addr, port, coordinator)
         fn = exec_fn
         if fn is None:
-            fn = (
-                _exec_local
-                if slot.hostname in local
-                else _exec_ssh
+            slot_is_local = (
+                slot.hostname in local if local else is_local_host(slot.hostname)
             )
+            fn = _exec_local if slot_is_local else _exec_ssh
         try:
             codes[i] = fn(command, wenv, slot, [failure])
         except BaseException:

@@ -107,7 +107,15 @@ def parse_args(argv: Optional[List[str]] = None):
     args = p.parse_args(argv)
 
     if args.config_file:
-        explicit = _explicit_dests(argv if argv is not None else sys.argv[1:], p)
+        full_argv = list(argv if argv is not None else sys.argv[1:])
+        # only hvdrun's own flags count as explicit — the trainee command
+        # captured by REMAINDER may contain identically-named flags
+        own_argv = (
+            full_argv[: len(full_argv) - len(args.command)]
+            if args.command
+            else full_argv
+        )
+        explicit = _explicit_dests(own_argv, p)
         config_parser.apply_config_file(args, args.config_file, explicit)
     return args
 

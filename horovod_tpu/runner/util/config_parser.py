@@ -72,10 +72,15 @@ def load_config_file(path: str) -> Dict[str, object]:
     return {k.replace("-", "_"): v for k, v in flat.items()}
 
 
-def _flatten(d, out, prefix=""):
+def _flatten(d, out):
     for k, v in d.items():
         if isinstance(v, dict):
             _flatten(v, out)
+        elif k in out and out[k] != v:
+            raise ValueError(
+                f"config key {k!r} appears in multiple sections with "
+                f"different values ({out[k]!r} vs {v!r})"
+            )
         else:
             out[k] = v
 

@@ -195,15 +195,41 @@ def get_local_host_addresses() -> List[str]:
     """Local addresses, loopback first; the last entry is the most
     routable one (real NIC IP when resolvable, else loopback)."""
     addrs = ["127.0.0.1"]
+    candidates = []
     try:
-        ip = socket.gethostbyname(socket.gethostname())
-        if ip != "127.0.0.1":
-            addrs.append(ip)
+        # Debian-style hosts resolve the hostname to 127.0.1.1 — any
+        # 127.x.x.x is loopback and useless to remote workers
+        candidates.append(socket.gethostbyname(socket.gethostname()))
     except OSError:
         pass
+    try:
+        # UDP connect sends no packets but selects the outbound NIC
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            candidates.append(s.getsockname()[0])
+    except OSError:
+        pass
+    for ip in candidates:
+        if not ip.startswith("127.") and ip not in addrs:
+            addrs.append(ip)
     return addrs
 
 
 def routable_host_address() -> str:
     """The address remote workers should use to reach this machine."""
     return get_local_host_addresses()[-1]
+
+
+def is_local_host(name: str) -> bool:
+    """True if `name` refers to this machine (hostname, localhost, or any
+    local address)."""
+    if name in ("localhost", socket.gethostname()):
+        return True
+    if name in get_local_host_addresses():
+        return True
+    try:
+        return socket.gethostbyname(name) in get_local_host_addresses() + [
+            "127.0.1.1"
+        ]
+    except OSError:
+        return False

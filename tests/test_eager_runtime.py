@@ -1,0 +1,59 @@
+"""EagerRuntime pipeline tests: enqueue → negotiate (native) → fuse →
+execute → synchronize, single-process world (the multi-process negotiation
+itself is covered by test_native_runtime.py)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.ops.eager_runtime import EagerRuntime
+
+
+@pytest.fixture
+def rt():
+    r = EagerRuntime(0, 1, cycle_ms=1.0, cache_capacity=32)
+    yield r
+    r.shutdown()
+
+
+def test_allreduce_roundtrip(rt):
+    x = np.arange(8, dtype=np.float32)
+    h = rt.allreduce_async("t1", x)
+    out = rt.synchronize(h)
+    np.testing.assert_allclose(out, x)  # sum over world of 1
+
+
+def test_allreduce_average_and_scales(rt):
+    x = np.ones((4,), dtype=np.float32) * 2
+    h = rt.allreduce_async("t2", x, average=True)
+    np.testing.assert_allclose(rt.synchronize(h), x)
+    h = rt.enqueue("t3", x, prescale=0.5, postscale=4.0)
+    np.testing.assert_allclose(rt.synchronize(h), x * 0.5 * 4.0)
+
+
+def test_many_tensors_all_complete(rt):
+    handles = {
+        f"g{i}": rt.allreduce_async(f"g{i}", np.full((16,), i, np.float32))
+        for i in range(20)
+    }
+    for i, (name, h) in enumerate(handles.items()):
+        np.testing.assert_allclose(
+            rt.synchronize(h), np.full((16,), i, np.float32)
+        )
+
+
+def test_cache_hits_accumulate(rt):
+    for _ in range(3):
+        h = rt.allreduce_async("steady", np.ones((8,), np.float32))
+        rt.synchronize(h)
+    assert rt.cache_hits() >= 2
+
+
+def test_barrier(rt):
+    rt.barrier(timeout_s=10.0)
+
+
+def test_bytes_negotiated_counts(rt):
+    h = rt.allreduce_async("b", np.ones((1024,), np.float32))
+    rt.synchronize(h)
+    assert rt.bytes_negotiated() >= 4096

@@ -1,0 +1,289 @@
+"""Native control-plane runtime tests: real multi-process negotiation over
+localhost TCP (reference tier-2 pattern, SURVEY.md §4: op sweeps under a
+multi-rank world; here the world is N spawned processes, no jax needed).
+
+The module avoids importing jax/horovod_tpu at top level so spawned
+workers stay light; the native package is loaded by file path.
+"""
+
+import importlib.util
+import multiprocessing as mp
+import os
+import socket
+import time
+
+import pytest
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "horovod_tpu", "_native",
+)
+
+
+def _load_native():
+    spec = importlib.util.spec_from_file_location(
+        "hvd_native_standalone", os.path.join(_NATIVE_DIR, "__init__.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _drain_until(rt, handles, timeout_s=30.0, execute=True):
+    """Fetch batches until all handles are terminal; returns batch log."""
+    log = []
+    deadline = time.time() + timeout_s
+    pending = set(handles)
+    while pending and time.time() < deadline:
+        batch = rt.next_batch(timeout_s=0.2)
+        if batch is not None:
+            log.append((batch.op, tuple(batch.names)))
+            if execute:
+                rt.batch_done(batch, ok=True)
+        done = {
+            h for h in pending
+            if rt.poll(h) in (rt_mod_DONE, rt_mod_FAILED)
+        }
+        pending -= done
+    return log
+
+
+# poll state constants mirrored here to keep the worker picklable
+rt_mod_DONE = 2
+rt_mod_FAILED = -1
+
+
+def _worker(rank, size, port, scenario, q):
+    native = _load_native()
+    rt = native.NativeRuntime()
+    rt.init(
+        rank, size, "127.0.0.1", port,
+        cycle_ms=1.0,
+        cache_capacity=64,
+        stall_warning_s=60.0,
+    )
+    try:
+        result = scenario(native, rt, rank, size)
+        q.put((rank, "ok", result))
+    except Exception as e:  # surfaced to the asserting parent
+        q.put((rank, "err", repr(e)))
+    finally:
+        rt.shutdown()
+
+
+def _run_world(size, scenario, timeout_s=60.0):
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker, args=(r, size, port, scenario, q))
+        for r in range(size)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = time.time() + timeout_s
+    while len(results) < size and time.time() < deadline:
+        try:
+            rank, status, payload = q.get(timeout=1.0)
+            results[rank] = (status, payload)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.terminate()
+    assert len(results) == size, f"only {len(results)}/{size} reported"
+    for rank, (status, payload) in results.items():
+        assert status == "ok", f"rank {rank} failed: {payload}"
+    return {r: payload for r, (_, payload) in results.items()}
+
+
+# ---------------------------------------------------------- scenarios
+# (top-level functions: spawn requires picklable targets)
+
+
+def scenario_out_of_order(native, rt, rank, size):
+    names = ["grad_a", "grad_b", "grad_c", "grad_d"]
+    order = names if rank == 0 else list(reversed(names))
+    handles = [
+        rt.enqueue(n, native.OP_ALLREDUCE, "float32", [4, 4])
+        for n in order
+    ]
+    log = _drain_until(rt, handles)
+    states = [rt.poll(h) for h in handles]
+    return {"log": log, "states": states}
+
+
+def test_negotiation_orders_ranks_identically():
+    """Ranks submit in opposite orders; the executed batch sequence must be
+    identical (the controller's whole purpose, controller.h:74-111)."""
+    out = _run_world(2, scenario_out_of_order)
+    assert out[0]["log"] == out[1]["log"]
+    all_names = [n for _, names in out[0]["log"] for n in names]
+    assert sorted(all_names) == ["grad_a", "grad_b", "grad_c", "grad_d"]
+    assert all(s == rt_mod_DONE for s in out[0]["states"])
+    assert all(s == rt_mod_DONE for s in out[1]["states"])
+
+
+def scenario_fusion(native, rt, rank, size):
+    # second tensor has a different dtype: must not fuse with the others
+    h1 = rt.enqueue("w1", native.OP_ALLREDUCE, "float32", [16])
+    h2 = rt.enqueue("w2", native.OP_ALLREDUCE, "float64", [16])
+    h3 = rt.enqueue("w3", native.OP_ALLREDUCE, "float32", [16])
+    log = _drain_until(rt, [h1, h2, h3])
+    return log
+
+
+def test_fusion_groups_same_dtype_only():
+    out = _run_world(2, scenario_fusion)
+    assert out[0] == out[1]
+    groups = [set(names) for _, names in out[0]]
+    f32 = next(g for g in groups if "w1" in g)
+    f64 = next(g for g in groups if "w2" in g)
+    assert f32 == {"w1", "w3"}
+    assert f64 == {"w2"}
+
+
+def scenario_mismatch(native, rt, rank, size):
+    shape = [4] if rank == 0 else [8]
+    h = rt.enqueue("bad", native.OP_ALLREDUCE, "float32", shape)
+    state = rt.wait(h, timeout_s=20.0)
+    # execution-side must also see the error batch (or nothing at all)
+    return {"state": state, "err": rt.last_error()}
+
+
+def test_shape_mismatch_fails_on_all_ranks():
+    """Mismatched shapes must raise consistently on every rank, not
+    deadlock (reference negotiation error channel, controller.cc:497)."""
+    out = _run_world(2, scenario_mismatch)
+    for r in range(2):
+        assert out[r]["state"] == rt_mod_FAILED
+
+
+def scenario_cache(native, rt, rank, size):
+    logs = []
+    for step in range(3):
+        hs = [
+            rt.enqueue(f"g{i}", native.OP_ALLREDUCE, "float32", [8])
+            for i in range(3)
+        ]
+        logs.append(_drain_until(rt, hs))
+    return {"logs": logs, "cache_hits": rt.cache_hits()}
+
+
+def test_response_cache_steady_state():
+    """Repeat steps hit the response cache; batches stay identical
+    (reference response_cache.h:45 fast path)."""
+    out = _run_world(2, scenario_cache)
+    for r in range(2):
+        # steps 2 and 3 ran from cache: ≥6 hits (3 tensors × 2 steps)
+        assert out[r]["cache_hits"] >= 6, out[r]
+        all_step_names = [
+            sorted(n for _, names in log for n in names)
+            for log in out[r]["logs"]
+        ]
+        assert all_step_names[0] == all_step_names[1] == all_step_names[2]
+    assert out[0]["logs"][1] == out[1]["logs"][1]
+
+
+def scenario_join(native, rt, rank, size):
+    log = []
+    if rank == 1:
+        h = rt.enqueue("tail_grad", native.OP_ALLREDUCE, "float32", [4])
+        log = _drain_until(rt, [h])
+    jh = rt.join()
+    deadline = time.time() + 20
+    while rt.poll(jh) not in (rt_mod_DONE, rt_mod_FAILED):
+        b = rt.next_batch(timeout_s=0.2)
+        if b is not None:
+            log.append((b.op, tuple(b.names)))
+            rt.batch_done(b, ok=True)
+        if time.time() > deadline:
+            break
+    return {"log": log, "join_state": rt.poll(jh)}
+
+
+def test_join_covers_missing_ranks():
+    """Rank 1 has one extra batch; rank 0 joins — the tensor completes with
+    rank 0 counted as a zero contributor, then join completes everywhere
+    (reference JoinOp, collective_operations.h:325)."""
+    out = _run_world(2, scenario_join)
+    assert out[0]["join_state"] == rt_mod_DONE
+    assert out[1]["join_state"] == rt_mod_DONE
+    # rank 1 executed its tensor; rank 0 received the same batch (it must
+    # contribute zeros for a tensor it never submitted)
+    r1_names = [n for _, names in out[1]["log"] for n in names]
+    assert "tail_grad" in r1_names
+    r0_names = [n for _, names in out[0]["log"] for n in names]
+    assert "tail_grad" in r0_names
+
+
+def scenario_barrier(native, rt, rank, size):
+    if rank == 1:
+        time.sleep(0.3)  # stagger arrival
+    h = rt.barrier()
+    state = rt.wait(h, timeout_s=20.0)
+    # drain the barrier batch
+    b = rt.next_batch(timeout_s=1.0)
+    if b is not None:
+        rt.batch_done(b, ok=True)
+    return state
+
+
+def test_barrier_completes_on_all():
+    out = _run_world(2, scenario_barrier)
+    assert all(v in (1, 2) for v in out.values())
+
+
+def scenario_world3(native, rt, rank, size):
+    hs = [
+        rt.enqueue(f"p{i}", native.OP_ALLREDUCE, "float32", [32])
+        for i in range(5)
+    ]
+    log = _drain_until(rt, hs)
+    return log
+
+
+def test_three_rank_world():
+    out = _run_world(3, scenario_world3)
+    assert out[0] == out[1] == out[2]
+    names = sorted(n for _, ns in out[0] for n in ns)
+    assert names == ["p0", "p1", "p2", "p3", "p4"]
+
+
+# ---------------------------------------------------------- single process
+
+
+def test_single_rank_world_immediate():
+    native = _load_native()
+    rt = native.NativeRuntime()
+    rt.init(0, 1, cycle_ms=1.0)
+    try:
+        h = rt.enqueue("solo", native.OP_ALLREDUCE, "float32", [4])
+        batch = rt.next_batch(timeout_s=5.0)
+        assert batch is not None
+        assert batch.names == ["solo"]
+        rt.batch_done(batch, ok=True)
+        assert rt.wait(h, timeout_s=5.0) == rt_mod_DONE
+    finally:
+        rt.shutdown()
+
+
+def test_duplicate_name_rejected():
+    native = _load_native()
+    rt = native.NativeRuntime()
+    rt.init(0, 1, cycle_ms=1000.0)  # slow cycle: both enqueues land together
+    try:
+        rt.enqueue("dup", native.OP_ALLREDUCE, "float32", [4])
+        h2 = rt.enqueue("dup", native.OP_ALLREDUCE, "float32", [4])
+        assert rt.poll(h2) == rt_mod_FAILED
+        assert "dup" in rt.last_error()
+    finally:
+        rt.shutdown()
