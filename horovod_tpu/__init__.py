@@ -98,7 +98,10 @@ from .optim import (  # noqa: F401
 
 # Elastic + timeline live under their own namespaces, mirroring
 # hvd.elastic.* and hvd.start_timeline in the reference.
+from . import callbacks  # noqa: F401
+from . import data  # noqa: F401
 from . import elastic  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401
 
 
 def start_timeline(filename: str, mark_cycles: bool = False) -> None:

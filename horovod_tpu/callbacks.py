@@ -1,0 +1,154 @@
+"""Training-loop callbacks (the Keras callback family, framework-neutral).
+
+Reference: /root/reference/horovod/_keras/callbacks.py —
+BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateWarmupCallback, LearningRateScheduleCallback. JAX training
+loops are explicit, so these are plain objects the loop invokes; each
+documents its reference analog.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+
+class Callback:
+    def on_train_begin(self, state: Any = None) -> Any:
+        return state
+
+    def on_epoch_begin(self, epoch: int, state: Any = None) -> Any:
+        return state
+
+    def on_batch_end(self, batch: int, state: Any = None) -> Any:
+        return state
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None,
+                     state: Any = None) -> Any:
+        return state
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial params/opt state from root at train start
+    (reference _keras/callbacks.py BroadcastGlobalVariablesCallback)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state):
+        from .optim import broadcast_parameters
+
+        return broadcast_parameters(state, root_rank=self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Allreduce-average metric values across ranks at epoch end
+    (reference MetricAverageCallback)."""
+
+    def on_epoch_end(self, epoch, logs=None, state=None):
+        if logs:
+            import jax.numpy as jnp
+            import numpy as np
+
+            from .ops import allreduce
+
+            for k, v in list(logs.items()):
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    reduced = allreduce(
+                        jnp.asarray(float(v)).reshape(1),
+                        average=True, name=f"metric.{k}",
+                    )
+                    logs[k] = float(np.asarray(reduced)[0])
+                elif hasattr(v, "dtype") and jnp.issubdtype(
+                    jnp.asarray(v).dtype, jnp.number
+                ):
+                    arr = jnp.asarray(v)
+                    reduced = allreduce(
+                        arr.reshape(-1), average=True, name=f"metric.{k}"
+                    ).reshape(arr.shape)
+                    logs[k] = (
+                        float(reduced) if arr.ndim == 0
+                        else np.asarray(reduced)
+                    )
+        return state
+
+
+class LearningRateWarmupCallback(Callback):
+    """Linearly ramp the LR multiplier from 1 to `size` over warmup epochs
+    (reference _keras/callbacks.py LearningRateWarmupCallback: multiplier
+    = 1 + epoch * (size - 1) / warmup_epochs — the gradual-warmup trick
+    from the large-minibatch SGD recipe). Exposes `scale(epoch)` for
+    explicit loops and an optax-style schedule via `as_schedule`.
+
+    `momentum_correction` is accepted for reference-API compatibility; in
+    optax the equivalent adjustment is applying
+    `momentum_correction_factor(prev_epoch, epoch)` to the momentum
+    hyperparameter via `optax.inject_hyperparams` — it is not applied
+    automatically here.
+    """
+
+    def __init__(self, warmup_epochs: float = 5.0,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0,
+                 initial_lr: Optional[float] = None,
+                 size: Optional[int] = None):
+        from .core import basics
+
+        self.warmup_epochs = warmup_epochs
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self.size = size if size is not None else (
+            basics.size() if basics.is_initialized() else 1
+        )
+        self.initial_lr = initial_lr
+
+    def scale(self, epoch: float) -> float:
+        """Multiplier on the base (single-rank) LR at fractional epoch."""
+        if epoch >= self.warmup_epochs:
+            return float(self.size)
+        return 1.0 + epoch * (self.size - 1.0) / self.warmup_epochs
+
+    def momentum_correction_factor(self, prev_epoch: float,
+                                   epoch: float) -> float:
+        """Multiply SGD momentum by this when the LR changes mid-warmup
+        (reference callbacks.py momentum correction: new_lr/old_lr)."""
+        if not self.momentum_correction:
+            return 1.0
+        return self.scale(epoch) / max(self.scale(prev_epoch), 1e-12)
+
+    def as_schedule(self, steps_per_epoch: int, base_lr: float
+                    ) -> Callable[[int], float]:
+        def schedule(step):
+            import jax.numpy as jnp
+
+            epoch = jnp.minimum(
+                step / steps_per_epoch, float(self.warmup_epochs)
+            )
+            return base_lr * (
+                1.0 + epoch * (self.size - 1.0) / self.warmup_epochs
+            )
+
+        return schedule
+
+
+class LearningRateScheduleCallback(Callback):
+    """Piecewise LR multiplier over epochs
+    (reference LearningRateScheduleCallback)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True):
+        self.multiplier = (
+            multiplier if callable(multiplier) else (lambda e: multiplier)
+        )
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+
+    def scale(self, epoch: float) -> float:
+        if epoch < self.start_epoch:
+            return 1.0
+        if self.end_epoch is not None and epoch >= self.end_epoch:
+            return 1.0
+        e = math.floor(epoch) if self.staircase else epoch
+        return float(self.multiplier(e))

@@ -1,0 +1,15 @@
+"""Data loading utilities.
+
+Reference: /root/reference/horovod/data/data_loader_base.py
+(`BaseDataLoader`/`AsyncDataLoaderMixin`) and torch/elastic/sampler.py
+(`ElasticSampler`). TPU additions: `ShardedDataLoader` places each host
+batch onto the mesh with a named sharding so pjit consumes it without
+resharding.
+"""
+
+from .data_loader_base import (  # noqa: F401
+    AsyncDataLoaderMixin,
+    BaseDataLoader,
+    ShardedDataLoader,
+)
+from .sampler import ElasticSampler  # noqa: F401

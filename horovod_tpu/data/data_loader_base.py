@@ -1,0 +1,135 @@
+"""Data loader base classes.
+
+Reference: /root/reference/horovod/data/data_loader_base.py —
+`BaseDataLoader` (iteration contract) and `AsyncDataLoaderMixin`
+(background thread + bounded queue prefetch, `close()` draining).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+
+class BaseDataLoader:
+    """Iteration contract (reference BaseDataLoader)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[Any]:
+        return self._iterate()
+
+
+class AsyncDataLoaderMixin:
+    """Prefetch batches on a background thread through a bounded queue
+    (reference AsyncDataLoaderMixin: async_loader_queue_size).
+
+    Mix in *before* the loader class:
+        class AsyncLoader(AsyncDataLoaderMixin, MyLoader): ...
+    """
+
+    def __init__(self, *args, async_loader_queue_size: int = 4, **kwargs):
+        self._async_queue_size = async_loader_queue_size
+        self._async_queue: Optional[queue.Queue] = None
+        self._async_thread: Optional[threading.Thread] = None
+        self._closed = threading.Event()
+        super().__init__(*args, **kwargs)
+
+    _END = object()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the consumer closed the loader
+        (an abandoned iteration must not pin the fill thread forever)."""
+        while not self._closed.is_set():
+            try:
+                self._async_queue.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self):
+        try:
+            for item in super()._iterate():
+                if not self._put(item):
+                    return
+            self._put(self._END)
+        except BaseException as e:  # surface loader errors to the consumer
+            self._put((self._END, e))
+
+    def _iterate(self) -> Iterator[Any]:
+        if self._async_queue_size <= 0:
+            yield from super()._iterate()
+            return
+        self._async_queue = queue.Queue(maxsize=self._async_queue_size)
+        self._closed.clear()
+        self._async_thread = threading.Thread(target=self._fill, daemon=True)
+        self._async_thread.start()
+        try:
+            while True:
+                item = self._async_queue.get()
+                if item is self._END:
+                    break
+                if (
+                    isinstance(item, tuple) and len(item) == 2
+                    and item[0] is self._END
+                ):
+                    raise item[1]
+                yield item
+        finally:
+            # break/exception in the consumer: release the fill thread
+            self.close()
+
+    def close(self) -> None:
+        """Stop the prefetch thread, draining the queue."""
+        self._closed.set()
+        if self._async_queue is not None:
+            while True:
+                try:
+                    self._async_queue.get_nowait()
+                except queue.Empty:
+                    break
+        if self._async_thread is not None:
+            self._async_thread.join(timeout=5)
+
+
+class ShardedDataLoader(BaseDataLoader):
+    """Wrap an iterable of host batches (numpy arrays / pytrees), placing
+    each onto the mesh with a batch-dim named sharding (TPU-native: no
+    per-rank sampler needed — the global batch is split across the dp axis
+    by XLA, the role DistributedSampler plays in the reference examples).
+    """
+
+    def __init__(self, source, mesh=None, axis: Optional[str] = None):
+        self._source = source
+        self._mesh = mesh
+        self._axis = axis
+
+    def __len__(self) -> int:
+        return len(self._source)
+
+    def _iterate(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..core import basics
+        from ..core.state import global_state
+
+        mesh = self._mesh
+        axis = self._axis
+        if mesh is None and basics.is_initialized():
+            mesh = global_state().mesh
+            axis = axis or global_state().dp_axis[0]
+        for batch in self._source:
+            if mesh is None:
+                yield batch
+                continue
+            sharding = NamedSharding(mesh, P(axis))
+            yield jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), batch
+            )

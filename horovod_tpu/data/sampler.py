@@ -1,0 +1,77 @@
+"""Elastic sampler: skip already-processed samples after a world resize.
+
+Reference: /root/reference/horovod/torch/elastic/sampler.py:24
+(`ElasticSampler`): shards indices over ranks, records processed indices
+via `record_batch`, and `set_epoch`/reshuffles so a resumed epoch skips
+seen data.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+
+class ElasticSampler:
+    def __init__(self, dataset_size: int, shuffle: bool = True, seed: int = 0):
+        self.dataset_size = dataset_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: set = set()
+        self._rank = 0
+        self._num_replicas = 1
+        self._reset()
+
+    # world hooks (reference sampler.py set_epoch / on reset) ------------
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.processed_indices.clear()
+        self._reset()
+
+    def set_world(self, rank: int, num_replicas: int) -> None:
+        self._rank = rank
+        self._num_replicas = num_replicas
+        self._reset()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        start = batch_idx * batch_size
+        taken = self.indices[start:start + batch_size]
+        self.processed_indices.update(int(i) for i in taken)
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = state["epoch"]
+        self.processed_indices = set(state["processed_indices"])
+        self._reset()
+
+    def state_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "processed_indices": sorted(self.processed_indices),
+        }
+
+    # iteration ----------------------------------------------------------
+
+    def _reset(self) -> None:
+        order = np.arange(self.dataset_size)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(order)
+        remaining = [i for i in order if i not in self.processed_indices]
+        # pad so every replica sees the same count (repeat as many times as
+        # needed — near epoch end fewer samples than replicas may remain)
+        n = len(remaining)
+        per = (n + self._num_replicas - 1) // self._num_replicas
+        target = per * self._num_replicas
+        if remaining:
+            while len(remaining) < target:
+                remaining += remaining[: target - len(remaining)]
+        self.indices: List[int] = remaining[self._rank::self._num_replicas]
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)

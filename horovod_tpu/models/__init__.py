@@ -1,4 +1,5 @@
 from .mlp import MLP, MnistNet  # noqa: F401
+from .moe import MoeMlp  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
 from .transformer import (  # noqa: F401
     BERT_BASE,

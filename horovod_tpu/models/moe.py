@@ -1,0 +1,158 @@
+"""Mixture-of-Experts layer with expert parallelism over the mesh.
+
+The reference provides the EP *primitive* — alltoall with uneven splits
+(/root/reference/horovod/common/operations.cc:1858, SURVEY.md §2.5 row
+"Alltoall (EP building block)") — but no MoE layer; users were expected to
+build one on top. Here it is first-class, TPU-first:
+
+* top-k token routing with an auxiliary load-balancing loss (the standard
+  switch/mixtral recipe);
+* **dense path** (no `ep` axis bound): every device computes all experts —
+  correct at any scale, optimal single-chip;
+* **expert-parallel path** (`ep` axis bound inside shard_map): experts are
+  sharded over the ep axis and tokens reach their experts via
+  `lax.all_to_all` over ICI — the XLA-native form of the reference's
+  alltoall-based EP. Capacity-factor dropping keeps shapes static for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import basics
+
+
+class MoeMlp(nn.Module):
+    """Top-k routed expert MLP (SwiGLU experts).
+
+    Args mirror TransformerConfig naming; `ep_axis` names the mesh axis
+    experts shard over when bound (num_experts must divide by its size).
+    """
+
+    hidden_size: int
+    mlp_dim: int
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    ep_axis: str = "ep"
+    dtype: Any = jnp.bfloat16
+    router_aux_weight: float = 0.01
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        """[tokens, hidden] -> ([tokens, hidden], aux_loss)."""
+        t, h = x.shape
+        e, k = self.num_experts, self.top_k
+
+        router = nn.Dense(e, dtype=jnp.float32, name="router")
+        logits = router(x.astype(jnp.float32))           # [t, e]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate_vals, gate_idx = lax.top_k(probs, k)        # [t, k]
+        gate_vals = gate_vals / jnp.sum(gate_vals, -1, keepdims=True)
+
+        # load-balancing aux loss (Switch Transformer eq. 4)
+        me = jnp.mean(probs, axis=0)                     # [e]
+        ce = jnp.mean(
+            jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0
+        )
+        aux = self.router_aux_weight * e * jnp.sum(me * ce)
+
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(),
+            (e, h, 2 * self.mlp_dim), jnp.float32,
+        ).astype(self.dtype)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(),
+            (e, self.mlp_dim, h), jnp.float32,
+        ).astype(self.dtype)
+
+        ep = self._ep_size()
+        if ep > 1:
+            y = self._expert_parallel(x, gate_idx, gate_vals, w_in, w_out, ep)
+        else:
+            y = self._dense(x, gate_idx, gate_vals, w_in, w_out)
+        return y.astype(x.dtype), aux
+
+    # ---------------------------------------------------------------- dense
+
+    def _dense(self, x, gate_idx, gate_vals, w_in, w_out):
+        """All experts on every device: one einsum over the expert dim."""
+        xc = x.astype(self.dtype)
+        up = jnp.einsum("th,ehm->tem", xc, w_in)          # [t, e, 2m]
+        g, u = jnp.split(up, 2, axis=-1)
+        act = jax.nn.silu(g) * u
+        per_expert = jnp.einsum("tem,emh->teh", act, w_out)  # [t, e, h]
+        mask = jax.nn.one_hot(
+            gate_idx, self.num_experts, dtype=self.dtype
+        )                                                  # [t, k, e]
+        weights = jnp.einsum(
+            "tke,tk->te", mask, gate_vals.astype(self.dtype)
+        )
+        return jnp.einsum("teh,te->th", per_expert, weights)
+
+    # ------------------------------------------------------ expert parallel
+
+    def _expert_parallel(self, x, gate_idx, gate_vals, w_in, w_out, ep):
+        """Capacity-bucketed dispatch via all_to_all over the ep axis.
+
+        Each device holds num_experts/ep experts (its shard of w_in/w_out
+        is selected by ep rank). Token shards are dispatched: every device
+        builds [e, capacity, h] buckets, all_to_all rotates the expert dim
+        so device j receives the buckets for its experts from every peer,
+        computes, and the reverse all_to_all returns results.
+        """
+        t, h = x.shape
+        e, k = self.num_experts, self.top_k
+        local_e = e // ep
+        capacity = int(self.capacity_factor * k * t / e) + 1
+
+        # position of each (token, k) within its expert's bucket
+        flat_idx = gate_idx.reshape(-1)                    # [t*k]
+        onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.int32)
+        pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot  # 1-based
+        pos = jnp.sum(pos_in_expert, axis=-1) - 1            # [t*k]
+        keep = pos < capacity                                 # drop overflow
+
+        xc = x.astype(self.dtype)
+        tok = jnp.repeat(jnp.arange(t), k)
+        buckets = jnp.zeros((e, capacity, h), self.dtype)
+        buckets = buckets.at[
+            jnp.where(keep, flat_idx, 0),
+            jnp.where(keep, pos, 0),
+        ].add(jnp.where(keep[:, None], xc[tok], 0))
+
+        # [e, c, h] -> [ep, local_e, c, h]; all_to_all over ep axis swaps
+        # the leading ep dim with the device dim (ICI all-to-all)
+        buckets = buckets.reshape(ep, local_e, capacity, h)
+        recv = lax.all_to_all(
+            buckets, self.ep_axis, split_axis=0, concat_axis=0, tiled=False
+        )                                  # [ep(src), local_e, c, h]
+
+        my = lax.axis_index(self.ep_axis)
+        w_in_l = lax.dynamic_slice_in_dim(w_in, my * local_e, local_e, 0)
+        w_out_l = lax.dynamic_slice_in_dim(w_out, my * local_e, local_e, 0)
+        up = jnp.einsum("slch,lhm->slcm", recv, w_in_l)
+        g, u = jnp.split(up, 2, axis=-1)
+        act = jax.nn.silu(g) * u
+        out = jnp.einsum("slcm,lmh->slch", act, w_out_l)
+
+        back = lax.all_to_all(
+            out, self.ep_axis, split_axis=0, concat_axis=0, tiled=False
+        )                                  # [ep, local_e, c, h] expert-major
+        back = back.reshape(e, capacity, h)
+
+        gathered = back[
+            jnp.where(keep, flat_idx, 0), jnp.where(keep, pos, 0)
+        ]                                  # [t*k, h]
+        gathered = jnp.where(keep[:, None], gathered, 0)
+        weighted = gathered * gate_vals.reshape(-1, 1).astype(self.dtype)
+        return jnp.zeros((t, h), self.dtype).at[tok].add(weighted)
+
+    def _ep_size(self) -> int:
+        sizes = basics.bound_axis_sizes()
+        return sizes.get(self.ep_axis, 1)

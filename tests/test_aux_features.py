@@ -1,0 +1,254 @@
+"""SyncBatchNorm, data loaders, callbacks, MoE (tier-2 style: 8-device
+virtual mesh via conftest)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.data import (
+    AsyncDataLoaderMixin,
+    BaseDataLoader,
+    ElasticSampler,
+    ShardedDataLoader,
+)
+from horovod_tpu.callbacks import (
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)
+from horovod_tpu.models import MoeMlp
+
+
+# ------------------------------------------------------- SyncBatchNorm
+
+
+def test_sync_batch_norm_matches_global_stats(hvd8):
+    """Per-device shards with different stats: SyncBatchNorm must normalize
+    with the GLOBAL batch statistics (reference torch/sync_batch_norm.py
+    semantics)."""
+    mesh = hvd.mesh()
+    ax = hvd.dp_axis_names()[0]
+    rng = np.random.RandomState(0)
+    # 8 shards with very different means
+    x = (rng.rand(64, 16).astype(np.float32)
+         + np.repeat(np.arange(8), 8)[:, None] * 10)
+
+    model = hvd.SyncBatchNorm(use_running_average=False, momentum=0.9)
+    variables = model.init(jax.random.PRNGKey(0), x[:8])
+
+    def fwd(xs):
+        y, updates = model.apply(
+            variables, xs, mutable=["batch_stats"]
+        )
+        return y, updates["batch_stats"]
+
+    sharded = jax.jit(
+        shard_map(
+            fwd, mesh=mesh, in_specs=P(ax),
+            out_specs=(P(ax), P()), check_vma=False,
+        )
+    )
+    xs = jax.device_put(x, NamedSharding(mesh, P(ax)))
+    y, stats = sharded(xs)
+    y = np.asarray(y)
+
+    # expected: plain batchnorm over the WHOLE batch
+    mean = x.mean(0)
+    var = x.var(0)
+    expect = (x - mean) / np.sqrt(var + model.epsilon)
+    np.testing.assert_allclose(y, expect, atol=1e-3)
+    # running stats updated toward global mean
+    np.testing.assert_allclose(
+        np.asarray(stats["mean"]), 0.1 * mean, rtol=1e-3
+    )
+
+
+def test_sync_batch_norm_local_fallback(hvd8):
+    """Outside shard_map: plain local batch norm."""
+    x = np.random.RandomState(1).rand(16, 8).astype(np.float32)
+    model = hvd.SyncBatchNorm(use_running_average=False)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    y, _ = model.apply(variables, x, mutable=["batch_stats"])
+    expect = (x - x.mean(0)) / np.sqrt(x.var(0) + model.epsilon)
+    np.testing.assert_allclose(np.asarray(y), expect, atol=1e-4)
+
+
+# ------------------------------------------------------- data loaders
+
+
+class RangeLoader(BaseDataLoader):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def _iterate(self):
+        for i in range(self.n):
+            yield i
+
+
+class AsyncRangeLoader(AsyncDataLoaderMixin, RangeLoader):
+    pass
+
+
+def test_async_loader_preserves_order():
+    loader = AsyncRangeLoader(50, async_loader_queue_size=4)
+    assert list(loader) == list(range(50))
+    loader.close()
+
+
+def test_async_loader_sync_mode():
+    loader = AsyncRangeLoader(10, async_loader_queue_size=0)
+    assert list(loader) == list(range(10))
+
+
+def test_sharded_loader_places_on_mesh(hvd8):
+    batches = [np.ones((16, 4), np.float32) * i for i in range(3)]
+    loader = ShardedDataLoader(batches)
+    out = list(loader)
+    assert len(out) == 3
+    for i, b in enumerate(out):
+        assert isinstance(b, jax.Array)
+        assert len(b.sharding.device_set) == 8
+        np.testing.assert_allclose(np.asarray(b), batches[i])
+
+
+def test_elastic_sampler_skips_processed():
+    s = ElasticSampler(dataset_size=20, shuffle=False)
+    s.set_world(0, 2)
+    first = list(s)[:3]
+    assert first == [0, 2, 4]
+    s.record_batch(0, 3)
+    s.set_world(0, 2)  # resize triggers reset with processed skip
+    assert not (set(first) & set(s.indices))
+    # state roundtrip
+    state = s.state_dict()
+    s2 = ElasticSampler(dataset_size=20, shuffle=False)
+    s2.load_state_dict(state)
+    assert set(s2.processed_indices) == {0, 2, 4}
+
+
+# ------------------------------------------------------- callbacks
+
+
+def test_warmup_scale_ramps_to_size(hvd8):
+    cb = LearningRateWarmupCallback(warmup_epochs=5)
+    assert cb.scale(0) == pytest.approx(1.0)
+    assert cb.scale(5) == pytest.approx(8.0)  # world of 8
+    assert 1.0 < cb.scale(2.5) < 8.0
+    sched = cb.as_schedule(steps_per_epoch=10, base_lr=0.1)
+    assert float(sched(0)) == pytest.approx(0.1)
+    assert float(sched(50)) == pytest.approx(0.8)
+
+
+def test_schedule_callback_windows():
+    cb = LearningRateScheduleCallback(
+        multiplier=lambda e: 0.1, start_epoch=2, end_epoch=4
+    )
+    assert cb.scale(1) == 1.0
+    assert cb.scale(2) == pytest.approx(0.1)
+    assert cb.scale(4) == 1.0
+
+
+def test_metric_average_callback(hvd8):
+    logs = {"loss": 2.0, "name": "x"}
+    MetricAverageCallback().on_epoch_end(0, logs)
+    assert logs["loss"] == pytest.approx(2.0)  # replicated world: identity
+    assert logs["name"] == "x"
+
+
+# ------------------------------------------------------- MoE
+
+
+def _moe_apply_dense(layer, params, x):
+    y, aux = layer.apply({"params": params}, x)
+    return y, aux
+
+
+def test_moe_dense_output_is_gated_expert_mix(hvd8):
+    layer = MoeMlp(hidden_size=16, mlp_dim=32, num_experts=4, top_k=2,
+                   dtype=jnp.float32)
+    x = jnp.asarray(
+        np.random.RandomState(0).rand(12, 16), dtype=jnp.float32
+    )
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    y, aux = _moe_apply_dense(layer, params, x)
+    assert y.shape == x.shape
+    assert np.isfinite(float(aux)) and float(aux) >= 0
+
+
+def test_moe_expert_parallel_matches_dense(hvd8):
+    """EP path (all_to_all over ep axis) must produce the dense path's
+    output when capacity is ample."""
+    from jax.sharding import Mesh
+
+    devices = np.asarray(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("ep",))
+    layer = MoeMlp(hidden_size=8, mlp_dim=16, num_experts=4, top_k=2,
+                   capacity_factor=8.0, dtype=jnp.float32)
+    tokens = 16
+    x = jnp.asarray(
+        np.random.RandomState(1).rand(tokens, 8), dtype=jnp.float32
+    )
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    y_dense, _ = _moe_apply_dense(layer, params, x)
+
+    def fwd(p, xs):
+        y, aux = layer.apply({"params": p}, xs)
+        return y
+
+    with mesh:
+        y_ep = jax.jit(
+            shard_map(
+                fwd, mesh=mesh, in_specs=(P(), P("ep")), out_specs=P("ep"),
+                check_vma=False,
+            )
+        )(params, x)
+    np.testing.assert_allclose(
+        np.asarray(y_ep), np.asarray(y_dense), atol=2e-4
+    )
+
+
+def test_elastic_sampler_pad_shortfall_keeps_shards_equal():
+    """Near epoch end: fewer remaining samples than replicas must still
+    give every replica the same shard length (lockstep SPMD loops)."""
+    lengths = []
+    for rank in range(8):
+        s = ElasticSampler(dataset_size=11, shuffle=False)
+        s.processed_indices = set(range(8))  # 3 remain, 8 replicas
+        s.set_world(rank, 8)
+        lengths.append(len(s))
+    assert len(set(lengths)) == 1 and lengths[0] > 0
+
+
+def test_async_loader_propagates_errors():
+    class Boom(BaseDataLoader):
+        def __len__(self):
+            return 2
+
+        def _iterate(self):
+            yield 1
+            raise RuntimeError("io error")
+
+    class AsyncBoom(AsyncDataLoaderMixin, Boom):
+        pass
+
+    loader = AsyncBoom(async_loader_queue_size=2)
+    with pytest.raises(RuntimeError, match="io error"):
+        list(loader)
+
+
+def test_async_loader_abandoned_iteration_releases_thread():
+    import time
+
+    loader = AsyncRangeLoader(10000, async_loader_queue_size=2)
+    for i in loader:
+        if i == 3:
+            break
+    time.sleep(0.5)
+    assert not loader._async_thread.is_alive()
