@@ -117,8 +117,16 @@ class LearningRateWarmupCallback(Callback):
             return 1.0
         return self.scale(epoch) / max(self.scale(prev_epoch), 1e-12)
 
-    def as_schedule(self, steps_per_epoch: int, base_lr: float
+    def as_schedule(self, steps_per_epoch: int,
+                    base_lr: Optional[float] = None
                     ) -> Callable[[int], float]:
+        if base_lr is None:
+            base_lr = self.initial_lr
+        if base_lr is None:
+            raise ValueError(
+                "pass base_lr to as_schedule or initial_lr at construction"
+            )
+
         def schedule(step):
             import jax.numpy as jnp
 

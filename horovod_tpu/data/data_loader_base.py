@@ -125,6 +125,9 @@ class ShardedDataLoader(BaseDataLoader):
         if mesh is None and basics.is_initialized():
             mesh = global_state().mesh
             axis = axis or global_state().dp_axis[0]
+        elif mesh is not None and axis is None:
+            # explicit mesh without an axis must still shard the batch dim
+            axis = mesh.axis_names[0]
         for batch in self._source:
             if mesh is None:
                 yield batch

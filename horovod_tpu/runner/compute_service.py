@@ -1,0 +1,105 @@
+"""Compute service: register data-producing workers, hand out shards.
+
+Reference: /root/reference/horovod/runner/common/service/compute_service.py
+:97,219 (`ComputeService`/`ComputeClient`) — the registry behind
+`horovod.tensorflow.data.compute` (TF data-service dispatchers/workers on
+Horovod slots). TPU-analog: a generic registry over the launcher's
+authenticated TCP transport — compute workers register (kind, index,
+address); trainers wait for and look up all workers of a kind; shutdown
+broadcasts to every waiter.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .util.network import AckResponse, BasicClient, BasicService
+
+SERVICE_NAME = "compute-service"
+
+
+class RegisterWorkerRequest:
+    def __init__(self, kind: str, index: int, address: str):
+        self.kind = kind
+        self.index = index
+        self.address = address
+
+
+class WaitForWorkersRequest:
+    def __init__(self, kind: str, count: int, timeout_s: float):
+        self.kind = kind
+        self.count = count
+        self.timeout_s = timeout_s
+
+
+class WorkersResponse:
+    def __init__(self, addresses: Dict[int, str]):
+        self.addresses = addresses
+
+
+class ShutdownRequest:
+    pass
+
+
+class ComputeService(BasicService):
+    """Driver-side registry (reference compute_service.py:97)."""
+
+    def __init__(self, key: bytes):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._workers: Dict[str, Dict[int, str]] = {}
+        self._shutdown = False
+        super().__init__(SERVICE_NAME, key)
+
+    def _handle(self, req, client_address):
+        if isinstance(req, RegisterWorkerRequest):
+            with self._cv:
+                self._workers.setdefault(req.kind, {})[req.index] = (
+                    req.address
+                )
+                self._cv.notify_all()
+            return AckResponse()
+        if isinstance(req, WaitForWorkersRequest):
+            with self._cv:
+                ok = self._cv.wait_for(
+                    lambda: self._shutdown
+                    or len(self._workers.get(req.kind, {})) >= req.count,
+                    timeout=req.timeout_s,
+                )
+                if self._shutdown:
+                    return WorkersResponse({})
+                del ok  # on timeout, return what we have
+                return WorkersResponse(dict(self._workers.get(req.kind, {})))
+        if isinstance(req, ShutdownRequest):
+            with self._cv:
+                self._shutdown = True
+                self._cv.notify_all()
+            return AckResponse()
+        return super()._handle(req, client_address)
+
+
+class ComputeClient(BasicClient):
+    """Worker/trainer-side client (reference compute_service.py:219)."""
+
+    def __init__(self, addresses: List[Tuple[str, int]], key: bytes,
+                 timeout_s: float = 30.0):
+        super().__init__(SERVICE_NAME, addresses, key, timeout_s=timeout_s)
+
+    def register_worker(self, kind: str, index: int, address: str) -> None:
+        self.request(RegisterWorkerRequest(kind, index, address))
+
+    def wait_for_workers(self, kind: str, count: int,
+                         timeout_s: float = 60.0) -> Dict[int, str]:
+        # transport timeout must outlast the server-side wait, or the
+        # socket read times out before the server's cv.wait_for returns
+        saved = self._timeout
+        self._timeout = max(saved, timeout_s + 10.0)
+        try:
+            resp = self.request(WaitForWorkersRequest(kind, count, timeout_s))
+        finally:
+            self._timeout = saved
+        return resp.addresses
+
+    def shutdown_service(self) -> None:
+        self.request(ShutdownRequest())

@@ -1,0 +1,442 @@
+"""PyTorch adapter: the reference's `horovod.torch` API surface backed by
+the TPU framework's collectives.
+
+Reference surface: /root/reference/horovod/torch/mpi_ops.py (op family +
+handle-based async), torch/optimizer.py:36 (`DistributedOptimizer` with
+per-parameter gradient hooks), torch/functions.py:30,62
+(broadcast_parameters / broadcast_optimizer_state). Torch here is the
+CPU-side host framework (baked-in build has no CUDA); tensors bridge
+torch↔numpy zero-copy and execute through the same collective layer as
+the JAX path, so a reference user's training script structure ports
+unchanged:
+
+    import horovod_tpu.torch as hvd
+    hvd.init()
+    opt = hvd.DistributedOptimizer(torch.optim.SGD(...),
+                                   named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ..core.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from ..ops import Adasum, Average, Max, Min, Product, ReduceOp, Sum  # noqa: F401
+from ..ops import collectives as _c
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+def _to_np(t) -> np.ndarray:
+    torch = _torch()
+    if t.dtype == torch.bfloat16:
+        # numpy has no native bf16: bit-view through uint16 → ml_dtypes
+        import ml_dtypes
+
+        return (
+            t.detach().cpu().contiguous().view(torch.uint16).numpy()
+            .view(ml_dtypes.bfloat16)
+        )
+    return t.detach().cpu().numpy()
+
+
+def _to_torch(a, like):
+    torch = _torch()
+    # always copy: np.asarray over a jax Array yields a read-only buffer,
+    # and torch.from_numpy would alias it (mutation = undefined behavior)
+    a = np.array(a, copy=True)
+    if a.dtype.name == "bfloat16":
+        t = torch.from_numpy(a.view(np.uint16)).view(torch.bfloat16)
+        return t.to(like.dtype)
+    return torch.from_numpy(a).to(like.dtype)
+
+
+# ---------------------------------------------------------------------------
+# handle-based async op family (reference torch/mpi_ops.py:107-1290).
+# Execution is dispatched immediately (XLA's dispatch is itself async);
+# handles exist for API parity: poll() is always true once the result
+# materializes, synchronize() fetches it.
+# ---------------------------------------------------------------------------
+
+_handles: Dict[int, Any] = {}
+_next_handle = [1]
+
+
+def _register(result) -> int:
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _handles[h] = result
+    return h
+
+
+def poll(handle: int) -> bool:
+    return handle in _handles
+
+
+def synchronize(handle: int):
+    try:
+        return _handles.pop(handle)
+    except KeyError:
+        raise ValueError(f"unknown handle {handle}")
+
+
+def _run(op_fn, tensor, *args, **kwargs):
+    out = op_fn(np.asarray(_to_np(tensor)), *args, **kwargs)
+    return _to_torch(np.asarray(out), tensor)
+
+
+# -- allreduce --------------------------------------------------------------
+
+def allreduce(tensor, average=None, name=None, compression=None,
+              op=None, prescale_factor=1.0, postscale_factor=1.0,
+              process_set=None):
+    ctx = None
+    wire = tensor
+    if compression is not None and compression is not Compression.none:
+        wire, ctx = compression.compress(tensor)
+    out = _c.allreduce(
+        _to_np(wire), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set,
+    )
+    result = _to_torch(np.asarray(out), wire)
+    if compression is not None and compression is not Compression.none:
+        result = compression.decompress(result, ctx)
+    return _to_torch_dtype(result, tensor)
+
+
+def _to_torch_dtype(t, like):
+    return t.to(like.dtype) if t.dtype != like.dtype else t
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=None):
+    return _register(
+        allreduce(tensor, average=average, name=name, op=op,
+                  prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor,
+                  process_set=process_set)
+    )
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0, process_set=None):
+    out = allreduce(tensor, average=average, name=name, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set=process_set)
+    tensor.copy_(out)
+    return tensor
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=None):
+    allreduce_(tensor, average=average, name=name, op=op,
+               prescale_factor=prescale_factor,
+               postscale_factor=postscale_factor, process_set=process_set)
+    return _register(tensor)
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      process_set=None):
+    outs = _c.grouped_allreduce(
+        [_to_np(t) for t in tensors], average=average, name=name, op=op,
+        process_set=process_set,
+    )
+    return [_to_torch(np.asarray(o), t) for o, t in zip(outs, tensors)]
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            process_set=None):
+    return _register(
+        grouped_allreduce(tensors, average=average, name=name, op=op,
+                          process_set=process_set)
+    )
+
+
+# -- allgather / broadcast / alltoall / reducescatter ----------------------
+
+def allgather(tensor, name=None, process_set=None):
+    return _run(_c.allgather, tensor, name=name, process_set=process_set)
+
+
+def allgather_async(tensor, name=None, process_set=None):
+    return _register(allgather(tensor, name=name, process_set=process_set))
+
+
+def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
+    return _run(_c.broadcast, tensor, root_rank=root_rank, name=name,
+                process_set=process_set)
+
+
+def broadcast_async(tensor, root_rank: int = 0, name=None, process_set=None):
+    return _register(
+        broadcast(tensor, root_rank=root_rank, name=name,
+                  process_set=process_set)
+    )
+
+
+def broadcast_(tensor, root_rank: int = 0, name=None, process_set=None):
+    tensor.copy_(broadcast(tensor, root_rank=root_rank, name=name,
+                           process_set=process_set))
+    return tensor
+
+
+def broadcast_async_(tensor, root_rank: int = 0, name=None,
+                     process_set=None):
+    broadcast_(tensor, root_rank=root_rank, name=name,
+               process_set=process_set)
+    return _register(tensor)
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    return _run(_c.alltoall, tensor, splits=splits, name=name,
+                process_set=process_set)
+
+
+def alltoall_async(tensor, splits=None, name=None, process_set=None):
+    return _register(alltoall(tensor, splits=splits, name=name,
+                              process_set=process_set))
+
+
+def reducescatter(tensor, op=None, name=None, process_set=None):
+    return _run(_c.reducescatter, tensor, op=op, name=name,
+                process_set=process_set)
+
+
+def reducescatter_async(tensor, op=None, name=None, process_set=None):
+    return _register(reducescatter(tensor, op=op, name=name,
+                                   process_set=process_set))
+
+
+def join(device=-1) -> int:
+    del device  # the reference takes a GPU id; XLA owns placement
+    from ..ops import join as _join
+
+    return _join()
+
+
+def barrier(process_set=None):
+    from ..ops import barrier as _barrier
+
+    return _barrier(process_set=process_set)
+
+
+# ---------------------------------------------------------------------------
+# parameter / optimizer-state broadcast (reference torch/functions.py)
+# ---------------------------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0, process_set=None):
+    """In-place broadcast of a state_dict or named_parameters iterable
+    (reference torch/functions.py:30)."""
+    if hasattr(params, "items"):
+        items = list(params.items())
+    else:
+        items = list(params)
+    for name, p in items:
+        if p is None or not hasattr(p, "data"):
+            if hasattr(p, "copy_"):
+                broadcast_(p, root_rank=root_rank, name=f"bp.{name}",
+                           process_set=process_set)
+            continue
+        broadcast_(p.data, root_rank=root_rank, name=f"bp.{name}",
+                   process_set=process_set)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0,
+                              process_set=None):
+    """Broadcast optimizer state tensors in-place
+    (reference torch/functions.py:62; the reference pickles non-tensor
+    hyperparameters — same here via broadcast_object)."""
+    torch = _torch()
+    state = optimizer.state_dict()
+    # tensor entries broadcast in place; scalars travel pickled
+    scalars = {}
+    for gi, group in enumerate(state.get("param_groups", [])):
+        for k, v in group.items():
+            if k != "params":
+                scalars[f"group.{gi}.{k}"] = v
+    for pid, pstate in state.get("state", {}).items():
+        for k, v in pstate.items():
+            key = f"state.{pid}.{k}"
+            if torch.is_tensor(v):
+                broadcast_(v, root_rank=root_rank, name=f"bos.{key}",
+                           process_set=process_set)
+            else:
+                scalars[key] = v
+    scalars = broadcast_object(scalars, root_rank=root_rank)
+    for gi, group in enumerate(state.get("param_groups", [])):
+        for k in list(group.keys()):
+            if k != "params" and f"group.{gi}.{k}" in scalars:
+                group[k] = scalars[f"group.{gi}.{k}"]
+    for pid, pstate in state.get("state", {}).items():
+        for k in list(pstate.keys()):
+            key = f"state.{pid}.{k}"
+            if key in scalars:
+                pstate[k] = scalars[key]
+    optimizer.load_state_dict(state)
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None, process_set=None):
+    from ..optim.functions import broadcast_object as _bo
+
+    return _bo(obj, root_rank=root_rank, name=name, process_set=process_set)
+
+
+def allgather_object(obj, name=None, process_set=None):
+    from ..optim.functions import allgather_object as _ao
+
+    return _ao(obj, name=name, process_set=process_set)
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer (reference torch/optimizer.py:36)
+# ---------------------------------------------------------------------------
+
+class Compression:
+    """fp16-on-the-wire compression knobs (reference torch/compression.py:20).
+    On TPU the wire dtype is bf16."""
+
+    class none:
+        @staticmethod
+        def compress(t):
+            return t, None
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t
+
+    class fp16:
+        @staticmethod
+        def compress(t):
+            return (t.bfloat16() if t.dtype.is_floating_point else t), t.dtype
+
+        @staticmethod
+        def decompress(t, ctx):
+            return t.to(ctx) if ctx is not None else t
+
+
+class _DistributedOptimizer:
+    """Wraps a torch optimizer: per-parameter post-accumulate hooks launch
+    gradient allreduces; step() synchronizes then steps
+    (reference optimizer.py:131-324)."""
+
+    def __init__(self, optimizer, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1, op=Average,
+                 gradient_predivide_factor: float = 1.0, process_set=None):
+        torch = _torch()
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self._process_set = process_set
+        self._bpps = backward_passes_per_step
+        self._predivide = gradient_predivide_factor
+
+        if named_parameters is not None:
+            named = list(named_parameters)
+        else:
+            named = [
+                (f"param.{gi}.{pi}", p)
+                for gi, group in enumerate(optimizer.param_groups)
+                for pi, p in enumerate(group["params"])
+            ]
+        from collections import Counter
+
+        counts = Counter(n for n, _ in named)
+        dups = [n for n, c in counts.items() if c > 1]
+        if dups:
+            raise ValueError(f"duplicate parameter names: {sorted(dups)}")
+        self._named = named
+        self._name_of = {p: n for n, p in named}
+        self._counters = {p: 0 for _, p in named}
+        self._pending: Dict[Any, Any] = {}
+        self._hooks = []
+        for _, p in named:
+            if p.requires_grad:
+                self._hooks.append(
+                    p.register_post_accumulate_grad_hook(self._make_hook())
+                )
+
+    def _make_hook(self):
+        def hook(p):
+            self._counters[p] += 1
+            if self._counters[p] >= self._bpps:
+                self._counters[p] = 0
+                self._pending[p] = self._allreduce_grad_async(p)
+
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._name_of.get(p, "grad")
+        grad = p.grad
+        if self._predivide != 1.0:
+            grad = grad / self._predivide
+        compressed, ctx = self._compression.compress(grad)
+        out = allreduce(
+            compressed,
+            name=f"grad.{name}",
+            op=self._op,
+            process_set=self._process_set,
+        )
+        return self._compression.decompress(out, ctx)
+
+    def synchronize(self) -> None:
+        for p, result in self._pending.items():
+            p.grad.copy_(result.to(p.grad.dtype))
+        self._pending.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        return self._opt.zero_grad(*a, **kw)
+
+    # pass-through for state/introspection
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._opt.load_state_dict(sd)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1, op=Average,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None):
+    return _DistributedOptimizer(
+        optimizer, named_parameters=named_parameters,
+        compression=compression,
+        backward_passes_per_step=backward_passes_per_step, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set,
+    )

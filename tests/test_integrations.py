@@ -1,0 +1,82 @@
+"""Spark/Ray gating + compute service registry."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.runner.compute_service import (
+    ComputeClient,
+    ComputeService,
+)
+from horovod_tpu.runner.util.secret import make_secret_key
+
+
+def test_spark_gated_without_pyspark():
+    import horovod_tpu.spark as sp
+
+    try:
+        import pyspark  # noqa: F401
+
+        pytest.skip("pyspark installed; gating not exercised")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="pyspark"):
+        sp.run(lambda: 1)
+
+
+def test_ray_gated_without_ray():
+    import horovod_tpu.ray as r
+
+    try:
+        import ray  # noqa: F401
+
+        pytest.skip("ray installed; gating not exercised")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="ray"):
+        r.RayExecutor(num_workers=2)
+
+
+def test_compute_service_register_and_wait():
+    key = make_secret_key()
+    svc = ComputeService(key)
+    try:
+        client = ComputeClient(svc.addresses(), key)
+        # waiter blocks until both workers register
+        result = {}
+
+        def wait():
+            result["addrs"] = client2.wait_for_workers(
+                "dispatcher", 2, timeout_s=10.0
+            )
+
+        client2 = ComputeClient(svc.addresses(), key)
+        t = threading.Thread(target=wait)
+        t.start()
+        client.register_worker("dispatcher", 0, "h1:5000")
+        client.register_worker("dispatcher", 1, "h2:5000")
+        t.join(timeout=10)
+        assert result["addrs"] == {0: "h1:5000", 1: "h2:5000"}
+        # different kind unaffected
+        assert client.wait_for_workers("worker", 0, timeout_s=0.2) == {}
+    finally:
+        svc.shutdown()
+
+
+def test_compute_service_shutdown_releases_waiters():
+    key = make_secret_key()
+    svc = ComputeService(key)
+    try:
+        c1 = ComputeClient(svc.addresses(), key)
+        c2 = ComputeClient(svc.addresses(), key)
+        done = threading.Event()
+
+        def wait():
+            c1.wait_for_workers("never", 5, timeout_s=30.0)
+            done.set()
+
+        threading.Thread(target=wait, daemon=True).start()
+        c2.shutdown_service()
+        assert done.wait(timeout=5.0)
+    finally:
+        svc.shutdown()
