@@ -86,6 +86,7 @@ def load() -> ctypes.CDLL:
         ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
         ctypes.c_int,
     ]
+    lib.hvd_native_release.argtypes = [ctypes.c_longlong]
     lib.hvd_native_last_error.restype = ctypes.c_char_p
     lib.hvd_native_stall_warnings.restype = ctypes.c_longlong
     lib.hvd_native_cache_hits.restype = ctypes.c_longlong
@@ -207,6 +208,10 @@ class NativeRuntime:
 
     def wait(self, handle: int, timeout_s: float = 60.0) -> int:
         return self._lib.hvd_native_wait(handle, timeout_s)
+
+    def release(self, handle: int) -> None:
+        """Free a handle's runtime state after a terminal wait/poll."""
+        self._lib.hvd_native_release(handle)
 
     def next_batch(self, timeout_s: float = 1.0) -> Optional[ExecutionBatch]:
         buf = ctypes.create_string_buffer(1 << 20)

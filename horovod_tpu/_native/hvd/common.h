@@ -120,17 +120,27 @@ struct Response {
   DataType dtype = DataType::kFloat32;
   int64_t total_bytes = 0;
   std::vector<int64_t> first_shape;  // representative shape (validation)
+  // per-tensor shapes parallel to tensor_names: lets ranks without a
+  // local pending entry (joined ranks) replicate exact cache metadata
+  // for fused batches instead of guessing from first_shape
+  std::vector<std::vector<int64_t>> tensor_shapes;
 };
 
 struct RequestList {
   std::vector<Request> requests;
-  std::vector<uint64_t> cache_bits;  // bitvector of cache-hit positions
+  std::vector<uint64_t> cache_bits;    // bitvector of cache-hit positions
+  std::vector<uint64_t> invalid_bits;  // positions whose cached metadata no
+                                       // longer matches this rank's request
   bool shutdown = false;
   bool join = false;
 };
 
 struct ResponseList {
   std::vector<Response> responses;
+  // OR of every rank's invalid_bits: all ranks erase these cache positions
+  // in the same cycle, keeping position tables replicated (reference
+  // CacheCoordinator, controller.cc:802).
+  std::vector<uint64_t> agreed_invalid_bits;
   bool shutdown = false;
   int32_t join_count = 0;
 };

@@ -137,6 +137,7 @@ Response TcpController::ConstructResponse(const std::string& name) {
   resp.postscale = first.postscale;
   resp.dtype = first.dtype;
   resp.first_shape = first.shape;
+  resp.tensor_shapes = {first.shape};
   // allgather: total bytes sums every rank's first dim
   if (first.op == OpType::kAllgather) {
     for (const auto& kv : rec.requests) resp.total_bytes += kv.second.ByteSize();
@@ -176,6 +177,8 @@ std::vector<Response> TcpController::FuseResponses(
         out[it->second].total_bytes + r.total_bytes <=
             opts_.fusion_threshold_bytes) {
       out[it->second].tensor_names.push_back(r.tensor_names[0]);
+      out[it->second].tensor_shapes.push_back(
+          r.tensor_shapes.empty() ? r.first_shape : r.tensor_shapes[0]);
       out[it->second].total_bytes += r.total_bytes;
     } else {
       open[key] = out.size();
@@ -211,18 +214,29 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
     if (all[r].join) joined_ranks_.insert(r);
   }
 
-  // 2. agreed cache hits: AND of all cache bitvectors; joined ranks agree
-  // with everything (they contribute zeros) — reference response_cache
-  // CacheCoordinator semantics
+  // 2. cache coordination (reference CoordinateCacheAndState,
+  // controller.cc:802): agreed hits = AND of all hit bitvectors; agreed
+  // invalidations = OR of all invalid bitvectors. Any rank invalidating a
+  // position vetoes its hit and forces every rank to erase that entry in
+  // this same cycle, so per-rank position tables never diverge. Joined
+  // ranks agree with everything (they contribute zeros to the AND).
   std::vector<uint32_t> agreed_positions;
+  std::vector<uint64_t> agreed_invalid;
   if (cache != nullptr && cache->capacity() > 0) {
     std::vector<std::vector<uint64_t>> bitsets;
     for (int32_t r = 0; r < opts_.size; ++r) {
       if (!joined_ranks_.count(r)) bitsets.push_back(all[r].cache_bits);
+      for (size_t w = 0; w < all[r].invalid_bits.size(); ++w) {
+        if (w >= agreed_invalid.size()) agreed_invalid.resize(w + 1, 0);
+        agreed_invalid[w] |= all[r].invalid_bits[w];
+      }
     }
     if (!bitsets.empty()) {
-      agreed_positions =
-          ResponseCache::BitsToPositions(ResponseCache::Intersect(bitsets));
+      auto hits = ResponseCache::Intersect(bitsets);
+      for (size_t w = 0; w < hits.size() && w < agreed_invalid.size(); ++w) {
+        hits[w] &= ~agreed_invalid[w];
+      }
+      agreed_positions = ResponseCache::BitsToPositions(hits);
     }
   }
 
@@ -300,6 +314,7 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
   }
 
   rl.responses = FuseResponses(std::move(ready));
+  rl.agreed_invalid_bits = std::move(agreed_invalid);
   rl.shutdown = shutdown;
 
   // 7. broadcast the agreed list

@@ -74,8 +74,20 @@ struct Global {
   std::vector<int64_t> join_handles;
   std::atomic<bool> join_requested{false};
 
-  // requests held aside because they cache-hit, awaiting global agreement
-  std::unordered_map<std::string, Request> pending_hits;
+  // a request held aside because it cache-hit, awaiting global agreement;
+  // age counts cycles without agreement — past kMaxHitParkCycles the
+  // request renegotiates instead of deadlocking on a peer whose cache
+  // entry is gone (ADVICE r1 #2)
+  struct ParkedHit {
+    Request request;
+    int age = 0;
+  };
+  std::unordered_map<std::string, ParkedHit> pending_hits;
+  // requests whose cached metadata changed: parked one cycle while the
+  // coordinated invalidation round erases the entry on every rank
+  std::unordered_map<std::string, Request> pending_invalid;
+  // requests to re-submit through full negotiation next cycle
+  std::vector<Request> retry_requests;
 
   double cycle_ms = 1.0;
   int32_t rank = 0;
@@ -117,10 +129,20 @@ void PushBatch(Batch b) {
   g->batch_cv.notify_all();
 }
 
+// Time a cache-hit request stays parked waiting for every rank to agree
+// before falling back to full negotiation — long enough to ride out
+// ordinary inter-rank enqueue skew (data-loading jitter spans tens of
+// ms), far shorter than the stall window.
+constexpr double kHitParkSeconds = 2.0;
+
 // One negotiation cycle (reference RunLoopOnce, operations.cc:722).
 // Returns false to stop the loop.
 bool RunLoopOnce() {
   RequestList own;
+
+  // requests kicked back to full negotiation by earlier cycles
+  for (auto& req : g->retry_requests) own.requests.push_back(std::move(req));
+  g->retry_requests.clear();
 
   // drain new requests, classify against the cache
   auto drained = g->tensor_queue.PopMessages(512);
@@ -129,38 +151,102 @@ bool RunLoopOnce() {
     if (cache_on) {
       auto state = g->cache->Lookup(req);
       if (state == ResponseCache::State::kHit) {
-        g->pending_hits[req.name] = req;
+        // key copied before the move: C++17 sequences the RHS (which
+        // guts req) before the subscript expression
+        const std::string name = req.name;
+        g->pending_hits[name] = Global::ParkedHit{std::move(req), 0};
         g->cache_hits.fetch_add(1);
         continue;
       }
       if (state == ResponseCache::State::kInvalid) {
-        g->cache->Erase(req.name);
+        // don't erase locally — rank-local mutation would diverge the
+        // replicated position table. Park the request and raise the
+        // invalid bit; every rank erases on the coordinator's ORed
+        // verdict this cycle (reference CacheCoordinator).
+        const std::string name = req.name;
+        g->pending_invalid[name] = std::move(req);
+        continue;
       }
     }
     own.requests.push_back(std::move(req));
   }
-  if (cache_on && !g->pending_hits.empty()) {
-    std::vector<uint32_t> positions;
-    positions.reserve(g->pending_hits.size());
-    for (const auto& kv : g->pending_hits) {
-      positions.push_back(g->cache->Position(kv.first));
+  if (cache_on) {
+    if (!g->pending_hits.empty()) {
+      // a parked hit whose entry was LRU-evicted since parking must
+      // renegotiate: its position slot may now hold a different tensor,
+      // and Position() on a missing name would throw
+      std::vector<uint32_t> positions;
+      positions.reserve(g->pending_hits.size());
+      for (auto it = g->pending_hits.begin();
+           it != g->pending_hits.end();) {
+        if (!g->cache->Contains(it->first)) {
+          g->retry_requests.push_back(std::move(it->second.request));
+          it = g->pending_hits.erase(it);
+        } else {
+          positions.push_back(g->cache->Position(it->first));
+          ++it;
+        }
+      }
+      own.cache_bits = g->cache->HitBits(positions);
     }
-    own.cache_bits = g->cache->HitBits(positions);
+    if (!g->pending_invalid.empty()) {
+      std::vector<uint32_t> positions;
+      positions.reserve(g->pending_invalid.size());
+      for (auto it = g->pending_invalid.begin();
+           it != g->pending_invalid.end();) {
+        if (!g->cache->Contains(it->first)) {
+          // entry vanished (evicted) — nothing left to invalidate
+          g->retry_requests.push_back(std::move(it->second));
+          it = g->pending_invalid.erase(it);
+        } else {
+          positions.push_back(g->cache->Position(it->first));
+          ++it;
+        }
+      }
+      own.invalid_bits = g->cache->HitBits(positions);
+    }
   }
   own.join = g->join_requested.load();
   own.shutdown = g->shutdown.load();
 
   ResponseList rl = g->controller->RunCycle(own);
 
+  // Apply the coordinated invalidations before any Put from this cycle's
+  // responses: same order on every rank, identical cache state after.
+  if (cache_on && !rl.agreed_invalid_bits.empty()) {
+    for (uint32_t pos :
+         ResponseCache::BitsToPositions(rl.agreed_invalid_bits)) {
+      const std::string name = g->cache->NameAt(pos);
+      if (name.empty()) continue;
+      g->cache->Erase(name);
+      auto ph = g->pending_hits.find(name);
+      if (ph != g->pending_hits.end()) {
+        // our parked hit's entry was invalidated elsewhere: renegotiate
+        g->retry_requests.push_back(std::move(ph->second.request));
+        g->pending_hits.erase(ph);
+      }
+      auto pi = g->pending_invalid.find(name);
+      if (pi != g->pending_invalid.end()) {
+        g->retry_requests.push_back(std::move(pi->second));
+        g->pending_invalid.erase(pi);
+      }
+    }
+  }
+  // Any invalidation the coordinator didn't echo back (shouldn't happen —
+  // the verdict is an OR) still renegotiates rather than lingering.
+  for (auto& kv : g->pending_invalid) {
+    g->retry_requests.push_back(std::move(kv.second));
+  }
+  g->pending_invalid.clear();
+
   for (auto& resp : rl.responses) {
     if (resp.op == OpType::kError && resp.tensor_names.empty()) {
-      // global/transport error: fail everything pending
+      // global/transport error: fail everything pending (DrainAll covers
+      // parked hits and retries — their table entries were never popped)
       auto all = g->tensor_queue.DrainAll();
-      for (const auto& kv : g->pending_hits) {
-        auto hs = g->tensor_queue.PopEntries({kv.first});
-        all.insert(all.end(), hs.begin(), hs.end());
-      }
       g->pending_hits.clear();
+      g->pending_invalid.clear();
+      g->retry_requests.clear();
       g->broken.store(true);
       FailHandles(all, resp.error_reason);
       continue;
@@ -181,26 +267,34 @@ bool RunLoopOnce() {
       continue;
     }
 
-    std::vector<int64_t> handles = g->tensor_queue.PopEntries(
-        resp.tensor_names);
+    std::vector<PendingEntry> entries =
+        g->tensor_queue.PopEntriesWithRequests(resp.tensor_names);
+    std::vector<int64_t> handles;
+    handles.reserve(entries.size());
+    for (const auto& e : entries) handles.push_back(e.handle);
     if (resp.op == OpType::kError) {
       for (const auto& n : resp.tensor_names) g->pending_hits.erase(n);
       FailHandles(handles, resp.error_reason);
       continue;
     }
-    // refresh/insert cache entries in response order — identical on every
-    // rank, which keeps cache positions replicated (response_cache.h:45)
-    if (cache_on) {
-      for (const auto& name : resp.tensor_names) {
+    // refresh/insert cache entries in response order with each tensor's
+    // *own* metadata (never the fused response's representative shape —
+    // ADVICE r1 #1): the local pending Request when we enqueued this
+    // tensor, else the response's per-tensor shape (joined ranks receive
+    // responses for tensors they never enqueued and must mutate their
+    // cache identically to keep positions replicated,
+    // response_cache.h:45).
+    if (cache_on && resp.op != OpType::kBarrier) {
+      std::unordered_map<std::string, const Request*> local;
+      for (const auto& e : entries) local[e.request.name] = &e.request;
+      for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+        const std::string& name = resp.tensor_names[i];
+        g->pending_hits.erase(name);
         Request req;
-        bool have = false;
-        auto hit = g->pending_hits.find(name);
-        if (hit != g->pending_hits.end()) {
-          req = hit->second;
-          g->pending_hits.erase(hit);
-          have = true;
+        auto it = local.find(name);
+        if (it != local.end()) {
+          req = *it->second;
         } else {
-          // find the request metadata from the response itself
           req.name = name;
           req.op = resp.op;
           req.dtype = resp.dtype;
@@ -208,15 +302,15 @@ bool RunLoopOnce() {
           req.root_rank = resp.root_rank;
           req.prescale = resp.prescale;
           req.postscale = resp.postscale;
-          req.shape = resp.first_shape;
-          have = true;
+          req.shape = i < resp.tensor_shapes.size() ? resp.tensor_shapes[i]
+                                                    : resp.first_shape;
         }
-        if (have && resp.op != OpType::kBarrier) {
-          Response single = resp;
-          single.tensor_names = {name};
-          single.total_bytes = req.ByteSize();
-          g->cache->Put(single, req);
-        }
+        Response single = resp;
+        single.tensor_names = {name};
+        single.first_shape = req.shape;
+        single.tensor_shapes = {req.shape};
+        single.total_bytes = req.ByteSize();
+        g->cache->Put(single, req);
       }
     } else {
       for (const auto& n : resp.tensor_names) g->pending_hits.erase(n);
@@ -228,6 +322,24 @@ bool RunLoopOnce() {
     b.handles = handles;
     for (int64_t h : handles) SetHandle(h, kBatched);
     PushBatch(std::move(b));
+  }
+
+  // Hits still parked after this cycle's verdict: age them; once a hit
+  // has waited ~kHitParkSeconds without global agreement (a peer's entry
+  // was evicted, or it will simply never hit), fall back to full
+  // negotiation so a partial cache hit cannot deadlock (ADVICE r1 #2).
+  if (cache_on && !g->pending_hits.empty()) {
+    const int max_park_cycles = std::max(
+        8, static_cast<int>(kHitParkSeconds * 1000.0 /
+                            std::max(0.01, g->cycle_ms)));
+    for (auto it = g->pending_hits.begin(); it != g->pending_hits.end();) {
+      if (++it->second.age >= max_park_cycles) {
+        g->retry_requests.push_back(std::move(it->second.request));
+        it = g->pending_hits.erase(it);
+      } else {
+        ++it;
+      }
+    }
   }
 
   return !rl.shutdown;
@@ -411,10 +523,22 @@ void hvd_native_batch_done(long long batch_id, const long long* handles,
   {
     std::lock_guard<std::mutex> l(g->handle_mu);
     for (int i = 0; i < n; ++i) {
-      g->handle_states[handles[i]] = ok ? kDone : kFailed;
+      // update-only: a waiter that already consumed its result may have
+      // released the handle — re-inserting here would leak it forever
+      auto it = g->handle_states.find(handles[i]);
+      if (it != g->handle_states.end()) it->second = ok ? kDone : kFailed;
     }
   }
   g->handle_cv.notify_all();
+}
+
+// Drop a handle's state once the caller has observed a terminal state —
+// without this the handle table grows by one entry per collective ever
+// issued (ADVICE r1 #4).
+void hvd_native_release(long long handle) {
+  if (g == nullptr) return;
+  std::lock_guard<std::mutex> l(g->handle_mu);
+  g->handle_states.erase(handle);
 }
 
 const char* hvd_native_last_error() {

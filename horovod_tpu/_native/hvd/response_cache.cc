@@ -26,6 +26,11 @@ const Response& ResponseCache::Get(uint32_t position) const {
   return entries_.at(by_position_.at(position)).response;
 }
 
+const std::string& ResponseCache::NameAt(uint32_t position) const {
+  static const std::string kEmpty;
+  return position < by_position_.size() ? by_position_[position] : kEmpty;
+}
+
 void ResponseCache::Put(const Response& resp, const Request& req) {
   auto it = entries_.find(req.name);
   if (it != entries_.end()) {

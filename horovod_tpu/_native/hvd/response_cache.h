@@ -27,8 +27,15 @@ class ResponseCache {
   // response_cache.h:50): kInvalid = name cached but shape/dtype changed.
   State Lookup(const Request& req) const;
 
+  bool Contains(const std::string& name) const {
+    return entries_.count(name) != 0;
+  }
   uint32_t Position(const std::string& name) const;
   const Response& Get(uint32_t position) const;
+
+  // Name occupying a position ("" if free) — used to apply coordinated
+  // invalidation bitvectors, which address entries by position.
+  const std::string& NameAt(uint32_t position) const;
 
   // Insert/refresh after a negotiated response; evicts LRU at capacity.
   void Put(const Response& resp, const Request& req);

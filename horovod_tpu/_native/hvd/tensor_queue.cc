@@ -20,18 +20,18 @@ std::vector<Request> TensorQueue::PopMessages(size_t max) {
   return out;
 }
 
-std::vector<int64_t> TensorQueue::PopEntries(
+std::vector<PendingEntry> TensorQueue::PopEntriesWithRequests(
     const std::vector<std::string>& names) {
   std::lock_guard<std::mutex> l(mu_);
-  std::vector<int64_t> handles;
+  std::vector<PendingEntry> entries;
   for (const auto& n : names) {
     auto it = table_.find(n);
     if (it != table_.end()) {
-      handles.push_back(it->second.handle);
+      entries.push_back(std::move(it->second));
       table_.erase(it);
     }
   }
-  return handles;
+  return entries;
 }
 
 std::vector<int64_t> TensorQueue::DrainAll() {

@@ -31,9 +31,12 @@ class TensorQueue {
   // (reference PopMessagesFromQueue).
   std::vector<Request> PopMessages(size_t max);
 
-  // Resolve the handles for a negotiated response's tensors, removing them
-  // from the pending table (reference GetTensorEntriesFromResponse).
-  std::vector<int64_t> PopEntries(const std::vector<std::string>& names);
+  // Resolve the entries for a negotiated response's tensors, removing
+  // them from the pending table (reference GetTensorEntriesFromResponse).
+  // Each entry keeps its original Request — the response cache needs true
+  // per-tensor metadata, not the fused response's representative shape.
+  std::vector<PendingEntry> PopEntriesWithRequests(
+      const std::vector<std::string>& names);
 
   // Handles of everything pending (used to fail all on shutdown/error).
   std::vector<int64_t> DrainAll();

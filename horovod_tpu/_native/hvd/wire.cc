@@ -33,6 +33,7 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   w.U8(rl.shutdown ? 1 : 0);
   w.U8(rl.join ? 1 : 0);
   w.Vec(rl.cache_bits);
+  w.Vec(rl.invalid_bits);
   w.I32(static_cast<int32_t>(rl.requests.size()));
   for (const auto& r : rl.requests) WriteRequest(&w, r);
   return w.data();
@@ -44,6 +45,7 @@ bool DeserializeRequestList(const uint8_t* data, size_t len,
   rl->shutdown = r.U8() != 0;
   rl->join = r.U8() != 0;
   rl->cache_bits = r.Vec<uint64_t>();
+  rl->invalid_bits = r.Vec<uint64_t>();
   int32_t n = r.I32();
   rl->requests.clear();
   for (int32_t i = 0; i < n && r.ok(); ++i) {
@@ -64,6 +66,8 @@ static void WriteResponse(Writer* w, const Response& resp) {
   w->I32(static_cast<int32_t>(resp.dtype));
   w->I64(resp.total_bytes);
   w->Vec(resp.first_shape);
+  w->I32(static_cast<int32_t>(resp.tensor_shapes.size()));
+  for (const auto& s : resp.tensor_shapes) w->Vec(s);
 }
 
 static Response ReadResponse(Reader* r) {
@@ -81,6 +85,10 @@ static Response ReadResponse(Reader* r) {
   resp.dtype = static_cast<DataType>(r->I32());
   resp.total_bytes = r->I64();
   resp.first_shape = r->Vec<int64_t>();
+  int32_t ns = r->I32();
+  for (int32_t i = 0; i < ns && r->ok(); ++i) {
+    resp.tensor_shapes.push_back(r->Vec<int64_t>());
+  }
   return resp;
 }
 
@@ -88,6 +96,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   Writer w;
   w.U8(rl.shutdown ? 1 : 0);
   w.I32(rl.join_count);
+  w.Vec(rl.agreed_invalid_bits);
   w.I32(static_cast<int32_t>(rl.responses.size()));
   for (const auto& r : rl.responses) WriteResponse(&w, r);
   return w.data();
@@ -98,6 +107,7 @@ bool DeserializeResponseList(const uint8_t* data, size_t len,
   Reader r(data, len);
   rl->shutdown = r.U8() != 0;
   rl->join_count = r.I32();
+  rl->agreed_invalid_bits = r.Vec<uint64_t>();
   int32_t n = r.I32();
   rl->responses.clear();
   for (int32_t i = 0; i < n && r.ok(); ++i) {

@@ -1,9 +1,16 @@
 """Elastic sampler: skip already-processed samples after a world resize.
 
 Reference: /root/reference/horovod/torch/elastic/sampler.py:24
-(`ElasticSampler`): shards indices over ranks, records processed indices
+(`ElasticSampler`): shards indices over ranks, records processed batches
 via `record_batch`, and `set_epoch`/reshuffles so a resumed epoch skips
 seen data.
+
+State is **rank-symmetric** by construction: the epoch's shuffle order is
+identical on every rank (same seed), and progress is a single global
+cursor `processed_num` advanced by ``batch_size * num_replicas`` per
+recorded batch — the reference's design. That makes `state_dict` identical
+everywhere, so the elastic resync (broadcast rank 0's state) is lossless;
+per-rank index *sets* would diverge and forget other ranks' progress.
 """
 
 from __future__ import annotations
@@ -19,7 +26,7 @@ class ElasticSampler:
         self.shuffle = shuffle
         self.seed = seed
         self.epoch = 0
-        self.processed_indices: set = set()
+        self.processed_num = 0  # global samples consumed this epoch
         self._rank = 0
         self._num_replicas = 1
         self._reset()
@@ -28,7 +35,7 @@ class ElasticSampler:
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
-        self.processed_indices.clear()
+        self.processed_num = 0
         self._reset()
 
     def set_world(self, rank: int, num_replicas: int) -> None:
@@ -37,19 +44,36 @@ class ElasticSampler:
         self._reset()
 
     def record_batch(self, batch_idx: int, batch_size: int) -> None:
-        start = batch_idx * batch_size
-        taken = self.indices[start:start + batch_size]
-        self.processed_indices.update(int(i) for i in taken)
+        """Advance the global cursor by one per-rank batch: every rank
+        consumed `batch_size` samples in lockstep."""
+        del batch_idx  # progress is cumulative, not positional
+        self.processed_num = min(
+            self.processed_num + batch_size * self._num_replicas,
+            self.dataset_size,
+        )
+
+    @property
+    def processed_indices(self) -> List[int]:
+        """Globally-processed sample indices (prefix of the epoch order)."""
+        return [int(i) for i in self._order[: self.processed_num]]
 
     def load_state_dict(self, state: dict) -> None:
         self.epoch = state["epoch"]
-        self.processed_indices = set(state["processed_indices"])
+        if "processed_num" in state:
+            self.processed_num = state["processed_num"]
+        else:
+            # legacy checkpoints stored rank 0's *local* index set; scale
+            # by the replica count to approximate the global cursor
+            self.processed_num = min(
+                len(state["processed_indices"]) * self._num_replicas,
+                self.dataset_size,
+            )
         self._reset()
 
     def state_dict(self) -> dict:
         return {
             "epoch": self.epoch,
-            "processed_indices": sorted(self.processed_indices),
+            "processed_num": self.processed_num,
         }
 
     # iteration ----------------------------------------------------------
@@ -59,7 +83,8 @@ class ElasticSampler:
         if self.shuffle:
             rng = np.random.RandomState(self.seed + self.epoch)
             rng.shuffle(order)
-        remaining = [i for i in order if i not in self.processed_indices]
+        self._order = order
+        remaining = [int(i) for i in order[self.processed_num:]]
         # pad so every replica sees the same count (repeat as many times as
         # needed — near epoch end fewer samples than replicas may remain)
         n = len(remaining)

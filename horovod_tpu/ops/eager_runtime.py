@@ -150,6 +150,7 @@ class EagerRuntime:
         state = self._native.wait(h, timeout_s)
         while state == BATCHED:
             state = self._native.wait(h, timeout_s)
+        self._native.release(h)
         if state != DONE:
             raise HorovodInternalError(
                 f"barrier failed: {self._native.last_error()}"
@@ -167,7 +168,9 @@ class EagerRuntime:
             with self._lock:
                 if handle in self._results:
                     break
-        if self._native.poll(handle) == FAILED:
+        failed = self._native.poll(handle) == FAILED
+        self._native.release(handle)
+        if failed:
             raise HorovodInternalError(self._native.last_error())
         with self._lock:
             if handle not in self._results:

@@ -115,7 +115,7 @@ class ElasticDriver:
                     LOG.error("elastic job cannot continue: %s", e)
                     return 1
                 states = self._run_round()
-                if all(s == SUCCESS for s in states.values()):
+                if states and all(s == SUCCESS for s in states.values()):
                     return 0
                 self._resets += 1
                 if (
@@ -172,7 +172,9 @@ class ElasticDriver:
                         slot.hostname, slot.local_rank
                     )
         spawn_done.wait(timeout=30)
-        states = self._barrier_states
+        # barrier may never have fired if shutdown interrupted the round —
+        # an empty dict means "no successful round", never a crash in run()
+        states = self._barrier_states or {}
         if states:
             for key, state in states.items():
                 if state == FAILURE:

@@ -123,14 +123,29 @@ def test_elastic_sampler_skips_processed():
     s.set_world(0, 2)
     first = list(s)[:3]
     assert first == [0, 2, 4]
-    s.record_batch(0, 3)
+    s.record_batch(0, 3)  # both replicas consumed 3 → 6 globally
     s.set_world(0, 2)  # resize triggers reset with processed skip
-    assert not (set(first) & set(s.indices))
-    # state roundtrip
+    assert not (set(range(6)) & set(s.indices))
+    # state roundtrip — identical on every rank (global cursor, not
+    # rank-local index sets), so broadcasting rank 0's state is lossless
     state = s.state_dict()
     s2 = ElasticSampler(dataset_size=20, shuffle=False)
     s2.load_state_dict(state)
-    assert set(s2.processed_indices) == {0, 2, 4}
+    assert set(s2.processed_indices) == set(range(6))
+
+
+def test_elastic_sampler_state_rank_symmetric():
+    """Every rank's state_dict must agree after the same recorded batches,
+    so an elastic resync (broadcast of rank 0's state) loses nothing."""
+    states = []
+    for rank in range(4):
+        s = ElasticSampler(dataset_size=32, shuffle=True, seed=7)
+        s.set_world(rank, 4)
+        s.record_batch(0, 2)
+        s.record_batch(1, 2)
+        states.append(s.state_dict())
+    assert all(st == states[0] for st in states)
+    assert states[0]["processed_num"] == 16  # 2 batches × 2 × 4 replicas
 
 
 # ------------------------------------------------------- callbacks
@@ -220,7 +235,7 @@ def test_elastic_sampler_pad_shortfall_keeps_shards_equal():
     lengths = []
     for rank in range(8):
         s = ElasticSampler(dataset_size=11, shuffle=False)
-        s.processed_indices = set(range(8))  # 3 remain, 8 replicas
+        s.processed_num = 8  # 3 remain, 8 replicas
         s.set_world(rank, 8)
         lengths.append(len(s))
     assert len(set(lengths)) == 1 and lengths[0] > 0

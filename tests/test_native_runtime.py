@@ -210,6 +210,72 @@ def scenario_join(native, rt, rank, size):
     return {"log": log, "join_state": rt.poll(jh)}
 
 
+def scenario_cache_heterogeneous(native, rt, rank, size):
+    """Heterogeneous shapes fuse into one response; cached per-tensor
+    metadata must still be each tensor's own shape, so later rounds HIT
+    instead of churning through invalidate/renegotiate (ADVICE r1 #1)."""
+    shapes = {"h0": [4], "h1": [8], "h2": [2, 3]}
+    for step in range(4):
+        hs = [
+            rt.enqueue(n, native.OP_ALLREDUCE, "float32", shp)
+            for n, shp in shapes.items()
+        ]
+        _drain_until(rt, hs)
+    return {"cache_hits": rt.cache_hits()}
+
+
+def test_fused_heterogeneous_shapes_cache_correctly():
+    out = _run_world(2, scenario_cache_heterogeneous)
+    for r in range(2):
+        # rounds 2-4 should be steady-state hits: ≥ 3 tensors × 2 rounds
+        assert out[r]["cache_hits"] >= 6, out[r]
+
+
+def scenario_coordinated_invalidation(native, rt, rank, size):
+    """Shape change after caching: every rank must erase the entry in the
+    same cycle and renegotiate (reference CacheCoordinator semantics)."""
+    states = []
+    for shape in ([4], [4], [6], [6]):  # cache, hit, invalidate, re-hit
+        h = rt.enqueue("mut", native.OP_ALLREDUCE, "float32", shape)
+        _drain_until(rt, [h])
+        states.append(rt.poll(h))
+    return {"states": states, "cache_hits": rt.cache_hits()}
+
+
+def test_shape_change_invalidates_and_renegotiates():
+    out = _run_world(2, scenario_coordinated_invalidation)
+    for r in range(2):
+        assert all(s == rt_mod_DONE for s in out[r]["states"]), out[r]
+        assert out[r]["cache_hits"] >= 2, out[r]  # rounds 2 and 4 hit
+
+
+def scenario_partial_hit_mismatch(native, rt, rank, size):
+    """Rank 0 re-submits with the cached metadata (hit), rank 1 changes
+    the shape (invalid). Previously rank 0's parked hit deadlocked; now
+    the coordinated erase kicks both into negotiation, which surfaces a
+    consistent shape-mismatch error — and the world stays usable."""
+    h = rt.enqueue("p", native.OP_ALLREDUCE, "float32", [8])
+    _drain_until(rt, [h])
+    shape = [8] if rank == 0 else [5]
+    h2 = rt.enqueue("p", native.OP_ALLREDUCE, "float32", shape)
+    state2 = rt.wait(h2, timeout_s=20.0)
+    while state2 == 1:  # BATCHED: drain the error batch if one appears
+        b = rt.next_batch(timeout_s=0.2)
+        if b is not None:
+            rt.batch_done(b, ok=True)
+        state2 = rt.wait(h2, timeout_s=5.0)
+    h3 = rt.enqueue("q", native.OP_ALLREDUCE, "float32", [3])
+    _drain_until(rt, [h3])
+    return {"mismatch_state": state2, "after_state": rt.poll(h3)}
+
+
+def test_partial_cache_hit_does_not_deadlock():
+    out = _run_world(2, scenario_partial_hit_mismatch)
+    for r in range(2):
+        assert out[r]["mismatch_state"] == rt_mod_FAILED, out[r]
+        assert out[r]["after_state"] == rt_mod_DONE, out[r]
+
+
 def test_join_covers_missing_ranks():
     """Rank 1 has one extra batch; rank 0 joins — the tensor completes with
     rank 0 counted as a zero contributor, then join completes everywhere
