@@ -99,9 +99,12 @@ class Knobs:
 
     # --- hierarchy (operations.cc:551-565) ---
     # On TPU: "hierarchical" = reduce-scatter over ICI within a slice, then
-    # all-reduce across slices over DCN, then all-gather over ICI.
+    # all-reduce across slices over DCN, then all-gather over ICI
+    # (ops/hierarchical.py). local_size: ranks per inner (ICI) domain when
+    # the world is one flat axis; 0 = auto (process-local device count).
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
+    hierarchical_local_size: int = 0
 
     # --- elastic ---
     elastic_timeout_seconds: float = 600.0
@@ -147,6 +150,7 @@ class Knobs:
             compression_wire_dtype=_env("COMPRESSION_WIRE_DTYPE", "") or "",
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
+            hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),
             elastic_timeout_seconds=_env_float("ELASTIC_TIMEOUT", 600.0),
             reset_limit=_env_int("RESET_LIMIT", 0),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),

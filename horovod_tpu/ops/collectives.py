@@ -152,7 +152,15 @@ def _spmd_allreduce_leaf(x, op, axes, ps, prescale, postscale):
         # ADASUM at the lax level degenerates to a sum here; the adaptive
         # combining lives in ops/adasum.py and is dispatched by allreduce()
         # before reaching this leaf.
-        y = lax.psum(x, axis_arg, axis_index_groups=groups)
+        from . import hierarchical
+
+        if hierarchical.hierarchy_enabled_for("allreduce", ps, axes):
+            y = hierarchical.hierarchical_psum(
+                x, axes, basics.bound_axis_sizes(),
+                global_state().knobs.hierarchical_local_size,
+            )
+        else:
+            y = lax.psum(x, axis_arg, axis_index_groups=groups)
         if op == ReduceOp.AVERAGE:
             if groups is None:
                 y = (y / nset).astype(x.dtype)
@@ -206,6 +214,13 @@ def _spmd_allgather_leaf(x, axes, ps):
         # types all_gather output as device-varying; callers returning it
         # through shard_map out_specs=P() should pass check_vma=False or
         # psum-mask it (see the PRODUCT branch of _spmd_allreduce_leaf).
+        from . import hierarchical
+
+        if hierarchical.hierarchy_enabled_for("allgather", ps, axes):
+            return hierarchical.hierarchical_allgather(
+                x, axes, basics.bound_axis_sizes(),
+                global_state().knobs.hierarchical_local_size,
+            )
         return lax.all_gather(x, axis_arg, tiled=True)
     # Proper subset: XLA all-gather wants equal-size groups; emulate with
     # scatter-into-zeros + group psum (constant extra FLOPs, one collective).
@@ -377,7 +392,15 @@ def _eager_perrank(op_kind: str, stacked, op=ReduceOp.SUM, prescale=1.0,
         op_kind, ndev, int(op), float(prescale), float(postscale),
         int(root_rank), st.epoch,
     )
-    out = prog(stacked)
+    from contextlib import nullcontext
+
+    from ..utils.timeline import active_timeline
+
+    tl = active_timeline()
+    # host-side span around the XLA dispatch (reference analog: the
+    # NCCL_* op activity, timeline.cc; device time is in xplane)
+    with tl.activity(op_kind, "XLA_COLLECTIVE") if tl else nullcontext():
+        out = prog(stacked)
     if jax.default_backend() == "cpu":
         # On the virtual CPU mesh two concurrently-executing multi-partition
         # programs can starve each other's collective rendezvous when the

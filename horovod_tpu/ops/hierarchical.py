@@ -1,0 +1,154 @@
+"""Hierarchical (two-level) collectives: the ICI×DCN scaling lever.
+
+Reference: /root/reference/horovod/common/ops/nccl_operations.h:227
+(`NCCLHierarchicalAllreduce`: intra-node ncclReduceScatter → cross-node
+MPI allreduce of the residual → intra-node ncclAllGather) and
+`MPIHierarchicalAllgather` in mpi_operations.cc (node-leader gather +
+shared-memory window). Selected by `HOROVOD_HIERARCHICAL_ALLREDUCE` /
+`HOROVOD_HIERARCHICAL_ALLGATHER` (operations.cc:551-565).
+
+TPU translation: "node" becomes "slice" — the fast inner domain is the
+ICI torus, the slow outer domain is DCN. The structure is the same and
+for the same reason: the bandwidth-bound outer leg must move 1/k of the
+bytes (k = inner-domain size), so
+
+    allreduce(x)  =  all_gather_inner( psum_outer( rs_inner(x) ) )
+    allgather(x)  =  all_gather_outer( all_gather_inner(x) )
+
+Two forms:
+
+* **two axes** — the reduction world is already factored into mesh axes
+  (inner = last axis, laid out innermost on the torus by
+  parallel/mesh.py): collectives address whole axes, no groups needed.
+* **one axis + block size** — the world is one flat axis whose ranks
+  0..n-1 pack `block` consecutive ranks per inner domain (the launcher's
+  rank model: local ranks are contiguous, hosts are the outer level —
+  runner/util/hosts.py SlotInfo). Inner groups are contiguous blocks,
+  outer groups are strided, expressed as `axis_index_groups`.
+
+Numerics are identical to the flat psum (sum reassociation over a
+partition of the world); a structure test asserts the emitted HLO
+differs (reduce-scatter+all-gather vs one all-reduce).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import basics
+from ..core.exceptions import HorovodInternalError
+
+
+def _flatten_pad(x, multiple: int):
+    """Flatten to 1-D and zero-pad so the length divides `multiple`."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    rem = n % multiple
+    if rem:
+        flat = jnp.pad(flat, (0, multiple - rem))
+    return flat, n
+
+
+def _block_groups(world: int, block: int) -> Tuple[list, list]:
+    """(inner, outer) axis_index_groups for contiguous blocks of `block`
+    ranks: inner = [0..b-1], [b..2b-1], ...; outer = strided across
+    blocks at equal offset (the cross-node communicator of the
+    reference's rank model, controller.h:120-132)."""
+    inner = [list(range(i, i + block)) for i in range(0, world, block)]
+    nblocks = world // block
+    outer = [
+        [off + b * block for b in range(nblocks)] for off in range(block)
+    ]
+    return inner, outer
+
+
+def resolve_block(world: int, block: int = 0) -> int:
+    """Pick the inner-domain size: explicit knob value, else the process-
+    local device count (ICI domain ≈ node), else no hierarchy (1)."""
+    if block <= 0:
+        try:
+            block = basics.local_size()
+        except Exception:
+            return 1
+    if block <= 1 or block >= world or world % block:
+        return 1
+    return block
+
+
+def hierarchical_psum(x, axes: Sequence[str], axis_sizes, block: int = 0):
+    """Two-level sum of `x` over `axes`, equal in value to
+    ``lax.psum(x, axes)``.
+
+    axes: 1 axis (split by `block` via groups) or 2+ axes (last axis =
+    inner/ICI level, the rest = outer). axis_sizes: name -> extent.
+    """
+    axes = tuple(axes)
+    if len(axes) >= 2:
+        inner_ax = axes[-1]
+        outer_ax = axes[:-1] if len(axes) > 2 else axes[0]
+        k = axis_sizes[inner_ax]
+        flat, n = _flatten_pad(x, k)
+        rs = lax.psum_scatter(flat, inner_ax, scatter_dimension=0,
+                              tiled=True)
+        ar = lax.psum(rs, outer_ax)
+        out = lax.all_gather(ar, inner_ax, tiled=True)
+        return out[:n].reshape(x.shape)
+
+    axis = axes[0]
+    world = axis_sizes[axis]
+    block = resolve_block(world, block)
+    if block == 1:
+        return lax.psum(x, axis)
+    inner, outer = _block_groups(world, block)
+    flat, n = _flatten_pad(x, block)
+    rs = lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True,
+                          axis_index_groups=inner)
+    ar = lax.psum(rs, axis, axis_index_groups=outer)
+    out = lax.all_gather(ar, axis, tiled=True, axis_index_groups=inner)
+    return out[:n].reshape(x.shape)
+
+
+def hierarchical_allgather(x, axes: Sequence[str], axis_sizes,
+                           block: int = 0):
+    """Two-level dim-0 concatenation equal in value to a flat tiled
+    ``lax.all_gather`` over `axes` (rank order = outer-major, matching
+    the flat gather's index order)."""
+    axes = tuple(axes)
+    if len(axes) >= 2:
+        inner_ax = axes[-1]
+        g = lax.all_gather(x, inner_ax, tiled=True)
+        for ax in reversed(axes[:-1]):
+            g = lax.all_gather(g, ax, tiled=True)
+        return g
+
+    axis = axes[0]
+    world = axis_sizes[axis]
+    block = resolve_block(world, block)
+    if block == 1:
+        return lax.all_gather(x, axis, tiled=True)
+    inner, outer = _block_groups(world, block)
+    g = lax.all_gather(x, axis, tiled=True, axis_index_groups=inner)
+    # outer gather concatenates blocks in block order == global rank order
+    return lax.all_gather(g, axis, tiled=True, axis_index_groups=outer)
+
+
+def hierarchy_enabled_for(op_kind: str, ps, axes: Sequence[str]) -> bool:
+    """Knob gate: hierarchical routing applies to global-set SUM/AVERAGE
+    allreduce and allgather (the reference restricts likewise:
+    nccl_operations.h:227 is allreduce-only sum; MPIHierarchicalAllgather
+    requires the global communicator)."""
+    from ..core.state import global_state
+
+    st = global_state()
+    if ps is not None or not st.initialized:
+        return False
+    k = st.knobs
+    if op_kind == "allreduce":
+        return bool(k.hierarchical_allreduce)
+    if op_kind == "allgather":
+        return bool(k.hierarchical_allgather)
+    return False

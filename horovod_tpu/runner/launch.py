@@ -84,6 +84,10 @@ def parse_args(argv: Optional[List[str]] = None):
     p.add_argument("--hierarchical-allgather",
                    dest="hierarchical_allgather", action="store_true",
                    default=None)
+    p.add_argument("--hierarchical-local-size",
+                   dest="hierarchical_local_size", type=int,
+                   help="ranks per inner (ICI) domain for hierarchical "
+                        "collectives; 0 = auto (local device count)")
     p.add_argument("--stall-check-disable", dest="stall_check_disable",
                    action="store_true", default=None)
     p.add_argument("--stall-warning-time-seconds",

@@ -22,6 +22,7 @@ ARG_TO_ENV = {
     "compression_wire_dtype": "HOROVOD_COMPRESSION_WIRE_DTYPE",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
     "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
+    "hierarchical_local_size": "HOROVOD_HIERARCHICAL_LOCAL_SIZE",
     "elastic_timeout": "HOROVOD_ELASTIC_TIMEOUT",
     "reset_limit": "HOROVOD_RESET_LIMIT",
     "stall_check_disable": "HOROVOD_STALL_CHECK_DISABLE",

@@ -1,0 +1,82 @@
+"""Timeline: events actually flow from the collective layers into the
+Chrome-trace writer (reference analog: test/parallel/test_timeline.py,
+which asserts the JSON trace structure of a traced run).
+
+Covers the r1 verdict item "Timeline is dead code": the negotiation and
+execution phases must be emitted by the eager runtime, the XLA dispatch
+span by the eager collective path, and fusion plans by the fusion layer.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+def _events(path):
+    with open(path) as f:
+        evs = json.load(f)
+    assert isinstance(evs, list)
+    for ev in evs:
+        assert {"ph", "name", "ts", "pid", "tid"} <= set(ev)
+    return evs
+
+
+def test_eager_collective_emits_xla_spans(hvd8, tmp_path):
+    trace = str(tmp_path / "timeline.json")
+    hvd.start_timeline(trace)
+    hvd.allreduce(jnp.ones((4,)), op=hvd.Sum)
+    hvd.allgather(jnp.ones((2, 2)))
+    hvd.grouped_allreduce([jnp.ones((3,)), jnp.ones((5,))], op=hvd.Sum)
+    hvd.stop_timeline()
+
+    evs = _events(trace)
+    spans = [e for e in evs if e["name"] == "XLA_COLLECTIVE"]
+    assert {e["ph"] for e in spans} == {"B", "E"}
+    assert sum(e["ph"] == "B" for e in spans) >= 2  # allreduce + allgather
+    fusion = [e for e in evs if e["name"] == "FUSION_PLAN"]
+    assert fusion and fusion[0]["ph"] == "i"
+    assert fusion[0]["args"]["tensors"] == 2
+
+
+def test_eager_runtime_emits_negotiation_phases(hvd8, tmp_path):
+    from horovod_tpu.ops.eager_runtime import EagerRuntime
+
+    trace = str(tmp_path / "timeline_rt.json")
+    hvd.start_timeline(trace, mark_cycles=True)
+    rt = EagerRuntime(0, 1, cache_capacity=0)
+    try:
+        h = rt.allreduce_async("grad/w", np.ones((4,), np.float32))
+        out = rt.synchronize(h)
+        np.testing.assert_allclose(out, np.ones((4,), np.float32))
+    finally:
+        rt.shutdown()
+    hvd.stop_timeline()
+
+    evs = _events(trace)
+    by_tensor = [e for e in evs if e["tid"] == "grad/w"]
+    phases = [(e["ph"], e["name"]) for e in by_tensor]
+    # negotiation opens at enqueue, closes when the batch is agreed; the
+    # execution span wraps the data-plane run (reference phase story,
+    # common.h:79-113)
+    assert phases.index(("B", "NEGOTIATE_ALLREDUCE")) < phases.index(
+        ("E", "NEGOTIATE_ALLREDUCE")
+    )
+    assert phases.index(("E", "NEGOTIATE_ALLREDUCE")) <= phases.index(
+        ("B", "ALLREDUCE")
+    )
+    assert phases.index(("B", "ALLREDUCE")) < phases.index(("E", "ALLREDUCE"))
+    assert any(e["name"] == "CYCLE_START" for e in evs)
+
+
+def test_timeline_json_is_well_formed_after_stop(hvd8, tmp_path):
+    trace = str(tmp_path / "timeline_wf.json")
+    hvd.start_timeline(trace)
+    hvd.allreduce(jnp.ones(()), op=hvd.Sum)
+    hvd.stop_timeline()
+    evs = _events(trace)  # json.load raises on malformed output
+    assert all(isinstance(e["ts"], (int, float)) for e in evs)
