@@ -102,8 +102,9 @@ class ExecutionBatch:
 
     def __init__(self, batch_id, op, reduce_op, root_rank, prescale,
                  postscale, dtype, total_bytes, names, handles, first_shape,
-                 error_reason):
+                 error_reason, cycle=0):
         self.batch_id = batch_id
+        self.cycle = cycle
         self.op = op
         self.reduce_op = reduce_op
         self.root_rank = root_rank
@@ -220,6 +221,7 @@ class NativeRuntime:
             return None
         r = _BatchReader(buf.raw[:n])
         batch_id = r.i64()
+        cycle = r.i64()
         op = r.i32()
         reduce_op = r.i32()
         root_rank = r.i32()
@@ -233,7 +235,7 @@ class NativeRuntime:
         error_reason = r.s()
         return ExecutionBatch(batch_id, op, reduce_op, root_rank, prescale,
                               postscale, dtype, total_bytes, names, handles,
-                              first_shape, error_reason)
+                              first_shape, error_reason, cycle=cycle)
 
     def batch_done(self, batch: ExecutionBatch, ok: bool = True) -> None:
         arr = (ctypes.c_longlong * len(batch.handles))(*batch.handles)

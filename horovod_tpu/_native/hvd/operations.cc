@@ -44,6 +44,7 @@ enum HandleState : int {
 
 struct Batch {
   int64_t id = 0;
+  int64_t cycle = 0;  // negotiation cycle that produced this batch
   Response response;
   std::vector<int64_t> handles;
 };
@@ -59,6 +60,7 @@ struct Global {
   std::atomic<bool> initialized{false};
   std::atomic<int64_t> handle_counter{1};
   std::atomic<int64_t> batch_counter{1};
+  std::atomic<int64_t> cycle_counter{0};
   std::atomic<int64_t> cache_hits{0};
   std::atomic<int64_t> bytes_negotiated{0};
 
@@ -138,6 +140,7 @@ constexpr double kHitParkSeconds = 2.0;
 // One negotiation cycle (reference RunLoopOnce, operations.cc:722).
 // Returns false to stop the loop.
 bool RunLoopOnce() {
+  const int64_t cycle = g->cycle_counter.fetch_add(1) + 1;
   RequestList own;
 
   // requests kicked back to full negotiation by earlier cycles
@@ -260,6 +263,7 @@ bool RunLoopOnce() {
       g->join_requested.store(false);
       Batch b;
       b.id = g->batch_counter.fetch_add(1);
+      b.cycle = cycle;
       b.response = resp;
       b.handles = hs;
       for (int64_t h : hs) SetHandle(h, kBatched);
@@ -318,6 +322,7 @@ bool RunLoopOnce() {
     g->bytes_negotiated.fetch_add(resp.total_bytes);
     Batch b;
     b.id = g->batch_counter.fetch_add(1);
+    b.cycle = cycle;
     b.response = resp;
     b.handles = handles;
     for (int64_t h : handles) SetHandle(h, kBatched);
@@ -467,9 +472,15 @@ int hvd_native_poll(long long handle) {
 int hvd_native_wait(long long handle, double timeout_s) {
   if (g == nullptr) return kFailed;
   std::unique_lock<std::mutex> l(g->handle_mu);
+  // an unknown handle was never enqueued or was already released after a
+  // terminal wait: report kFailed (same verdict as poll) instead of
+  // kPending, which would make a repeat synchronize spin forever
+  if (g->handle_states.find(handle) == g->handle_states.end()) {
+    return kFailed;
+  }
   auto pred = [&] {
     auto it = g->handle_states.find(handle);
-    return it != g->handle_states.end() &&
+    return it == g->handle_states.end() ||
            (it->second == kDone || it->second == kFailed ||
             it->second == kBatched);
   };
@@ -477,11 +488,13 @@ int hvd_native_wait(long long handle, double timeout_s) {
           l, std::chrono::duration<double>(timeout_s), pred)) {
     return kPending;
   }
-  return g->handle_states[handle];
+  auto it = g->handle_states.find(handle);
+  return it == g->handle_states.end() ? kFailed : it->second;
 }
 
-// Serialized batch: id, op, reduce_op, root_rank, prescale, postscale,
-// dtype, total_bytes, names, handles, first_shape, error_reason.
+// Serialized batch: id, cycle, op, reduce_op, root_rank, prescale,
+// postscale, dtype, total_bytes, names, handles, first_shape,
+// error_reason.
 long long hvd_native_next_batch(unsigned char* buf, long long buflen,
                                 double timeout_s) {
   if (g == nullptr) return -1;
@@ -499,6 +512,7 @@ long long hvd_native_next_batch(unsigned char* buf, long long buflen,
   }
   Writer w;
   w.I64(b.id);
+  w.I64(b.cycle);
   w.I32(static_cast<int32_t>(b.response.op));
   w.I32(b.response.reduce_op);
   w.I32(b.response.root_rank);

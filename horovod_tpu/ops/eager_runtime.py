@@ -37,6 +37,7 @@ from .._native import (
     FAILED,
     OP_ALLGATHER,
     OP_ALLREDUCE,
+    OP_ALLTOALL,
     OP_BARRIER,
     OP_BROADCAST,
     OP_JOIN,
@@ -47,6 +48,23 @@ from .._native import (
 
 _REDUCE_AVERAGE = 0
 _REDUCE_SUM = 1
+
+# op id -> (negotiation activity, execution activity) — the reference's
+# per-tensor phase names (common.h:79-113, timeline.cc)
+_OP_ACTIVITIES = {
+    OP_ALLREDUCE: ("NEGOTIATE_ALLREDUCE", "ALLREDUCE"),
+    OP_ALLGATHER: ("NEGOTIATE_ALLGATHER", "ALLGATHER"),
+    OP_BROADCAST: ("NEGOTIATE_BROADCAST", "BROADCAST"),
+    OP_ALLTOALL: ("NEGOTIATE_ALLTOALL", "ALLTOALL"),
+    OP_REDUCESCATTER: ("NEGOTIATE_REDUCESCATTER", "REDUCESCATTER"),
+}
+
+
+def _timeline():
+    """The active host-side timeline, or None (utils/timeline.py)."""
+    from ..utils.timeline import active_timeline
+
+    return active_timeline()
 
 
 class LoopbackExecutor:
@@ -112,6 +130,8 @@ class EagerRuntime:
         self._inputs: Dict[str, np.ndarray] = {}
         self._results: Dict[int, np.ndarray] = {}
         self._handle_name: Dict[int, str] = {}
+        self._handle_op: Dict[int, int] = {}
+        self._last_cycle = -1
         self._shutdown = threading.Event()
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="hvd-eager-executor"
@@ -124,6 +144,11 @@ class EagerRuntime:
                 reduce_op: int = _REDUCE_SUM, root_rank: int = 0,
                 prescale: float = 1.0, postscale: float = 1.0) -> int:
         arr = np.asarray(tensor)
+        tl = _timeline()
+        if tl is not None and op in _OP_ACTIVITIES:
+            tl.activity_start(name, _OP_ACTIVITIES[op][0],
+                              args={"shape": list(arr.shape),
+                                    "dtype": str(arr.dtype)})
         handle = self._native.enqueue(
             name, op, str(arr.dtype), list(arr.shape),
             reduce_op=reduce_op, root_rank=root_rank,
@@ -132,6 +157,7 @@ class EagerRuntime:
         with self._lock:
             self._inputs[name] = arr
             self._handle_name[handle] = name
+            self._handle_op[handle] = op
         return handle
 
     def allreduce_async(self, name: str, tensor, average: bool = False,
@@ -171,6 +197,16 @@ class EagerRuntime:
         failed = self._native.poll(handle) == FAILED
         self._native.release(handle)
         if failed:
+            # a handle that never reached the executor failed in
+            # negotiation: close its still-open NEGOTIATE span
+            with self._lock:
+                name = self._handle_name.pop(handle, None)
+                op = self._handle_op.pop(handle, None)
+                self._inputs.pop(name, None)
+            tl = _timeline()
+            if tl is not None and name is not None and op in _OP_ACTIVITIES:
+                tl.activity_end(name, _OP_ACTIVITIES[op][0])
+                tl.instant(name, "ERROR")
             raise HorovodInternalError(self._native.last_error())
         with self._lock:
             if handle not in self._results:
@@ -187,9 +223,36 @@ class EagerRuntime:
             batch = self._native.next_batch(timeout_s=0.1)
             if batch is None:
                 continue
+            tl = _timeline()
+            if tl is not None and batch.cycle != self._last_cycle:
+                # one marker per negotiation cycle, however many fused
+                # batches it produced (reference MarkCycleStart,
+                # operations.cc:734)
+                self._last_cycle = batch.cycle
+                tl.mark_cycle_start()
             if batch.op in (OP_JOIN, OP_BARRIER):
                 self._native.batch_done(batch, ok=True)
                 continue
+            negotiate, execute = _OP_ACTIVITIES.get(batch.op, (None, None))
+            # only tensors THIS rank enqueued get span events — a joined
+            # rank receives batches naming tensors it never started, and
+            # an E without a B corrupts the trace's track nesting
+            with self._lock:
+                ours = [
+                    self._handle_name[h]
+                    for h in batch.handles if h in self._handle_name
+                ]
+            if tl is not None and negotiate is not None:
+                # negotiation ended for every tensor in the fused batch;
+                # the execution span carries the fused-batch composition
+                # (reference: FuseResponses → per-tensor op activities)
+                for n in ours:
+                    tl.activity_end(n, negotiate)
+                    tl.activity_start(
+                        n, execute,
+                        args={"batch_id": batch.batch_id,
+                              "fused_with": len(batch.names)},
+                    )
             try:
                 with self._lock:
                     tensors = {
@@ -200,12 +263,22 @@ class EagerRuntime:
                 with self._lock:
                     for h in batch.handles:
                         name = self._handle_name.pop(h, None)
+                        self._handle_op.pop(h, None)
                         if name is not None and name in results:
                             self._results[h] = results[name]
                         self._inputs.pop(name, None)
                 self._native.batch_done(batch, ok=True)
             except Exception:
                 self._native.batch_done(batch, ok=False)
+                with self._lock:
+                    for h in batch.handles:
+                        name = self._handle_name.pop(h, None)
+                        self._handle_op.pop(h, None)
+                        self._inputs.pop(name, None)
+            finally:
+                if tl is not None and execute is not None:
+                    for n in ours:
+                        tl.activity_end(n, execute)
 
     # ------------------------------------------------------------ stats
 

@@ -35,6 +35,20 @@ def _threshold_bytes() -> int:
     return st.knobs.fusion_threshold_bytes
 
 
+def _record_fusion(n_tensors: int, n_buckets: int, threshold: int) -> None:
+    """Timeline instant marking a (compile-time) fusion plan — the analog
+    of the reference's MEMCPY_IN/OUT_FUSION_BUFFER runtime phases, which
+    XLA absorbs into the collective's prologue/epilogue here."""
+    from ..utils.timeline import active_timeline
+
+    tl = active_timeline()
+    if tl is not None:
+        tl.instant("fusion", "FUSION_PLAN", args={
+            "tensors": n_tensors, "buckets": n_buckets,
+            "threshold_bytes": threshold,
+        })
+
+
 def fuse_apply(
     tensors: Sequence,
     fn: Callable,
@@ -75,14 +89,17 @@ def fuse_apply(
                 )
                 off += n
 
+        n_buckets = 1
         for i in idxs:
             nbytes = arrs[i].size * itemsize
             if bucket and bucket_bytes + nbytes > threshold_bytes:
                 flush(bucket)
                 bucket, bucket_bytes = [], 0
+                n_buckets += 1
             bucket.append(i)
             bucket_bytes += nbytes
         flush(bucket)
+        _record_fusion(len(idxs), n_buckets, threshold_bytes)
     return out
 
 
@@ -115,6 +132,7 @@ def flatten_pytree_buckets(tree, threshold_bytes: int | None = None):
                 plan.append(cur_plan)
             cur, cur_bytes, cur_plan, off = [], 0, [], 0
 
+        n_buckets_before = len(buckets)
         for i in idxs:
             a = jnp.asarray(leaves[i]).reshape(-1)
             nbytes = a.size * itemsize
@@ -125,6 +143,8 @@ def flatten_pytree_buckets(tree, threshold_bytes: int | None = None):
             off += a.size
             cur_bytes += nbytes
         flush()
+        _record_fusion(len(idxs), len(buckets) - n_buckets_before,
+                       threshold_bytes)
 
     def unflatten(reduced_buckets):
         new_leaves = [None] * len(leaves)

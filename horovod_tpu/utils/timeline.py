@@ -161,6 +161,15 @@ class Timeline:
             f.write("\n]\n")
 
 
+def active_timeline() -> Optional["Timeline"]:
+    """The framework's timeline when tracing is on, else None — the one
+    gate every event-emitting layer uses."""
+    from ..core.state import global_state
+
+    tl = global_state().timeline
+    return tl if tl is not None and tl.active else None
+
+
 # -- jax profiler passthrough ----------------------------------------------
 
 def profiler_trace(logdir: str):
