@@ -43,10 +43,28 @@ DONE = 2
 FAILED = -1
 
 
+def _sources_newer_than_lib() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    src_dir = os.path.join(_DIR, "hvd")
+    candidates = [os.path.join(_DIR, "Makefile")]
+    if os.path.isdir(src_dir):
+        candidates += [
+            os.path.join(src_dir, f) for f in os.listdir(src_dir)
+        ]
+    return any(
+        os.path.getmtime(p) > lib_mtime
+        for p in candidates if os.path.isfile(p)
+    )
+
+
 def build(force: bool = False) -> str:
-    """Compile libhvd_tpu_core.so (idempotent)."""
+    """Compile libhvd_tpu_core.so. Rebuilds when any native source is
+    newer than the library — a stale .so with an old batch wire format
+    would crash the Python-side reader."""
     with _lock:
-        if force or not os.path.exists(_LIB_PATH):
+        if force or _sources_newer_than_lib():
             subprocess.check_call(
                 ["make", "-C", _DIR] + (["clean", "all"] if force else []),
                 stdout=subprocess.DEVNULL,
@@ -70,6 +88,7 @@ def load() -> ctypes.CDLL:
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_double, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
     ]
     lib.hvd_native_enqueue.restype = ctypes.c_longlong
     lib.hvd_native_join.restype = ctypes.c_longlong
@@ -102,9 +121,11 @@ class ExecutionBatch:
 
     def __init__(self, batch_id, op, reduce_op, root_rank, prescale,
                  postscale, dtype, total_bytes, names, handles, first_shape,
-                 error_reason, cycle=0):
+                 error_reason, cycle=0, rank_dim0=(), all_splits=()):
         self.batch_id = batch_id
         self.cycle = cycle
+        self.rank_dim0 = list(rank_dim0)    # allgather: per-rank dim-0
+        self.all_splits = list(all_splits)  # alltoall: flattened matrix
         self.op = op
         self.reduce_op = reduce_op
         self.root_rank = root_rank
@@ -186,11 +207,14 @@ class NativeRuntime:
     def enqueue(self, name: str, op: int, dtype: str,
                 shape: Sequence[int], reduce_op: int = 1,
                 root_rank: int = 0, prescale: float = 1.0,
-                postscale: float = 1.0) -> int:
+                postscale: float = 1.0,
+                splits: Optional[Sequence[int]] = None) -> int:
         arr = (ctypes.c_longlong * len(shape))(*shape)
+        sp = (ctypes.c_longlong * len(splits))(*splits) if splits else None
         h = self._lib.hvd_native_enqueue(
             name.encode(), op, _NUMPY_TO_DTYPE[dtype], arr, len(shape),
             reduce_op, root_rank, prescale, postscale,
+            sp, len(splits) if splits else 0,
         )
         if h < 0:
             raise RuntimeError(
@@ -217,6 +241,11 @@ class NativeRuntime:
     def next_batch(self, timeout_s: float = 1.0) -> Optional[ExecutionBatch]:
         buf = ctypes.create_string_buffer(1 << 20)
         n = self._lib.hvd_native_next_batch(buf, len(buf), timeout_s)
+        if n < 0:
+            # buffer too small (large-world splits matrix): the batch was
+            # requeued; retry with the exact required size
+            buf = ctypes.create_string_buffer(-n)
+            n = self._lib.hvd_native_next_batch(buf, len(buf), timeout_s)
         if n <= 0:
             return None
         r = _BatchReader(buf.raw[:n])
@@ -233,9 +262,12 @@ class NativeRuntime:
         handles = r.vec64()
         first_shape = r.vec64()
         error_reason = r.s()
+        rank_dim0 = r.vec64()
+        all_splits = r.vec64()
         return ExecutionBatch(batch_id, op, reduce_op, root_rank, prescale,
                               postscale, dtype, total_bytes, names, handles,
-                              first_shape, error_reason, cycle=cycle)
+                              first_shape, error_reason, cycle=cycle,
+                              rank_dim0=rank_dim0, all_splits=all_splits)
 
     def batch_done(self, batch: ExecutionBatch, ok: bool = True) -> None:
         arr = (ctypes.c_longlong * len(batch.handles))(*batch.handles)

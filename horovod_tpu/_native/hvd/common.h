@@ -97,6 +97,9 @@ struct Request {
   double prescale = 1.0;
   double postscale = 1.0;
   std::vector<int64_t> shape;
+  // alltoall only: rows of dim 0 destined to each rank (reference
+  // operations.cc:1858 uneven splits); empty = even split
+  std::vector<int64_t> splits;
 
   int64_t NumElements() const {
     int64_t n = 1;
@@ -124,6 +127,13 @@ struct Response {
   // local pending entry (joined ranks) replicate exact cache metadata
   // for fused batches instead of guessing from first_shape
   std::vector<std::vector<int64_t>> tensor_shapes;
+  // allgather: every rank's dim-0 extent in rank order — the negotiated
+  // size collection of reference ConstructResponse (controller.cc:497)
+  // that makes ragged (variable first-dim) allgather executable
+  std::vector<int64_t> rank_dim0;
+  // alltoall: the full splits matrix, row r = rank r's outgoing splits,
+  // flattened [rank * size + dest]; empty when every rank is even
+  std::vector<int64_t> all_splits;
 };
 
 struct RequestList {

@@ -72,12 +72,45 @@ ResponseList TcpController::WorkerCycle(const RequestList& own) {
   return rl;
 }
 
+// Per-request (rank-independent) validity: alltoall splits must address
+// every rank and cover the tensor exactly (reference operations.cc:1858).
+static std::string ValidateSplits(const Request& req, int32_t size) {
+  if (req.op != OpType::kAlltoall) return "";
+  int64_t d0 = req.shape.empty() ? 0 : req.shape[0];
+  if (req.splits.empty()) {
+    if (size > 0 && d0 % size) {
+      return "alltoall tensor '" + req.name + "' dim0 " +
+             std::to_string(d0) + " not divisible by world size";
+    }
+    return "";
+  }
+  if (static_cast<int32_t>(req.splits.size()) != size) {
+    return "alltoall tensor '" + req.name + "' has " +
+           std::to_string(req.splits.size()) + " splits for " +
+           std::to_string(size) + " ranks";
+  }
+  int64_t sum = 0;
+  for (int64_t s : req.splits) {
+    if (s < 0) {
+      return "alltoall tensor '" + req.name + "' has negative split " +
+             std::to_string(s);
+    }
+    sum += s;
+  }
+  if (sum != d0) {
+    return "alltoall tensor '" + req.name + "' splits sum " +
+           std::to_string(sum) + " != dim0 " + std::to_string(d0);
+  }
+  return "";
+}
+
 void TcpController::IncrementTensorCount(const Request& req, int32_t rank) {
   // reference: controller.cc:1006 — first request creates the record;
   // metadata must agree with what rank 0 of the record submitted
   auto it = message_table_.find(req.name);
   if (it == message_table_.end()) {
     TensorRecord rec;
+    rec.error = ValidateSplits(req, opts_.size);
     rec.requests[rank] = req;
     rec.ranks.insert(rank);
     message_table_[req.name] = std::move(rec);
@@ -99,20 +132,25 @@ void TcpController::IncrementTensorCount(const Request& req, int32_t rank) {
   } else if (req.op == OpType::kBroadcast &&
              req.root_rank != first.root_rank) {
     rec.error = "mismatched broadcast root for tensor '" + req.name + "'";
-  } else if (req.op != OpType::kAllgather && req.shape != first.shape) {
+  } else if (req.op != OpType::kAllgather && req.op != OpType::kAlltoall &&
+             req.shape != first.shape) {
     rec.error = "mismatched shapes for tensor '" + req.name + "'";
-  } else if (req.op == OpType::kAllgather) {
+  } else if (req.op == OpType::kAllgather || req.op == OpType::kAlltoall) {
+    // ragged ops: first dim may differ per rank; everything else must
+    // agree (reference ConstructResponse, controller.cc:497)
     if (req.shape.size() != first.shape.size()) {
-      rec.error = "mismatched ranks for allgather tensor '" + req.name + "'";
+      rec.error = "mismatched ranks for tensor '" + req.name + "'";
     } else {
       for (size_t d = 1; d < req.shape.size(); ++d) {
         if (req.shape[d] != first.shape[d]) {
           rec.error =
-              "mismatched non-first dims for allgather tensor '" +
-              req.name + "'";
+              "mismatched non-first dims for tensor '" + req.name + "'";
         }
       }
     }
+  }
+  if (rec.error.empty()) {
+    rec.error = ValidateSplits(req, opts_.size);
   }
   rec.requests[rank] = req;
   rec.ranks.insert(rank);
@@ -138,9 +176,30 @@ Response TcpController::ConstructResponse(const std::string& name) {
   resp.dtype = first.dtype;
   resp.first_shape = first.shape;
   resp.tensor_shapes = {first.shape};
-  // allgather: total bytes sums every rank's first dim
+  // allgather: total bytes sums every rank's first dim; the negotiated
+  // per-rank dim-0 sizes ship in the response so ragged gathers execute
+  // (reference allgather size collection, controller.cc:497)
   if (first.op == OpType::kAllgather) {
-    for (const auto& kv : rec.requests) resp.total_bytes += kv.second.ByteSize();
+    resp.rank_dim0.resize(opts_.size, 0);
+    for (const auto& kv : rec.requests) {
+      resp.total_bytes += kv.second.ByteSize();
+      resp.rank_dim0[kv.first] =
+          kv.second.shape.empty() ? 0 : kv.second.shape[0];
+    }
+  } else if (first.op == OpType::kAlltoall) {
+    // full splits matrix, row r = rank r's outgoing splits (even rows
+    // synthesized as dim0/size), so every rank knows its recv layout
+    resp.total_bytes = first.ByteSize();
+    resp.all_splits.assign(
+        static_cast<size_t>(opts_.size) * opts_.size, 0);
+    for (const auto& kv : rec.requests) {
+      const Request& r = kv.second;
+      int64_t d0 = r.shape.empty() ? 0 : r.shape[0];
+      for (int32_t j = 0; j < opts_.size; ++j) {
+        resp.all_splits[kv.first * opts_.size + j] =
+            r.splits.empty() ? d0 / opts_.size : r.splits[j];
+      }
+    }
   } else {
     resp.total_bytes = first.ByteSize();
   }
@@ -158,9 +217,11 @@ std::vector<Response> TcpController::FuseResponses(
   // fusion key -> index of the open (not-yet-full) batch in `out`
   std::map<std::string, size_t> open;
   for (auto& r : ready) {
+    // allgather left unfused: responses carry per-rank dim-0 layouts and
+    // the executors run per-tensor anyway (no packed fusion buffer here —
+    // XLA absorbs pack/unpack into the collective when it fuses)
     bool fusable_kind =
-        (r.op == OpType::kAllreduce || r.op == OpType::kAllgather ||
-         r.op == OpType::kReducescatter) &&
+        (r.op == OpType::kAllreduce || r.op == OpType::kReducescatter) &&
         r.tensor_names.size() == 1;
     if (!fusable_kind) {
       out.push_back(std::move(r));

@@ -422,7 +422,8 @@ int hvd_native_size() { return g ? g->size : -1; }
 long long hvd_native_enqueue(const char* name, int op, int dtype,
                              const long long* shape, int ndim, int reduce_op,
                              int root_rank, double prescale,
-                             double postscale) {
+                             double postscale, const long long* splits,
+                             int nsplits) {
   if (g == nullptr || !g->initialized.load() || g->broken.load()) return -1;
   Request req;
   req.rank = g->rank;
@@ -434,6 +435,7 @@ long long hvd_native_enqueue(const char* name, int op, int dtype,
   req.prescale = prescale;
   req.postscale = postscale;
   for (int i = 0; i < ndim; ++i) req.shape.push_back(shape[i]);
+  for (int i = 0; i < nsplits; ++i) req.splits.push_back(splits[i]);
   int64_t h = g->handle_counter.fetch_add(1);
   SetHandle(h, kPending);
   if (!g->tensor_queue.Add(req, h)) {
@@ -459,7 +461,7 @@ long long hvd_native_join() {
 long long hvd_native_barrier() {
   long long shape[1] = {0};
   return hvd_native_enqueue("__barrier__", static_cast<int>(OpType::kBarrier),
-                            0, shape, 0, 0, 0, 1.0, 1.0);
+                            0, shape, 0, 0, 0, 1.0, 1.0, nullptr, 0);
 }
 
 int hvd_native_poll(long long handle) {
@@ -494,10 +496,14 @@ int hvd_native_wait(long long handle, double timeout_s) {
 
 // Serialized batch: id, cycle, op, reduce_op, root_rank, prescale,
 // postscale, dtype, total_bytes, names, handles, first_shape,
-// error_reason.
+// error_reason, rank_dim0, all_splits.
+// Returns: >0 bytes written; 0 timeout/none; <0 the NEGATED required
+// buffer size — the batch stays queued so the caller can retry with a
+// larger buffer (an alltoall batch carries an O(size^2) splits matrix,
+// which outgrows any fixed buffer at large world sizes).
 long long hvd_native_next_batch(unsigned char* buf, long long buflen,
                                 double timeout_s) {
-  if (g == nullptr) return -1;
+  if (g == nullptr) return 0;
   Batch b;
   {
     std::unique_lock<std::mutex> l(g->batch_mu);
@@ -525,7 +531,19 @@ long long hvd_native_next_batch(unsigned char* buf, long long buflen,
   w.Vec(b.handles);
   w.Vec(b.response.first_shape);
   w.Str(b.response.error_reason);
-  if (static_cast<long long>(w.data().size()) > buflen) return -1;
+  w.Vec(b.response.rank_dim0);
+  w.Vec(b.response.all_splits);
+  if (static_cast<long long>(w.data().size()) > buflen) {
+    // too small: requeue at the front (order preserved) and report the
+    // needed size so the caller can retry — dropping a popped batch
+    // would hang every handle in it
+    {
+      std::lock_guard<std::mutex> l(g->batch_mu);
+      g->batches.push_front(std::move(b));
+    }
+    g->batch_cv.notify_all();
+    return -static_cast<long long>(w.data().size());
+  }
   std::memcpy(buf, w.data().data(), w.data().size());
   return static_cast<long long>(w.data().size());
 }

@@ -9,6 +9,7 @@ ResponseCache::State ResponseCache::Lookup(const Request& req) const {
   if (it == entries_.end()) return State::kMiss;
   const Entry& e = it->second;
   if (e.dtype != req.dtype || e.shape != req.shape ||
+      e.splits != req.splits ||
       e.response.op != req.op || e.response.reduce_op != req.reduce_op ||
       e.response.root_rank != req.root_rank ||
       e.response.prescale != req.prescale ||
@@ -39,6 +40,7 @@ void ResponseCache::Put(const Response& resp, const Request& req) {
     it->second.response = resp;
     it->second.dtype = req.dtype;
     it->second.shape = req.shape;
+    it->second.splits = req.splits;
     it->second.lru_it = lru_.begin();
     return;
   }
@@ -63,6 +65,7 @@ void ResponseCache::Put(const Response& resp, const Request& req) {
   e.response = resp;
   e.dtype = req.dtype;
   e.shape = req.shape;
+  e.splits = req.splits;
   e.position = pos;
   e.lru_it = lru_.begin();
   entries_[req.name] = std::move(e);

@@ -62,6 +62,7 @@ class ResponseCache {
     Response response;
     DataType dtype;
     std::vector<int64_t> shape;
+    std::vector<int64_t> splits;  // alltoall request splits
     uint32_t position;
     std::list<std::string>::iterator lru_it;
   };

@@ -12,6 +12,7 @@ static void WriteRequest(Writer* w, const Request& r) {
   w->F64(r.prescale);
   w->F64(r.postscale);
   w->Vec(r.shape);
+  w->Vec(r.splits);
 }
 
 static Request ReadRequest(Reader* r) {
@@ -25,6 +26,7 @@ static Request ReadRequest(Reader* r) {
   q.prescale = r->F64();
   q.postscale = r->F64();
   q.shape = r->Vec<int64_t>();
+  q.splits = r->Vec<int64_t>();
   return q;
 }
 
@@ -68,6 +70,8 @@ static void WriteResponse(Writer* w, const Response& resp) {
   w->Vec(resp.first_shape);
   w->I32(static_cast<int32_t>(resp.tensor_shapes.size()));
   for (const auto& s : resp.tensor_shapes) w->Vec(s);
+  w->Vec(resp.rank_dim0);
+  w->Vec(resp.all_splits);
 }
 
 static Response ReadResponse(Reader* r) {
@@ -89,6 +93,8 @@ static Response ReadResponse(Reader* r) {
   for (int32_t i = 0; i < ns && r->ok(); ++i) {
     resp.tensor_shapes.push_back(r->Vec<int64_t>());
   }
+  resp.rank_dim0 = r->Vec<int64_t>();
+  resp.all_splits = r->Vec<int64_t>();
   return resp;
 }
 

@@ -323,19 +323,17 @@ def _spmd_alltoall_leaf(x, axes, ps):
 # global mesh. The jit cache is keyed by shape/dtype/op — the steady-state
 # fast path analog of the reference's ResponseCache (response_cache.h:45).
 
-@functools.lru_cache(maxsize=4096)
-def _eager_program(op_kind: str, ndev: int, op: int, prescale: float,
-                   postscale: float, root_rank: int, epoch: int):
-    del epoch  # cache-buster across elastic re-init
-    st = global_state()
-    mesh = st.mesh
+def _build_perrank_program(op_kind: str, mesh, axes, op: int,
+                           prescale: float, postscale: float, root: int):
+    """jit(shard_map) program treating a [world, ...] stack as 'rank i's
+    tensor on device i'. `root` is an index along `axes`. Shared by the
+    global eager path and the process-set sub-mesh path."""
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    axes = ("hvd",) if mesh is None else tuple(mesh.axis_names)
-
     # The per-rank stack is laid out [world, ...] and sharded on dim 0, so
-    # each device's shard_map block is [1, ...]: squeeze it so the leaf sees
-    # exactly "this rank's tensor", like a Horovod process would.
+    # each device's shard_map block is [1, ...]: squeeze it so the leaf
+    # sees exactly "this rank's tensor", like a Horovod process would.
     if op_kind == "allreduce":
         def fn(x):
             return _spmd_allreduce_leaf(
@@ -348,7 +346,7 @@ def _eager_program(op_kind: str, ndev: int, op: int, prescale: float,
         in_spec, out_spec = P(axes), P()
     elif op_kind == "broadcast":
         def fn(x):
-            return _spmd_broadcast_leaf(x[0], root_rank, axes, None)
+            return _spmd_broadcast_leaf(x[0], root, axes, None)
         in_spec, out_spec = P(axes), P()
     elif op_kind == "reducescatter":
         def fn(x):
@@ -363,8 +361,6 @@ def _eager_program(op_kind: str, ndev: int, op: int, prescale: float,
     else:
         raise ValueError(op_kind)
 
-    from jax import shard_map
-
     return jax.jit(
         shard_map(
             fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
@@ -373,6 +369,37 @@ def _eager_program(op_kind: str, ndev: int, op: int, prescale: float,
             # framework-internal, so skip the static check.
             check_vma=False,
         )
+    )
+
+
+@functools.lru_cache(maxsize=1024)
+def _eager_subset_program(op_kind: str, ranks: tuple, op: int,
+                          prescale: float, postscale: float,
+                          root_local: int, epoch: int):
+    """Eager collective over a process set's sub-mesh: the set's devices
+    ARE the communicator (core/process_sets.py eager form), so the leaf
+    runs group-free over a dedicated "hvd" axis of exactly |set| devices.
+    """
+    del epoch
+    from jax.sharding import Mesh
+
+    st = global_state()
+    flat = np.asarray(st.mesh.devices).reshape(-1)
+    sub = Mesh(flat[np.asarray(ranks, dtype=np.int64)], ("hvd",))
+    return _build_perrank_program(
+        op_kind, sub, ("hvd",), op, prescale, postscale, root_local
+    )
+
+
+@functools.lru_cache(maxsize=4096)
+def _eager_program(op_kind: str, ndev: int, op: int, prescale: float,
+                   postscale: float, root_rank: int, epoch: int):
+    del epoch  # cache-buster across elastic re-init
+    st = global_state()
+    mesh = st.mesh
+    axes = ("hvd",) if mesh is None else tuple(mesh.axis_names)
+    return _build_perrank_program(
+        op_kind, mesh, axes, op, prescale, postscale, root_rank
     )
 
 
@@ -425,13 +452,24 @@ def _eager_collective(op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
     n = st.world_size() if ps is None else ps.size()
 
     if ps is not None:
-        # Eager subset ops run over the sub-mesh — a real communicator of
-        # exactly the member devices, no groups needed.
-        raise HorovodInternalError(
-            "eager process-set collectives: use ops inside shard_map or "
-            "ProcessSet.sub_mesh(); top-level eager subset execution lands "
-            "with the eager runtime (see ops/eager_runtime.py)"
+        # Eager subset ops run over the set's sub-mesh — a real
+        # communicator of exactly the member devices (the reference needs
+        # a whole per-set controller for this, process_set.h:26).
+        x = jnp.asarray(tensor)
+        root_local = ps.rank(root_rank) if op_kind == "broadcast" else 0
+        prog = _eager_subset_program(
+            op_kind, tuple(ps.ranks), int(op), float(prescale),
+            float(postscale), int(root_local), st.epoch,
         )
+        stacked = jnp.broadcast_to(x[None], (n,) + x.shape)
+        out = prog(stacked)
+        if jax.default_backend() == "cpu":
+            jax.block_until_ready(out)  # see _eager_perrank note
+        if op_kind == "reducescatter":
+            return out[: x.shape[0] // n]
+        if op_kind == "alltoall":
+            return out[: x.shape[0]]
+        return out
 
     x = jnp.asarray(tensor)
     # Replicated single-controller semantics: synthesize the per-rank stack.

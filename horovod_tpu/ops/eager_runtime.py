@@ -72,8 +72,9 @@ class LoopbackExecutor:
     contribution equals ours — the eager single-controller model of
     ops/collectives.py)."""
 
-    def __init__(self, world_size: int):
+    def __init__(self, world_size: int, rank: int = 0):
         self._n = world_size
+        self._rank = rank
 
     def __call__(self, batch: ExecutionBatch, tensors: Dict[str, np.ndarray]
                  ) -> Dict[str, np.ndarray]:
@@ -89,14 +90,46 @@ class LoopbackExecutor:
                     r = r / self._n
                 out[name] = r * batch.postscale
             elif batch.op == OP_ALLGATHER:
+                dims = batch.rank_dim0
+                if dims and len(set(dims)) > 1:
+                    # truly ragged peers cannot be simulated from our
+                    # buffer alone — a fabricated result would have the
+                    # negotiated total rows but garbage content
+                    raise HorovodInternalError(
+                        f"loopback executor cannot materialize ragged "
+                        f"allgather '{name}' (negotiated dims {dims}); "
+                        f"use the XLA executor (make_xla_executor)"
+                    )
                 out[name] = np.concatenate([x] * self._n, axis=0)
             elif batch.op == OP_BROADCAST:
                 out[name] = x
             elif batch.op == OP_REDUCESCATTER:
                 chunk = x.shape[0] // self._n
                 out[name] = x[:chunk] * self._n
+            elif batch.op == OP_ALLTOALL:
+                # identical inputs: each peer sends us the chunk destined
+                # to our rank; with the negotiated splits matrix the recv
+                # layout is column `rank` (reference operations.cc:1858)
+                n, r = self._n, self._rank
+                m = np.asarray(batch.all_splits, dtype=np.int64).reshape(
+                    (n, n)
+                )
+                pieces, recv_splits = [], []
+                for j in range(n):
+                    # peer j's buffer == ours; its chunk to us starts at
+                    # the sum of ITS splits before us (row j's prefix)
+                    joffs = np.concatenate(([0], np.cumsum(m[j])))
+                    pieces.append(x[joffs[r]:joffs[r] + m[j][r]])
+                    recv_splits.append(int(m[j][r]))
+                out[name] = (
+                    np.concatenate(pieces, axis=0),
+                    np.asarray(recv_splits, dtype=np.int64),
+                )
             else:
-                out[name] = x
+                raise HorovodInternalError(
+                    f"executor received unknown op {batch.op} for tensor "
+                    f"'{name}' — refusing to pass input through unchanged"
+                )
         return out
 
 
@@ -125,7 +158,7 @@ class EagerRuntime:
             cache_capacity=cache_capacity, stall_warning_s=stall_warning_s,
             stall_shutdown_s=stall_shutdown_s,
         )
-        self._executor = executor or LoopbackExecutor(size)
+        self._executor = executor or LoopbackExecutor(size, rank)
         self._lock = threading.Lock()
         self._inputs: Dict[str, np.ndarray] = {}
         self._results: Dict[int, np.ndarray] = {}
@@ -142,7 +175,8 @@ class EagerRuntime:
 
     def enqueue(self, name: str, tensor, op: int = OP_ALLREDUCE,
                 reduce_op: int = _REDUCE_SUM, root_rank: int = 0,
-                prescale: float = 1.0, postscale: float = 1.0) -> int:
+                prescale: float = 1.0, postscale: float = 1.0,
+                splits: Optional[List[int]] = None) -> int:
         arr = np.asarray(tensor)
         tl = _timeline()
         if tl is not None and op in _OP_ACTIVITIES:
@@ -153,6 +187,7 @@ class EagerRuntime:
             name, op, str(arr.dtype), list(arr.shape),
             reduce_op=reduce_op, root_rank=root_rank,
             prescale=prescale, postscale=postscale,
+            splits=[int(s) for s in splits] if splits is not None else None,
         )
         with self._lock:
             self._inputs[name] = arr
@@ -167,6 +202,22 @@ class EagerRuntime:
             reduce_op=_REDUCE_AVERAGE if average else _REDUCE_SUM,
             prescale=prescale, postscale=postscale,
         )
+
+    def allgather_async(self, name: str, tensor) -> int:
+        """Ragged-capable: dim 0 may differ per rank; the controller
+        negotiates per-rank sizes (reference controller.cc:497). Note the
+        default LoopbackExecutor refuses truly ragged worlds (it cannot
+        fabricate peers' data); the XLA executor handles them."""
+        return self.enqueue(name, tensor, OP_ALLGATHER)
+
+    def alltoall_async(self, name: str, tensor, splits=None) -> int:
+        """Uneven-capable: `splits[j]` rows go to rank j; synchronize
+        returns (output, received_splits) (reference
+        operations.cc:1858)."""
+        return self.enqueue(name, tensor, OP_ALLTOALL, splits=splits)
+
+    def broadcast_async(self, name: str, tensor, root_rank: int = 0) -> int:
+        return self.enqueue(name, tensor, OP_BROADCAST, root_rank=root_rank)
 
     def join(self) -> int:
         return self._native.join()
@@ -312,6 +363,10 @@ def make_xla_executor(mesh, axis_names):
     from . import collectives
 
     def execute(batch: ExecutionBatch, tensors: Dict[str, np.ndarray]):
+        rank = jax.process_index()
+        world = len(batch.rank_dim0) or (
+            int(len(batch.all_splits) ** 0.5) if batch.all_splits else 0
+        )
         out = {}
         for name in batch.names:
             if name not in tensors:
@@ -326,15 +381,67 @@ def make_xla_executor(mesh, axis_names):
                     )
                 )
             elif batch.op == OP_ALLGATHER:
-                out[name] = np.asarray(collectives.allgather(x))
+                dims = batch.rank_dim0
+                if dims and len(set(dims)) > 1:
+                    # ragged: pad every contribution to the negotiated max
+                    # dim-0, gather uniformly, slice out the real rows
+                    # (reference allgather size collection,
+                    # controller.cc:497)
+                    mx = max(dims)
+                    pad = [(0, int(mx - x.shape[0]))] + [(0, 0)] * (
+                        x.ndim - 1
+                    )
+                    g = np.asarray(
+                        collectives.allgather(np.pad(x, pad))
+                    )
+                    parts = [
+                        g[i * mx:i * mx + dims[i]] for i in range(len(dims))
+                    ]
+                    out[name] = np.concatenate(parts, axis=0)
+                else:
+                    out[name] = np.asarray(collectives.allgather(x))
             elif batch.op == OP_BROADCAST:
                 out[name] = np.asarray(
                     collectives.broadcast(x, root_rank=batch.root_rank)
                 )
             elif batch.op == OP_REDUCESCATTER:
                 out[name] = np.asarray(collectives.reducescatter(x))
+            elif batch.op == OP_ALLTOALL:
+                m = np.asarray(batch.all_splits, dtype=np.int64).reshape(
+                    (world, world)
+                )
+                recv_splits = m[:, rank]
+                if len(set(m.flatten().tolist())) <= 1:
+                    res = collectives.alltoall(x)
+                    res = res[0] if isinstance(res, tuple) else res
+                    out[name] = (np.asarray(res), recv_splits)
+                else:
+                    # uneven: pad each outgoing chunk to the matrix max,
+                    # run one uniform all_to_all, slice real rows back out
+                    mx = int(m.max())
+                    offs = np.concatenate(([0], np.cumsum(m[rank])))
+                    chunks = []
+                    for j in range(world):
+                        c = x[offs[j]:offs[j + 1]]
+                        pad = [(0, mx - c.shape[0])] + [(0, 0)] * (
+                            c.ndim - 1
+                        )
+                        chunks.append(np.pad(c, pad))
+                    packed = np.concatenate(chunks, axis=0)
+                    res = collectives.alltoall(packed)
+                    res = np.asarray(
+                        res[0] if isinstance(res, tuple) else res
+                    )
+                    parts = [
+                        res[j * mx:j * mx + recv_splits[j]]
+                        for j in range(world)
+                    ]
+                    out[name] = (np.concatenate(parts, axis=0), recv_splits)
             else:
-                out[name] = x
+                raise HorovodInternalError(
+                    f"executor received unknown op {batch.op} for tensor "
+                    f"'{name}' — refusing to pass input through unchanged"
+                )
         return out
 
     return execute

@@ -57,3 +57,47 @@ def test_bytes_negotiated_counts(rt):
     h = rt.allreduce_async("b", np.ones((1024,), np.float32))
     rt.synchronize(h)
     assert rt.bytes_negotiated() >= 4096
+
+
+def test_allgather_roundtrip(rt):
+    x = np.arange(6, dtype=np.float32).reshape(3, 2)
+    h = rt.allgather_async("ag", x)
+    np.testing.assert_allclose(rt.synchronize(h), x)  # world of 1
+
+
+def test_alltoall_even(rt):
+    x = np.arange(8, dtype=np.float32)
+    h = rt.alltoall_async("a2a", x)
+    out, recv = rt.synchronize(h)
+    np.testing.assert_allclose(out, x)
+    assert list(recv) == [8]
+
+
+def test_alltoall_uneven_splits(rt):
+    x = np.arange(10, dtype=np.float32)
+    h = rt.alltoall_async("a2a_u", x, splits=[10])
+    out, recv = rt.synchronize(h)
+    np.testing.assert_allclose(out, x)
+    assert list(recv) == [10]
+
+
+def test_alltoall_bad_splits_raises(rt):
+    h = rt.alltoall_async("a2a_bad", np.ones((10,), np.float32),
+                          splits=[3])  # sums to 3, dim0 is 10
+    with pytest.raises(HorovodInternalError):
+        rt.synchronize(h)
+
+
+def test_unknown_op_raises_not_passthrough():
+    """ADVICE/VERDICT r1: executors must refuse unknown ops rather than
+    'succeed' with garbage."""
+    from horovod_tpu._native import ExecutionBatch
+    from horovod_tpu.ops.eager_runtime import LoopbackExecutor
+
+    batch = ExecutionBatch(
+        batch_id=1, op=99, reduce_op=1, root_rank=0, prescale=1.0,
+        postscale=1.0, dtype=7, total_bytes=4, names=["z"], handles=[1],
+        first_shape=[1], error_reason="",
+    )
+    with pytest.raises(HorovodInternalError):
+        LoopbackExecutor(1)(batch, {"z": np.ones((1,), np.float32)})

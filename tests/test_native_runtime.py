@@ -193,6 +193,54 @@ def test_response_cache_steady_state():
     assert out[0]["logs"][1] == out[1]["logs"][1]
 
 
+def scenario_ragged_allgather(native, rt, rank, size):
+    """Ranks submit different dim-0 extents; the controller must collect
+    per-rank sizes into the response (reference controller.cc:497)."""
+    d0 = 3 + rank  # rank 0: 3 rows, rank 1: 4 rows
+    h = rt.enqueue("rag", native.OP_ALLGATHER, "float32", [d0, 2])
+    dims = []
+    deadline = time.time() + 20
+    while rt.poll(h) not in (rt_mod_DONE, rt_mod_FAILED):
+        b = rt.next_batch(timeout_s=0.2)
+        if b is not None:
+            dims = b.rank_dim0
+            rt.batch_done(b, ok=True)
+        if time.time() > deadline:
+            break
+    return {"state": rt.poll(h), "rank_dim0": dims}
+
+
+def test_ragged_allgather_negotiates_sizes():
+    out = _run_world(2, scenario_ragged_allgather)
+    for r in range(2):
+        assert out[r]["state"] == rt_mod_DONE, out[r]
+        assert out[r]["rank_dim0"] == [3, 4], out[r]
+
+
+def scenario_uneven_alltoall(native, rt, rank, size):
+    """Each rank's splits row reaches every rank as the full matrix."""
+    splits = [1, 3] if rank == 0 else [2, 2]
+    h = rt.enqueue("a2a", native.OP_ALLTOALL, "float32", [4, 2],
+                   splits=splits)
+    matrix = []
+    deadline = time.time() + 20
+    while rt.poll(h) not in (rt_mod_DONE, rt_mod_FAILED):
+        b = rt.next_batch(timeout_s=0.2)
+        if b is not None:
+            matrix = b.all_splits
+            rt.batch_done(b, ok=True)
+        if time.time() > deadline:
+            break
+    return {"state": rt.poll(h), "all_splits": matrix}
+
+
+def test_uneven_alltoall_negotiates_matrix():
+    out = _run_world(2, scenario_uneven_alltoall)
+    for r in range(2):
+        assert out[r]["state"] == rt_mod_DONE, out[r]
+        assert out[r]["all_splits"] == [1, 3, 2, 2], out[r]
+
+
 def scenario_join(native, rt, rank, size):
     log = []
     if rank == 1:
