@@ -62,10 +62,23 @@ class ElasticSampler:
         if "processed_num" in state:
             self.processed_num = state["processed_num"]
         else:
-            # legacy checkpoints stored rank 0's *local* index set; scale
-            # by the replica count to approximate the global cursor
+            # legacy checkpoints stored rank 0's *local* index set, recorded
+            # under the world size at save time. Scale by that if present;
+            # after an elastic resize the current replica count says nothing
+            # about the recording-time world, so with no record err LOW
+            # (replaying a few samples is recoverable, skipping them is not).
+            recorded = state.get("num_replicas")
+            if recorded is None:
+                from ..utils.logging import get_logger
+
+                get_logger().warning(
+                    "ElasticSampler: legacy checkpoint without a recorded "
+                    "world size; resuming at the unscaled local cursor "
+                    "(some samples may be replayed)."
+                )
+                recorded = 1
             self.processed_num = min(
-                len(state["processed_indices"]) * self._num_replicas,
+                len(state["processed_indices"]) * recorded,
                 self.dataset_size,
             )
         self._reset()
@@ -74,6 +87,9 @@ class ElasticSampler:
         return {
             "epoch": self.epoch,
             "processed_num": self.processed_num,
+            # recording-time world size: lets load_state_dict reconstruct
+            # the cursor correctly even across an elastic resize
+            "num_replicas": self._num_replicas,
         }
 
     # iteration ----------------------------------------------------------

@@ -154,7 +154,7 @@ def _spmd_allreduce_leaf(x, op, axes, ps, prescale, postscale):
         # before reaching this leaf.
         from . import hierarchical
 
-        if hierarchical.hierarchy_enabled_for("allreduce", ps, axes):
+        if hierarchical.hierarchy_enabled_for("allreduce", ps):
             y = hierarchical.hierarchical_psum(
                 x, axes, basics.bound_axis_sizes(),
                 global_state().knobs.hierarchical_local_size,
@@ -216,7 +216,7 @@ def _spmd_allgather_leaf(x, axes, ps):
         # psum-mask it (see the PRODUCT branch of _spmd_allreduce_leaf).
         from . import hierarchical
 
-        if hierarchical.hierarchy_enabled_for("allgather", ps, axes):
+        if hierarchical.hierarchy_enabled_for("allgather", ps):
             return hierarchical.hierarchical_allgather(
                 x, axes, basics.bound_axis_sizes(),
                 global_state().knobs.hierarchical_local_size,
@@ -393,14 +393,26 @@ def _eager_subset_program(op_kind: str, ranks: tuple, op: int,
 
 @functools.lru_cache(maxsize=4096)
 def _eager_program(op_kind: str, ndev: int, op: int, prescale: float,
-                   postscale: float, root_rank: int, epoch: int):
-    del epoch  # cache-buster across elastic re-init
+                   postscale: float, root_rank: int, epoch: int,
+                   hier_key=()):
+    # epoch: cache-buster across elastic re-init. hier_key: the hierarchical
+    # knob values baked into the traced program — toggling the knobs at
+    # runtime must not silently keep the old flat/hierarchical routing.
+    del epoch, hier_key
     st = global_state()
     mesh = st.mesh
     axes = ("hvd",) if mesh is None else tuple(mesh.axis_names)
     return _build_perrank_program(
         op_kind, mesh, axes, op, prescale, postscale, root_rank
     )
+
+
+def _hier_knob_key():
+    """The knob values that alter traced collective routing
+    (ops/hierarchical.py gates) — part of every eager program cache key."""
+    k = global_state().knobs
+    return (bool(k.hierarchical_allreduce), bool(k.hierarchical_allgather),
+            int(k.hierarchical_local_size))
 
 
 def _eager_perrank(op_kind: str, stacked, op=ReduceOp.SUM, prescale=1.0,
@@ -417,7 +429,7 @@ def _eager_perrank(op_kind: str, stacked, op=ReduceOp.SUM, prescale=1.0,
     ndev = int(np.prod(mesh.devices.shape))
     prog = _eager_program(
         op_kind, ndev, int(op), float(prescale), float(postscale),
-        int(root_rank), st.epoch,
+        int(root_rank), st.epoch, _hier_knob_key(),
     )
     from contextlib import nullcontext
 

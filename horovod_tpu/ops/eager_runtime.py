@@ -178,17 +178,20 @@ class EagerRuntime:
                 prescale: float = 1.0, postscale: float = 1.0,
                 splits: Optional[List[int]] = None) -> int:
         arr = np.asarray(tensor)
-        tl = _timeline()
-        if tl is not None and op in _OP_ACTIVITIES:
-            tl.activity_start(name, _OP_ACTIVITIES[op][0],
-                              args={"shape": list(arr.shape),
-                                    "dtype": str(arr.dtype)})
         handle = self._native.enqueue(
             name, op, str(arr.dtype), list(arr.shape),
             reduce_op=reduce_op, root_rank=root_rank,
             prescale=prescale, postscale=postscale,
             splits=[int(s) for s in splits] if splits is not None else None,
         )
+        # span opens only after the native enqueue accepted the tensor — a
+        # raise above would otherwise leave an unclosed 'B' corrupting the
+        # trace's track nesting
+        tl = _timeline()
+        if tl is not None and op in _OP_ACTIVITIES:
+            tl.activity_start(name, _OP_ACTIVITIES[op][0],
+                              args={"shape": list(arr.shape),
+                                    "dtype": str(arr.dtype)})
         with self._lock:
             self._inputs[name] = arr
             self._handle_name[handle] = name

@@ -136,14 +136,17 @@ def hierarchical_allgather(x, axes: Sequence[str], axis_sizes,
     return lax.all_gather(g, axis, tiled=True, axis_index_groups=outer)
 
 
-def hierarchy_enabled_for(op_kind: str, ps, axes: Sequence[str]) -> bool:
+def hierarchy_enabled_for(op_kind: str, ps) -> bool:
     """Knob gate: hierarchical routing applies to global-set SUM/AVERAGE
     allreduce and allgather (the reference restricts likewise:
     nccl_operations.h:227 is allreduce-only sum; MPIHierarchicalAllgather
-    requires the global communicator)."""
+    requires the global communicator). The global set may be expressed
+    either as None or as an explicit ProcessSet with id 0."""
     from ..core.state import global_state
 
     st = global_state()
+    if ps is not None and getattr(ps, "process_set_id", None) == 0:
+        ps = None
     if ps is not None or not st.initialized:
         return False
     k = st.knobs
