@@ -35,6 +35,7 @@ _NUMPY_TO_DTYPE = {
     "int64": 5, "float16": 6, "float32": 7, "float64": 8, "bool": 9,
     "bfloat16": 10,
 }
+DTYPE_TO_NUMPY = {v: k for k, v in _NUMPY_TO_DTYPE.items()}
 
 # handle states (operations.cc)
 PENDING = 0
@@ -121,11 +122,13 @@ class ExecutionBatch:
 
     def __init__(self, batch_id, op, reduce_op, root_rank, prescale,
                  postscale, dtype, total_bytes, names, handles, first_shape,
-                 error_reason, cycle=0, rank_dim0=(), all_splits=()):
+                 error_reason, cycle=0, rank_dim0=(), all_splits=(),
+                 shapes=()):
         self.batch_id = batch_id
         self.cycle = cycle
         self.rank_dim0 = list(rank_dim0)    # allgather: per-rank dim-0
         self.all_splits = list(all_splits)  # alltoall: flattened matrix
+        self.shapes = [list(s) for s in shapes]  # per-tensor, ∥ names
         self.op = op
         self.reduce_op = reduce_op
         self.root_rank = root_rank
@@ -264,10 +267,12 @@ class NativeRuntime:
         error_reason = r.s()
         rank_dim0 = r.vec64()
         all_splits = r.vec64()
+        shapes = [r.vec64() for _ in range(r.i32())]
         return ExecutionBatch(batch_id, op, reduce_op, root_rank, prescale,
                               postscale, dtype, total_bytes, names, handles,
                               first_shape, error_reason, cycle=cycle,
-                              rank_dim0=rank_dim0, all_splits=all_splits)
+                              rank_dim0=rank_dim0, all_splits=all_splits,
+                              shapes=shapes)
 
     def batch_done(self, batch: ExecutionBatch, ok: bool = True) -> None:
         arr = (ctypes.c_longlong * len(batch.handles))(*batch.handles)

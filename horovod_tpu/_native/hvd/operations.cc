@@ -354,8 +354,15 @@ void BackgroundLoop() {
   auto cycle = std::chrono::duration<double, std::milli>(g->cycle_ms);
   while (true) {
     auto start = std::chrono::steady_clock::now();
+    // Shutdown exits ONLY through the protocol: the flag rides out in
+    // own.shutdown, the coordinator ORs all ranks' flags and echoes the
+    // verdict, and RunLoopOnce returns false everywhere in the same
+    // cycle. A local early-exit here would leave the coordinator blocked
+    // in RecvFrame on our open socket while our process exit waits in the
+    // jax.distributed teardown barrier for the coordinator — a cross-
+    // process deadlock cycle. (Reference: shutdown is a negotiated,
+    // world-wide event — operations.cc:722 RunLoopOnce's should_shut_down.)
     if (!RunLoopOnce()) break;
-    if (g->shutdown.load() && g->tensor_queue.pending() == 0) break;
     auto elapsed = std::chrono::steady_clock::now() - start;
     if (elapsed < cycle) {
       std::this_thread::sleep_for(cycle - elapsed);
@@ -496,7 +503,7 @@ int hvd_native_wait(long long handle, double timeout_s) {
 
 // Serialized batch: id, cycle, op, reduce_op, root_rank, prescale,
 // postscale, dtype, total_bytes, names, handles, first_shape,
-// error_reason, rank_dim0, all_splits.
+// error_reason, rank_dim0, all_splits, tensor_shapes.
 // Returns: >0 bytes written; 0 timeout/none; <0 the NEGATED required
 // buffer size — the batch stays queued so the caller can retry with a
 // larger buffer (an alltoall batch carries an O(size^2) splits matrix,
@@ -533,6 +540,11 @@ long long hvd_native_next_batch(unsigned char* buf, long long buflen,
   w.Str(b.response.error_reason);
   w.Vec(b.response.rank_dim0);
   w.Vec(b.response.all_splits);
+  // per-tensor shapes parallel to tensor_names: a rank executing a fused
+  // batch containing tensors it never enqueued (join semantics) must
+  // contribute zeros of each tensor's true shape, not first_shape
+  w.I32(static_cast<int32_t>(b.response.tensor_shapes.size()));
+  for (const auto& s : b.response.tensor_shapes) w.Vec(s);
   if (static_cast<long long>(w.data().size()) > buflen) {
     // too small: requeue at the front (order preserved) and report the
     // needed size so the caller can retry — dropping a popped batch

@@ -154,9 +154,22 @@ def init(
         if st.initialized:
             return
 
-        # Multi-host bootstrap: launcher-provided coordinator (runner/)
+        # Multi-host bootstrap: launcher-provided coordinator (runner/).
+        # Must run before anything touches the backend — jax.process_count
+        # / jax.devices would initialize a single-process world and the
+        # late distributed.initialize would be ignored.
         coord = os.environ.get("HVD_TPU_COORDINATOR_ADDRESS")
-        if coord and jax.process_count() == 1:
+        from jax._src import distributed as _jax_distributed
+
+        if coord and _jax_distributed.global_state.client is None:
+            try:
+                # CPU test worlds need cross-process collectives; the TPU
+                # backend ignores this flag (ICI collectives are native)
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            except Exception:
+                pass
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=int(os.environ["HVD_TPU_NUM_PROCESSES"]),
@@ -200,13 +213,58 @@ def init(
 
             st.parameter_manager = ParameterManager(st.knobs)
 
+        if st.knobs.native_eager:
+            _start_native_eager(st)
+
         st.initialized = True
+
+
+def _start_native_eager(st) -> None:
+    """Construct the background negotiation runtime + data-plane executor
+    (the reference's InitializeHorovodOnce spawning BackgroundThreadLoop,
+    operations.cc:827,401). Multi-process worlds execute through the XLA
+    executor over a one-device-per-process mesh; single-process worlds use
+    the loopback executor so the full enqueue→negotiate→fuse→execute
+    pipeline is still exercised."""
+    import jax
+
+    from ..ops.eager_runtime import EagerRuntime, make_xla_executor
+
+    nproc = jax.process_count()
+    addr = os.environ.get("HVD_TPU_NATIVE_COORDINATOR_ADDR", "127.0.0.1")
+    port = int(os.environ.get("HVD_TPU_NATIVE_COORDINATOR_PORT", "0") or 0)
+    if nproc > 1:
+        if port == 0:
+            raise RuntimeError(
+                "HVD_TPU_NATIVE=1 with multiple processes requires the "
+                "launcher to publish HVD_TPU_NATIVE_COORDINATOR_ADDR/PORT "
+                "(hvdrun does; see runner/exec_run.py slot_env)"
+            )
+        executor = make_xla_executor(jax.process_index(), nproc)
+    else:
+        executor = None  # LoopbackExecutor
+    st.eager_runtime = EagerRuntime(
+        rank=jax.process_index(),
+        size=nproc,
+        coordinator_addr=addr,
+        coordinator_port=port,
+        executor=executor,
+        cycle_ms=st.knobs.cycle_time_ms,
+        fusion_threshold=st.knobs.fusion_threshold_bytes,
+        cache_capacity=(
+            st.knobs.cache_capacity if st.knobs.cache_enabled else 0
+        ),
+        stall_warning_s=st.knobs.stall_warning_time_seconds,
+        stall_shutdown_s=st.knobs.stall_shutdown_time_seconds,
+    )
 
 
 def shutdown() -> None:
     """Tear down state (reference: horovod_shutdown, operations.cc:983)."""
     st = global_state()
     with st.lock:
+        if st.eager_runtime is not None:
+            st.eager_runtime.shutdown()
         if st.timeline is not None:
             st.timeline.close()
         st.reset()

@@ -113,6 +113,13 @@ class Knobs:
     # --- process sets ---
     dynamic_process_sets: bool = False
 
+    # --- native eager runtime (HVD_TPU_NATIVE=1) ---
+    # Routes top-level (non-jit) collectives through the C++ negotiation
+    # runtime + XLA executor — the reference's background-loop
+    # architecture (operations.cc:401). Off by default: single-controller
+    # eager semantics don't need negotiation.
+    native_eager: bool = False
+
     # --- logging ---
     log_level: str = "WARNING"
     log_hide_timestamp: bool = False
@@ -154,6 +161,7 @@ class Knobs:
             elastic_timeout_seconds=_env_float("ELASTIC_TIMEOUT", 600.0),
             reset_limit=_env_int("RESET_LIMIT", 0),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
+            native_eager=_env_bool("NATIVE", False),
             log_level=_env("LOG_LEVEL", "WARNING") or "WARNING",
             log_hide_timestamp=_env_bool("LOG_HIDE_TIME", False),
             mesh_spec=_env("MESH", "") or "",

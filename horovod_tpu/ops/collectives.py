@@ -455,12 +455,90 @@ def _is_perrank(x, nset: int) -> bool:
     return hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == nset
 
 
+# auto-name fallback per op kind: call order must agree across ranks for
+# unnamed tensors (the reference's same caveat — torch/mpi_ops.py derives
+# a per-handle name when none is given)
+_AUTO_NAME_COUNTERS: dict = {}
+
+
+def _auto_name(op_kind: str) -> str:
+    import itertools
+
+    c = _AUTO_NAME_COUNTERS.setdefault(op_kind, itertools.count())
+    return f"{op_kind}.noname.{next(c)}"
+
+
+_NATIVE_OPS = {
+    "allreduce": 0,      # OP_ALLREDUCE
+    "allgather": 1,      # OP_ALLGATHER
+    "broadcast": 2,      # OP_BROADCAST
+    "alltoall": 3,       # OP_ALLTOALL
+    "reducescatter": 4,  # OP_REDUCESCATTER
+}
+
+
+def _leaf_namer(name):
+    """Per-leaf names for pytree ops: the first leaf keeps the user name,
+    later leaves get `.k` suffixes (deterministic pytree order keeps the
+    suffixes rank-consistent)."""
+    import itertools
+
+    c = itertools.count()
+
+    def next_name():
+        i = next(c)
+        if name is None:
+            return None
+        return name if i == 0 else f"{name}.{i}"
+
+    return next_name
+
+
+def _native_eager(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
+                  postscale=1.0, root_rank=0, name=None, splits=None):
+    """Route one top-level collective through the background negotiation
+    runtime: enqueue → controller negotiation → fused XLA execution →
+    synchronize (reference operations.cc:1400 EnqueueTensorAllreduces →
+    :273 PerformOperation; SURVEY.md §3.2)."""
+    x = np.asarray(tensor)
+    handle = rt.enqueue(
+        name or _auto_name(op_kind), x, _NATIVE_OPS[op_kind],
+        reduce_op=int(op), root_rank=int(root_rank),
+        prescale=float(prescale), postscale=float(postscale),
+        splits=splits,
+    )
+    out = rt.synchronize(handle)
+    if op_kind == "alltoall":
+        recv = None
+        if isinstance(out, tuple):
+            out, recv = out
+        return jnp.asarray(out), (
+            jnp.asarray(recv) if recv is not None else None
+        )
+    return jnp.asarray(out)
+
+
 def _eager_collective(op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
-                      postscale=1.0, root_rank=0, process_set=None):
+                      postscale=1.0, root_rank=0, process_set=None,
+                      name=None):
     st = global_state()
     ps = process_set
     if ps is not None and ps.process_set_id == 0:
         ps = None
+
+    rt = st.eager_runtime
+    if rt is not None:
+        if ps is not None:
+            raise HorovodInternalError(
+                "process-set collectives under the native eager runtime "
+                "need per-set controllers; run subsets through the SPMD "
+                "form (shard_map + process_set) for now"
+            )
+        out = _native_eager(
+            rt, op_kind, tensor, op, prescale, postscale, root_rank, name
+        )
+        return out[0] if op_kind == "alltoall" else out
+
     n = st.world_size() if ps is None else ps.size()
 
     if ps is not None:
@@ -537,7 +615,6 @@ def allreduce(
         op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
     elif average is not None:
         raise ValueError("specify either average= or op=, not both")
-    del name
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_allreduce
 
@@ -547,6 +624,15 @@ def allreduce(
             return jax.tree_util.tree_map(
                 lambda x: adasum_allreduce(
                     x, live[0], process_set=process_set
+                ),
+                tensor,
+            )
+        if global_state().eager_runtime is not None:
+            # negotiated path: real multi-process adasum via the executor
+            return jax.tree_util.tree_map(
+                lambda x: _eager_collective(
+                    "allreduce", x, op, prescale_factor, postscale_factor,
+                    process_set=process_set, name=name,
                 ),
                 tensor,
             )
@@ -561,10 +647,12 @@ def allreduce(
             x, op, live, ps, prescale_factor, postscale_factor
         )
 
+    namer = _leaf_namer(name)
+
     def eager(x):
         return _eager_collective(
             "allreduce", x, op, prescale_factor, postscale_factor,
-            process_set=ps,
+            process_set=ps, name=namer(),
         )
 
     return _dispatch(tensor, spmd, eager, axes)
@@ -631,15 +719,16 @@ def allgather(
     (torch/mpi_ops.py:752 allgather). SPMD shapes are rank-uniform by
     construction; ragged first dims are an eager-runtime feature
     (ops/eager_runtime.py)."""
-    del name
     axes = _resolve_axis(axis_name)
     ps = process_set
+    namer = _leaf_namer(name)
 
     def spmd(x, live):
         return _spmd_allgather_leaf(x, live, ps)
 
     def eager(x):
-        return _eager_collective("allgather", x, process_set=ps)
+        return _eager_collective("allgather", x, process_set=ps,
+                                 name=namer())
 
     return _dispatch(tensor, spmd, eager, axes)
 
@@ -654,20 +743,20 @@ def broadcast(
     """Broadcast root_rank's tensor to every rank
     (torch/mpi_ops.py:858). root_rank is a *global* rank, also for process
     sets (matching the reference's semantics)."""
-    del name
     axes = _resolve_axis(axis_name)
     ps = process_set
     if ps is not None and ps.process_set_id != 0 and root_rank not in ps.ranks:
         raise HorovodInternalError(
             f"broadcast root {root_rank} not in process set {ps.ranks}"
         )
+    namer = _leaf_namer(name)
 
     def spmd(x, live):
         return _spmd_broadcast_leaf(x, root_rank, live, ps)
 
     def eager(x):
         return _eager_collective("broadcast", x, root_rank=root_rank,
-                                 process_set=ps)
+                                 process_set=ps, name=namer())
 
     return _dispatch(tensor, spmd, eager, axes)
 
@@ -683,9 +772,9 @@ def reducescatter(
 ):
     """Reduce then scatter chunks of dim 0 (torch/mpi_ops.py:1022);
     rank i receives chunk i. Default op is Average like the reference."""
-    del name
     axes = _resolve_axis(axis_name)
     ps = process_set
+    namer = _leaf_namer(name)
 
     def spmd(x, live):
         return _spmd_reducescatter_leaf(
@@ -695,7 +784,7 @@ def reducescatter(
     def eager(x):
         return _eager_collective(
             "reducescatter", x, op, prescale_factor, postscale_factor,
-            process_set=ps,
+            process_set=ps, name=namer(),
         )
 
     return _dispatch(tensor, spmd, eager, axes)
@@ -729,7 +818,6 @@ def alltoall(
     Returns the exchanged tensor; with `splits` also returns
     received_splits, matching the reference's (output, received_splits).
     """
-    del name
     axes = _resolve_axis(axis_name)
     ps = process_set
 
@@ -742,6 +830,25 @@ def alltoall(
                 "parallel.ulysses.padded_alltoall (static max chunk); "
                 "equal-split alltoall lowers to one HLO"
             )
+        rt = global_state().eager_runtime
+        if rt is not None:
+            if ps is not None and ps.process_set_id != 0:
+                # never fall through to the single-controller fabrication:
+                # in a real multi-process world it would return wrong data
+                # silently (tile of our own chunk-0)
+                raise HorovodInternalError(
+                    "process-set collectives under the native eager "
+                    "runtime need per-set controllers; run subsets "
+                    "through the SPMD form (shard_map + process_set)"
+                )
+            # true ragged exchange: the controller negotiates the full
+            # splits matrix, the executor pads/slices around one uniform
+            # all_to_all HLO (reference operations.cc:1858)
+            out, recv = _native_eager(
+                rt, "alltoall", tensor, name=name,
+                splits=[int(s) for s in np.asarray(splits)],
+            )
+            return out, recv
         # eager single-controller: all ranks hold identical tensors, so the
         # rank-0 view receives each peer's chunk-0 = tensor[:splits[0]],
         # i.e. that chunk tiled n times (consistent with the equal-split
@@ -752,11 +859,14 @@ def alltoall(
         received_splits = jnp.full((n,), splits[0])
         return jnp.tile(chunk0, reps), received_splits
 
+    namer = _leaf_namer(name)
+
     def spmd(x, live):
         return _spmd_alltoall_leaf(x, live, ps)
 
     def eager(x):
-        return _eager_collective("alltoall", x, process_set=ps)
+        return _eager_collective("alltoall", x, process_set=ps,
+                                 name=namer())
 
     return _dispatch(tensor, spmd, eager, axes)
 
@@ -780,9 +890,13 @@ def join(device=None) -> int:
     uneven data is handled *inside* the step via masking (see
     `masked_allreduce`), the idiomatic XLA form. Eagerly this is therefore
     a synchronization no-op returning the last joined rank (0). The
-    multi-controller eager runtime implements true join accounting.
+    multi-controller eager runtime implements true join accounting: joined
+    ranks contribute zeros to collectives still pending on other ranks.
     """
     del device
+    rt = global_state().eager_runtime
+    if rt is not None and not basics.in_spmd_context():
+        return rt.join_sync()
     barrier()
     return 0
 
@@ -817,6 +931,11 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     st = global_state()
     if not st.initialized:
         return
+    if st.eager_runtime is not None and (
+        process_set is None or process_set.process_set_id == 0
+    ):
+        st.eager_runtime.barrier()
+        return
     out = _eager_collective("allreduce", jnp.zeros(()), ReduceOp.SUM,
                             process_set=process_set)
     jax.block_until_ready(out)
@@ -826,10 +945,32 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
 # async handles
 # ---------------------------------------------------------------------------
 #
-# JAX dispatch is asynchronous by construction: every eager op above
-# returns immediately with a future-backed Array. The handle layer exists
-# for API parity with torch/mpi_ops.py:107-151 (allreduce_async_ →
-# handle → synchronize/poll) and handle_manager.h:31.
+# Two async regimes (reference torch/mpi_ops.py:107-151 allreduce_async_ →
+# handle → synchronize/poll; handle_manager.h:31):
+#
+# * single-controller: JAX dispatch is asynchronous by construction — the
+#   op returns a future-backed Array immediately and the handle just wraps
+#   it.
+# * native runtime: the async op ENQUEUES into the background negotiation
+#   runtime without executing, exactly the reference's enqueue model. This
+#   is load-bearing, not parity sugar: ranks may submit tensors in
+#   different orders, and only non-blocking submission lets the controller
+#   see everything and order it (a blocking submit-then-wait would
+#   deadlock on reordered peers).
+
+class _NativeAsync:
+    """A pending native-runtime collective: per-leaf native handles plus
+    the treedef to rebuild the user's pytree at synchronize time."""
+
+    def __init__(self, rt, op_kind, treedef, handles, with_splits=False):
+        self.rt = rt
+        self.op_kind = op_kind
+        self.treedef = treedef
+        self.handles = handles
+        # alltoall parity: only a splits call returns (out, recv_splits);
+        # a plain alltoall returns the tensor alone, native or not
+        self.with_splits = with_splits
+
 
 class _HandleManager:
     def __init__(self):
@@ -856,33 +997,129 @@ def _async(fn, *args, **kw) -> int:
     return _handles.allocate(fn(*args, **kw))
 
 
-def allreduce_async(tensor, *a, **kw) -> int:
-    return _async(allreduce, tensor, *a, **kw)
+def _native_rt_for_async(process_set=None):
+    """The native runtime, when this call should route through it."""
+    st = global_state()
+    rt = st.eager_runtime
+    if rt is None or basics.in_spmd_context():
+        return None
+    if process_set is not None and process_set.process_set_id != 0:
+        return None
+    return rt
 
 
-def allgather_async(tensor, *a, **kw) -> int:
-    return _async(allgather, tensor, *a, **kw)
+def _native_async(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
+                  postscale=1.0, root_rank=0, name=None,
+                  splits=None) -> int:
+    leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    namer = _leaf_namer(name)
+    hs = []
+    for leaf in leaves:
+        hs.append(
+            rt.enqueue(
+                namer() or _auto_name(op_kind), np.asarray(leaf),
+                _NATIVE_OPS[op_kind], reduce_op=int(op),
+                root_rank=int(root_rank), prescale=float(prescale),
+                postscale=float(postscale), splits=splits,
+            )
+        )
+    return _handles.allocate(
+        _NativeAsync(rt, op_kind, treedef, hs,
+                     with_splits=splits is not None)
+    )
 
 
-def broadcast_async(tensor, *a, **kw) -> int:
-    return _async(broadcast, tensor, *a, **kw)
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=None, axis_name=None) -> int:
+    if op is None:
+        op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
+    elif average is not None:
+        raise ValueError("specify either average= or op=, not both")
+    rt = _native_rt_for_async(process_set)
+    if rt is not None:
+        return _native_async(
+            rt, "allreduce", tensor, op, prescale_factor,
+            postscale_factor, name=name,
+        )
+    return _async(allreduce, tensor, op=op, name=name,
+                  prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor,
+                  process_set=process_set, axis_name=axis_name)
 
 
-def alltoall_async(tensor, *a, **kw) -> int:
-    return _async(alltoall, tensor, *a, **kw)
+def allgather_async(tensor, name=None, process_set=None,
+                    axis_name=None) -> int:
+    rt = _native_rt_for_async(process_set)
+    if rt is not None:
+        return _native_async(rt, "allgather", tensor, name=name)
+    return _async(allgather, tensor, name=name, process_set=process_set,
+                  axis_name=axis_name)
 
 
-def reducescatter_async(tensor, *a, **kw) -> int:
-    return _async(reducescatter, tensor, *a, **kw)
+def broadcast_async(tensor, root_rank: int = 0, name=None,
+                    process_set=None, axis_name=None) -> int:
+    rt = _native_rt_for_async(process_set)
+    if rt is not None:
+        return _native_async(rt, "broadcast", tensor, root_rank=root_rank,
+                             name=name)
+    return _async(broadcast, tensor, root_rank=root_rank, name=name,
+                  process_set=process_set, axis_name=axis_name)
 
 
-def grouped_allreduce_async(tensors, *a, **kw) -> int:
-    return _async(grouped_allreduce, tensors, *a, **kw)
+def alltoall_async(tensor, splits=None, name=None, process_set=None,
+                   axis_name=None) -> int:
+    rt = _native_rt_for_async(process_set)
+    if rt is not None:
+        sp = (
+            [int(s) for s in np.asarray(splits)]
+            if splits is not None else None
+        )
+        return _native_async(rt, "alltoall", tensor, name=name, splits=sp)
+    return _async(alltoall, tensor, splits=splits, name=name,
+                  process_set=process_set, axis_name=axis_name)
+
+
+def reducescatter_async(tensor, op: ReduceOp = ReduceOp.AVERAGE, name=None,
+                        prescale_factor=1.0, postscale_factor=1.0,
+                        process_set=None, axis_name=None) -> int:
+    rt = _native_rt_for_async(process_set)
+    if rt is not None:
+        return _native_async(rt, "reducescatter", tensor, op,
+                             prescale_factor, postscale_factor, name=name)
+    return _async(reducescatter, tensor, op=op, name=name,
+                  prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor,
+                  process_set=process_set, axis_name=axis_name)
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=None, axis_name=None) -> int:
+    if op is None:
+        op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
+    elif average is not None:
+        raise ValueError("specify either average= or op=, not both")
+    rt = _native_rt_for_async(process_set)
+    if rt is not None:
+        # one enqueue per tensor in the same cycle: the controller's
+        # FuseResponses packs them into fused batches — the real runtime
+        # fusion path, not the compile-time bucketing of ops/fusion.py
+        return _native_async(
+            rt, "allreduce", list(tensors), op, prescale_factor,
+            postscale_factor, name=name,
+        )
+    return _async(grouped_allreduce, tensors, op=op, name=name,
+                  prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor,
+                  process_set=process_set, axis_name=axis_name)
 
 
 def poll(handle: int) -> bool:
     """True if the async op completed (torch/mpi_ops.py:1210)."""
     v = _handles.get(handle)
+    if isinstance(v, _NativeAsync):
+        return all(v.rt.poll(h) for h in v.handles)
     try:
         leaves = jax.tree_util.tree_leaves(v)
         return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
@@ -893,5 +1130,18 @@ def poll(handle: int) -> bool:
 def synchronize(handle: int):
     """Wait for and return the result (torch/mpi_ops.py:1226)."""
     v = _handles.release(handle)
+    if isinstance(v, _NativeAsync):
+        outs = []
+        for h in v.handles:
+            r = v.rt.synchronize(h)
+            if v.op_kind == "alltoall" and isinstance(r, tuple):
+                if v.with_splits:
+                    r = tuple(jnp.asarray(e) for e in r)
+                else:
+                    r = jnp.asarray(r[0])
+            else:
+                r = jnp.asarray(r)
+            outs.append(r)
+        return jax.tree_util.tree_unflatten(v.treedef, outs)
     jax.block_until_ready(v)
     return v

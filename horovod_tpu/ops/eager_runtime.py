@@ -34,6 +34,7 @@ from ..core.exceptions import HorovodInternalError
 from .._native import (
     BATCHED,
     DONE,
+    DTYPE_TO_NUMPY,
     FAILED,
     OP_ALLGATHER,
     OP_ALLREDUCE,
@@ -48,6 +49,10 @@ from .._native import (
 
 _REDUCE_AVERAGE = 0
 _REDUCE_SUM = 1
+_REDUCE_ADASUM = 2
+_REDUCE_MIN = 3
+_REDUCE_MAX = 4
+_REDUCE_PRODUCT = 5
 
 # op id -> (negotiation activity, execution activity) — the reference's
 # per-tensor phase names (common.h:79-113, timeline.cc)
@@ -85,9 +90,18 @@ class LoopbackExecutor:
             x = tensors[name]
             if batch.op == OP_ALLREDUCE:
                 scaled = x * batch.prescale
-                r = scaled * self._n  # n identical contributions
-                if batch.reduce_op == _REDUCE_AVERAGE:
-                    r = r / self._n
+                # n identical contributions: sum = x*n, min/max/adasum = x,
+                # product = x**n
+                if batch.reduce_op == _REDUCE_PRODUCT:
+                    r = scaled ** self._n
+                elif batch.reduce_op in (
+                    _REDUCE_ADASUM, _REDUCE_MIN, _REDUCE_MAX
+                ):
+                    r = scaled
+                else:
+                    r = scaled * self._n
+                    if batch.reduce_op == _REDUCE_AVERAGE:
+                        r = r / self._n
                 out[name] = r * batch.postscale
             elif batch.op == OP_ALLGATHER:
                 dims = batch.rank_dim0
@@ -105,7 +119,10 @@ class LoopbackExecutor:
                 out[name] = x
             elif batch.op == OP_REDUCESCATTER:
                 chunk = x.shape[0] // self._n
-                out[name] = x[:chunk] * self._n
+                r = x[:chunk] * batch.prescale * self._n
+                if batch.reduce_op == _REDUCE_AVERAGE:
+                    r = r / self._n
+                out[name] = r * batch.postscale
             elif batch.op == OP_ALLTOALL:
                 # identical inputs: each peer sends us the chunk destined
                 # to our rank; with the negotiated splits matrix the recv
@@ -224,6 +241,26 @@ class EagerRuntime:
 
     def join(self) -> int:
         return self._native.join()
+
+    def join_sync(self, timeout_s: float = 60.0) -> int:
+        """Join and block until every rank has joined (the worker thread
+        auto-completes OP_JOIN batches). Returns 0 — per-rank join order
+        is not tracked (reference returns the last joining rank purely as
+        a curiosity, torch/mpi_ops.py:1250)."""
+        h = self._native.join()
+        # a join handle stays PENDING until every rank has joined
+        # (controller.cc kJoin emits only on full coverage) — keep waiting
+        # through PENDING timeouts like synchronize does; the stall
+        # inspector owns genuinely-stuck worlds
+        state = self._native.wait(h, timeout_s)
+        while state in (0, BATCHED):
+            state = self._native.wait(h, timeout_s)
+        self._native.release(h)
+        if state != DONE:
+            raise HorovodInternalError(
+                f"join failed: {self._native.last_error()}"
+            )
+        return 0
 
     def barrier(self, timeout_s: float = 60.0) -> None:
         h = self._native.barrier()
@@ -351,100 +388,383 @@ class EagerRuntime:
         self._worker.join(timeout=5)
 
 
-def make_xla_executor(mesh, axis_names):
-    """Multi-controller data plane: execute a batch as XLA collectives over
-    the global mesh. Requires jax.distributed to be initialized (the
-    launcher does this; SURVEY.md §2.6 TPU equivalent row).
+class XlaExecutor:
+    """Multi-controller data plane: execute negotiated batches as XLA
+    collectives over a one-device-per-process mesh.
 
-    Single-host note: with one controller this reduces to the eager path in
-    ops/collectives.py; the negotiation layer above it is still what keeps
-    multiple *processes* consistent, so this executor is only reached when
-    jax.process_count() > 1.
+    This is the TPU-native analog of the reference's enqueue↔execute
+    handshake (/root/reference/horovod/common/operations.cc:273
+    PerformOperation; tensorflow/xla_mpi_ops.cc:317 rendezvous): the
+    controller has already fixed the fused batch order identically on
+    every process, so each process can issue the same jit-compiled
+    collective program in the same order — exactly the consistency XLA
+    multi-controller execution requires. The negotiation world is
+    *processes* (the reference's rank model): each process contributes its
+    local tensor on its first local device over a dedicated ``proc`` mesh
+    axis; remaining local devices are untouched (the SPMD path owns them).
+
+    Fused allreduce batches are packed into one flat buffer per batch —
+    one collective HLO for N tensors, the compile-time mirror of the
+    reference's fusion buffer (fusion_buffer_manager.h:30).
     """
-    import jax
 
-    from . import collectives
+    def __init__(self, rank: int, world: int):
+        import jax
+        from jax.sharding import Mesh
 
-    def execute(batch: ExecutionBatch, tensors: Dict[str, np.ndarray]):
-        rank = jax.process_index()
-        world = len(batch.rank_dim0) or (
-            int(len(batch.all_splits) ** 0.5) if batch.all_splits else 0
+        # The controller's rank/world MUST be the jax process topology:
+        # dim-0 slicing of gathered results and the alltoall recv-splits
+        # column are indexed by this rank, so a mismatch silently reads
+        # another process's data (ADVICE r2 #1).
+        if rank != jax.process_index():
+            raise HorovodInternalError(
+                f"native runtime rank {rank} != jax.process_index() "
+                f"{jax.process_index()}; the XLA executor requires the "
+                "controller rank order to be the JAX process order"
+            )
+        if world != jax.process_count():
+            raise HorovodInternalError(
+                f"native runtime size {world} != jax.process_count() "
+                f"{jax.process_count()}"
+            )
+        by_proc: Dict[int, object] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        if sorted(by_proc) != list(range(world)):
+            raise HorovodInternalError(
+                f"process indices {sorted(by_proc)} are not contiguous "
+                f"0..{world - 1}"
+            )
+        self._rank = rank
+        self._world = world
+        self._local_device = by_proc[rank]
+        self._mesh = Mesh(
+            np.asarray([by_proc[p] for p in range(world)]), ("proc",)
         )
+        self._programs: Dict[tuple, Callable] = {}
+
+    # -------------------------------------------------------- plumbing
+
+    def _global_stack(self, arr: np.ndarray):
+        """Place this process's tensor as slice [rank] of a [world, ...]
+        global array sharded one-slice-per-process along ``proc``."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        a = jnp.asarray(arr)
+        return jax.make_array_from_single_device_arrays(
+            (self._world,) + a.shape,
+            NamedSharding(self._mesh, P("proc")),
+            [jax.device_put(a[None], self._local_device)],
+        )
+
+    def _program(self, key, leaf, out_spec_sharded: bool):
+        """jit(shard_map) over the proc mesh, cached by signature — the
+        steady-state fast path (compilation plays the role the response
+        cache plays for negotiation)."""
+        prog = self._programs.get(key)
+        if prog is None:
+            import jax
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            prog = jax.jit(
+                shard_map(
+                    lambda s: leaf(s[0]),
+                    mesh=self._mesh,
+                    in_specs=P("proc"),
+                    out_specs=P("proc") if out_spec_sharded else P(),
+                    check_vma=False,
+                )
+            )
+            self._programs[key] = prog
+        return prog
+
+    def _local_shard(self, out) -> np.ndarray:
+        shards = [s for s in out.addressable_shards]
+        assert len(shards) == 1, "proc mesh places one shard per process"
+        return np.asarray(shards[0].data)
+
+    # ------------------------------------------------------ op leaves
+
+    def _reduce_leaf(self, reduce_op: int, prescale: float,
+                     postscale: float):
+        import jax.numpy as jnp
+        from jax import lax
+
+        n = self._world
+
+        def leaf(x):
+            if prescale != 1.0:
+                x = x * jnp.asarray(prescale, dtype=x.dtype)
+            if reduce_op in (_REDUCE_SUM, _REDUCE_AVERAGE):
+                y = lax.psum(x, "proc")
+                if reduce_op == _REDUCE_AVERAGE:
+                    y = (y / n).astype(x.dtype)
+            elif reduce_op == _REDUCE_MIN:
+                y = lax.pmin(x, "proc")
+            elif reduce_op == _REDUCE_MAX:
+                y = lax.pmax(x, "proc")
+            elif reduce_op == _REDUCE_PRODUCT:
+                y = jnp.prod(
+                    lax.all_gather(x, "proc"), axis=0
+                ).astype(x.dtype)
+            elif reduce_op == _REDUCE_ADASUM:
+                from .adasum import adasum_allreduce
+
+                y = adasum_allreduce(x, "proc")
+            else:
+                raise HorovodInternalError(
+                    f"unknown reduce op {reduce_op}"
+                )
+            if postscale != 1.0:
+                y = y * jnp.asarray(postscale, dtype=y.dtype)
+            return y
+
+        return leaf
+
+    # ------------------------------------------------------- execution
+
+    def _materialize(self, batch: ExecutionBatch,
+                     tensors: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """Per-tensor local inputs in batch order; zeros for tensors this
+        process never enqueued (join semantics: a joined rank contributes
+        zero tensors, reference collective_operations.h:325)."""
+        np_dtype = DTYPE_TO_NUMPY.get(batch.dtype, "float32")
+        if np_dtype == "bfloat16":
+            import ml_dtypes
+
+            np_dtype = ml_dtypes.bfloat16
+        out = []
+        for i, name in enumerate(batch.names):
+            if name in tensors:
+                out.append(np.asarray(tensors[name]))
+            else:
+                shape = (
+                    batch.shapes[i]
+                    if i < len(batch.shapes)
+                    else batch.first_shape
+                )
+                out.append(np.zeros(shape, dtype=np_dtype))
+        return out
+
+    def __call__(self, batch: ExecutionBatch,
+                 tensors: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        op = batch.op
+        if op == OP_ALLREDUCE:
+            return self._run_allreduce(batch, tensors)
+        if op == OP_REDUCESCATTER:
+            return self._run_reducescatter(batch, tensors)
+        if op == OP_ALLGATHER:
+            return self._run_allgather(batch, tensors)
+        if op == OP_BROADCAST:
+            return self._run_broadcast(batch, tensors)
+        if op == OP_ALLTOALL:
+            return self._run_alltoall(batch, tensors)
+        raise HorovodInternalError(
+            f"executor received unknown op {op} for batch {batch.names} — "
+            "refusing to pass input through unchanged"
+        )
+
+    def _run_allreduce(self, batch, tensors):
+        inputs = self._materialize(batch, tensors)
+        # pack the fused batch into one flat buffer -> ONE collective HLO
+        # (the reference memcpys into the fusion buffer and issues one
+        # ncclAllReduce, nccl_operations.cc:175-246)
+        flats = [x.reshape(-1) for x in inputs]
+        packed = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        leaf = self._reduce_leaf(
+            batch.reduce_op, batch.prescale, batch.postscale
+        )
+        prog = self._program(
+            ("allreduce", packed.shape, str(packed.dtype), batch.reduce_op,
+             batch.prescale, batch.postscale),
+            leaf, out_spec_sharded=False,
+        )
+        res = np.asarray(prog(self._global_stack(packed)))
+        out, off = {}, 0
+        for name, x in zip(batch.names, inputs):
+            n = x.size
+            if name in tensors:
+                out[name] = res[off:off + n].reshape(x.shape)
+            off += n
+        return out
+
+    def _run_reducescatter(self, batch, tensors):
+        from jax import lax
+        import jax.numpy as jnp
+
+        inputs = self._materialize(batch, tensors)
+        n = self._world
+        out = {}
+        for name, x in zip(batch.names, inputs):
+            reduce_op = batch.reduce_op
+            prescale, postscale = batch.prescale, batch.postscale
+
+            def leaf(v):
+                if prescale != 1.0:
+                    v = v * jnp.asarray(prescale, dtype=v.dtype)
+                y = lax.psum_scatter(
+                    v, "proc", scatter_dimension=0, tiled=True
+                )
+                if reduce_op == _REDUCE_AVERAGE:
+                    y = (y / n).astype(v.dtype)
+                if postscale != 1.0:
+                    y = y * jnp.asarray(postscale, dtype=y.dtype)
+                return y
+
+            prog = self._program(
+                ("reducescatter", x.shape, str(x.dtype), reduce_op,
+                 prescale, postscale),
+                leaf, out_spec_sharded=True,
+            )
+            res = self._local_shard(prog(self._global_stack(x)))
+            if name in tensors:
+                out[name] = res
+        return out
+
+    def _run_allgather(self, batch, tensors):
+        from jax import lax
+
+        dims = [int(d) for d in batch.rank_dim0]
+        out = {}
+        for i, name in enumerate(batch.names):
+            x = (
+                np.asarray(tensors[name]) if name in tensors
+                else None
+            )
+            mx = max(dims) if dims else (x.shape[0] if x is not None else 0)
+            # ragged: pad every contribution to the negotiated max dim-0,
+            # gather uniformly, slice the real rows back out (reference
+            # allgather size collection, controller.cc:497)
+            if x is None:
+                tail = tuple(
+                    batch.shapes[i][1:] if i < len(batch.shapes)
+                    else batch.first_shape[1:]
+                )
+                np_dtype = DTYPE_TO_NUMPY.get(batch.dtype, "float32")
+                padded = np.zeros((mx,) + tail, dtype=np_dtype)
+            elif x.shape[0] < mx:
+                pad = [(0, mx - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+                padded = np.pad(x, pad)
+            else:
+                padded = x
+
+            def leaf(v):
+                return lax.all_gather(v, "proc", tiled=True)
+
+            prog = self._program(
+                ("allgather", padded.shape, str(padded.dtype)),
+                leaf, out_spec_sharded=False,
+            )
+            g = np.asarray(prog(self._global_stack(padded)))
+            if name not in tensors:
+                continue
+            if dims and len(set(dims)) > 1:
+                parts = [
+                    g[r * mx:r * mx + dims[r]] for r in range(len(dims))
+                ]
+                out[name] = np.concatenate(parts, axis=0)
+            else:
+                out[name] = g
+        return out
+
+    def _run_broadcast(self, batch, tensors):
+        from jax import lax
+        import jax.numpy as jnp
+
+        inputs = self._materialize(batch, tensors)
+        root = batch.root_rank
+        out = {}
+        for name, x in zip(batch.names, inputs):
+            def leaf(v):
+                mask = lax.axis_index("proc") == root
+                if v.dtype == jnp.bool_:
+                    # psum on bool promotes to int32; round-trip through
+                    # int and cast back so the caller keeps its dtype
+                    y = lax.psum(
+                        jnp.where(mask, v, False).astype(jnp.int32), "proc"
+                    )
+                    return y.astype(jnp.bool_)
+                return lax.psum(v * mask.astype(v.dtype), "proc")
+
+            prog = self._program(
+                ("broadcast", x.shape, str(x.dtype), root),
+                leaf, out_spec_sharded=False,
+            )
+            res = np.asarray(prog(self._global_stack(x)))
+            if name in tensors:
+                out[name] = res
+        return out
+
+    def _run_alltoall(self, batch, tensors):
+        from jax import lax
+
+        world, rank = self._world, self._rank
+        m = np.asarray(batch.all_splits, dtype=np.int64).reshape(
+            (world, world)
+        )
+        recv_splits = m[:, rank]
         out = {}
         for name in batch.names:
             if name not in tensors:
-                continue
-            x = tensors[name]
-            if batch.op == OP_ALLREDUCE:
-                avg = batch.reduce_op == _REDUCE_AVERAGE
-                out[name] = np.asarray(
-                    collectives.allreduce(
-                        x, average=avg, prescale_factor=batch.prescale,
-                        postscale_factor=batch.postscale,
-                    )
+                # a joined rank's row is all zeros; still participate
+                x = np.zeros(
+                    (0,) + tuple(batch.first_shape[1:]),
+                    dtype=DTYPE_TO_NUMPY.get(batch.dtype, "float32"),
                 )
-            elif batch.op == OP_ALLGATHER:
-                dims = batch.rank_dim0
-                if dims and len(set(dims)) > 1:
-                    # ragged: pad every contribution to the negotiated max
-                    # dim-0, gather uniformly, slice out the real rows
-                    # (reference allgather size collection,
-                    # controller.cc:497)
-                    mx = max(dims)
-                    pad = [(0, int(mx - x.shape[0]))] + [(0, 0)] * (
-                        x.ndim - 1
-                    )
-                    g = np.asarray(
-                        collectives.allgather(np.pad(x, pad))
-                    )
-                    parts = [
-                        g[i * mx:i * mx + dims[i]] for i in range(len(dims))
-                    ]
-                    out[name] = np.concatenate(parts, axis=0)
-                else:
-                    out[name] = np.asarray(collectives.allgather(x))
-            elif batch.op == OP_BROADCAST:
-                out[name] = np.asarray(
-                    collectives.broadcast(x, root_rank=batch.root_rank)
-                )
-            elif batch.op == OP_REDUCESCATTER:
-                out[name] = np.asarray(collectives.reducescatter(x))
-            elif batch.op == OP_ALLTOALL:
-                m = np.asarray(batch.all_splits, dtype=np.int64).reshape(
-                    (world, world)
-                )
-                recv_splits = m[:, rank]
-                if len(set(m.flatten().tolist())) <= 1:
-                    res = collectives.alltoall(x)
-                    res = res[0] if isinstance(res, tuple) else res
-                    out[name] = (np.asarray(res), recv_splits)
-                else:
-                    # uneven: pad each outgoing chunk to the matrix max,
-                    # run one uniform all_to_all, slice real rows back out
-                    mx = int(m.max())
-                    offs = np.concatenate(([0], np.cumsum(m[rank])))
-                    chunks = []
-                    for j in range(world):
-                        c = x[offs[j]:offs[j + 1]]
-                        pad = [(0, mx - c.shape[0])] + [(0, 0)] * (
-                            c.ndim - 1
-                        )
-                        chunks.append(np.pad(c, pad))
-                    packed = np.concatenate(chunks, axis=0)
-                    res = collectives.alltoall(packed)
-                    res = np.asarray(
-                        res[0] if isinstance(res, tuple) else res
-                    )
-                    parts = [
-                        res[j * mx:j * mx + recv_splits[j]]
-                        for j in range(world)
-                    ]
-                    out[name] = (np.concatenate(parts, axis=0), recv_splits)
             else:
-                raise HorovodInternalError(
-                    f"executor received unknown op {batch.op} for tensor "
-                    f"'{name}' — refusing to pass input through unchanged"
+                x = np.asarray(tensors[name])
+            # pad each outgoing chunk to the matrix max, one uniform
+            # all_to_all HLO, slice real rows back out (the static-shape
+            # form XLA needs; reference operations.cc:1858 uneven splits)
+            mx = int(m.max()) if m.size else 0
+            offs = np.concatenate(([0], np.cumsum(m[rank])))
+            chunks = []
+            for j in range(world):
+                c = x[offs[j]:offs[j + 1]]
+                pad = [(0, mx - c.shape[0])] + [(0, 0)] * (c.ndim - 1)
+                chunks.append(np.pad(c, pad))
+            packed = np.concatenate(chunks, axis=0)
+
+            def leaf(v):
+                return lax.all_to_all(
+                    v, "proc", split_axis=0, concat_axis=0, tiled=True
                 )
+
+            prog = self._program(
+                ("alltoall", packed.shape, str(packed.dtype)),
+                leaf, out_spec_sharded=True,
+            )
+            res = self._local_shard(prog(self._global_stack(packed)))
+            if name not in tensors:
+                continue
+            parts = [
+                res[j * mx:j * mx + int(recv_splits[j])]
+                for j in range(world)
+            ]
+            out[name] = (
+                np.concatenate(parts, axis=0),
+                recv_splits.copy(),
+            )
         return out
 
-    return execute
+
+def make_xla_executor(rank: Optional[int] = None,
+                      world: Optional[int] = None) -> XlaExecutor:
+    """Build the multi-controller XLA data plane. Requires
+    jax.distributed to be initialized (hvd.init does this from the
+    launcher-provided env; SURVEY.md §2.6 TPU equivalent row).
+
+    rank/world default to — and are validated against — the JAX process
+    topology; pass the EagerRuntime's configured values so a controller
+    rank-order mismatch fails loudly instead of mis-slicing (ADVICE r2 #1).
+    """
+    import jax
+
+    if rank is None:
+        rank = jax.process_index()
+    if world is None:
+        world = jax.process_count()
+    return XlaExecutor(rank, world)

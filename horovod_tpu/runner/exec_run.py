@@ -32,6 +32,7 @@ from .util.network import (
 from .util.secret import ENV_SECRET
 
 JAX_COORD_PORT_OFFSET = 19  # coordinator port = rendezvous port + offset
+NATIVE_COORD_PORT_OFFSET = 23  # native control-plane coordinator port
 
 
 def slot_env(
@@ -40,6 +41,7 @@ def slot_env(
     rendezvous_addr: str,
     rendezvous_port: int,
     coordinator_address: str,
+    native_coordinator_port: int = 0,
 ) -> Dict[str, str]:
     """Per-slot worker environment (reference gloo_run.py:66-101)."""
     env = dict(base_env)
@@ -67,6 +69,17 @@ def slot_env(
     env["HVD_TPU_COORDINATOR_ADDRESS"] = coordinator_address
     env["HVD_TPU_NUM_PROCESSES"] = str(slot.size)
     env["HVD_TPU_PROCESS_ID"] = str(slot.rank)
+    # Native eager control plane (HVD_TPU_NATIVE=1): the rank-0 worker's
+    # TcpController binds this port on its host; all workers dial it
+    # (hvd.init → core/basics._start_native_eager). Always published —
+    # harmless when native mode is off.
+    if native_coordinator_port:
+        env["HVD_TPU_NATIVE_COORDINATOR_ADDR"] = (
+            coordinator_address.rsplit(":", 1)[0]
+        )
+        env["HVD_TPU_NATIVE_COORDINATOR_PORT"] = str(
+            native_coordinator_port
+        )
     return env
 
 
@@ -125,8 +138,10 @@ def launch_slots(
     rank0_host = assignments[0].hostname
     if local and rank0_host in local or not local and is_local_host(rank0_host):
         coordinator = f"{rendezvous_addr}:{find_free_port()}"
+        native_port = find_free_port()
     else:
         coordinator = f"{rank0_host}:{port + JAX_COORD_PORT_OFFSET}"
+        native_port = port + NATIVE_COORD_PORT_OFFSET
 
     if ENV_SECRET not in env:
         from .util.secret import make_secret_key
@@ -138,7 +153,8 @@ def launch_slots(
     codes: List[Optional[int]] = [None] * len(assignments)
 
     def run_slot(i: int, slot: SlotInfo):
-        wenv = slot_env(slot, env, rendezvous_addr, port, coordinator)
+        wenv = slot_env(slot, env, rendezvous_addr, port, coordinator,
+                        native_coordinator_port=native_port)
         fn = exec_fn
         if fn is None:
             slot_is_local = (

@@ -1,0 +1,141 @@
+"""Worker for the end-to-end native eager pipeline test.
+
+Spawned once per rank by tests/test_native_eager_e2e.py with the env the
+launcher would provide (HVD_TPU_* coordinator vars + HVD_TPU_NATIVE=1).
+Runs the PUBLIC hvd API — not the runtime internals — so the test proves
+the full wiring: hvd.init() starts the background negotiation runtime,
+hvd.allreduce/... enqueue through it, and the XLA executor runs real
+cross-process collectives (reference call stack SURVEY.md §3.2).
+
+Each scenario uses rank-DISTINCT values and rank-DIFFERENT enqueue orders:
+exactly the hazards negotiation exists to remove.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.core.state import global_state
+
+    hvd.init()
+    rank = int(os.environ["HVD_TPU_PROCESS_ID"])
+    size = int(os.environ["HVD_TPU_NUM_PROCESSES"])
+
+    st = global_state()
+    assert st.eager_runtime is not None, "eager runtime was not wired in"
+
+    out = {"rank": rank}
+
+    # 1. out-of-order enqueue with distinct values ---------------------
+    # rank r's tensor t_i = (r+1) * (i+1) * ones; sum_r (r+1) = S
+    names = ["grad_a", "grad_b", "grad_c", "grad_d"]
+    order = list(range(len(names))) if rank % 2 == 0 else list(
+        reversed(range(len(names)))
+    )
+    s_world = sum(r + 1 for r in range(size))
+    results = {}
+    handles = {}
+    for i in order:
+        t = np.full((4, 3), float((rank + 1) * (i + 1)), dtype=np.float32)
+        handles[i] = hvd.allreduce_async(
+            t, name=names[i], op=hvd.Sum
+        )
+    for i in order:
+        results[i] = np.asarray(hvd.synchronize(handles[i]))
+    out["allreduce_ok"] = all(
+        np.allclose(results[i], s_world * (i + 1)) for i in range(len(names))
+    )
+
+    # 2. averaged allreduce with prescale ------------------------------
+    t = np.full((8,), float(rank + 1), dtype=np.float32)
+    avg = np.asarray(
+        hvd.allreduce(t, average=True, name="avg_t", prescale_factor=2.0)
+    )
+    expect = 2.0 * s_world / size
+    out["average_ok"] = bool(np.allclose(avg, expect))
+
+    # 3. ragged allgather ----------------------------------------------
+    rows = rank + 2  # rank 0: 2 rows, rank 1: 3 rows, ...
+    t = np.full((rows, 2), float(rank), dtype=np.float32)
+    g = np.asarray(hvd.allgather(t, name="rag"))
+    expect_parts = [
+        np.full((r + 2, 2), float(r), dtype=np.float32) for r in range(size)
+    ]
+    out["allgather_ok"] = bool(
+        np.array_equal(g, np.concatenate(expect_parts, axis=0))
+    )
+
+    # 4. broadcast from a non-zero root --------------------------------
+    t = np.full((5,), float(rank * 10 + 7), dtype=np.float32)
+    b = np.asarray(hvd.broadcast(t, root_rank=size - 1, name="bc"))
+    out["broadcast_ok"] = bool(np.allclose(b, (size - 1) * 10 + 7))
+
+    # 5. reducescatter (average) ----------------------------------------
+    d0 = 2 * size
+    t = np.arange(d0 * 3, dtype=np.float32).reshape(d0, 3) * (rank + 1)
+    rs = np.asarray(hvd.reducescatter(t, name="rs"))
+    full_avg = np.arange(d0 * 3, dtype=np.float32).reshape(d0, 3) * (
+        s_world / size
+    )
+    out["reducescatter_ok"] = bool(
+        np.allclose(rs, full_avg[rank * 2:(rank + 1) * 2])
+    )
+
+    # 6. uneven alltoall -------------------------------------------------
+    # rank r sends (j+1) rows to rank j, stamped with sender/dest ids
+    splits = [j + 1 for j in range(size)]
+    total = sum(splits)
+    t = np.zeros((total, 2), dtype=np.float32)
+    off = 0
+    for j, n_rows in enumerate(splits):
+        t[off:off + n_rows] = [rank, j]
+        off += n_rows
+    recv, recv_splits = hvd.alltoall(t, splits=splits, name="a2a")
+    recv = np.asarray(recv)
+    # every peer sends us (rank+1) rows stamped [sender, our rank]
+    expect = np.concatenate(
+        [
+            np.tile([[s, rank]], (rank + 1, 1)).astype(np.float32)
+            for s in range(size)
+        ],
+        axis=0,
+    )
+    out["alltoall_ok"] = bool(
+        np.array_equal(recv, expect)
+        and [int(x) for x in np.asarray(recv_splits)] == [rank + 1] * size
+    )
+
+    # 7. join: rank 0 runs out of data; the others keep reducing and the
+    # joined rank contributes zeros through the XLA executor (reference
+    # JoinOp, collective_operations.h:325)
+    if size > 1:
+        if rank == 0:
+            hvd.join()
+            out["join_ok"] = True
+        else:
+            t = np.full((3,), float(rank + 1), dtype=np.float32)
+            red = np.asarray(hvd.allreduce(t, op=hvd.Sum, name="tail"))
+            expect_tail = sum(r + 1 for r in range(1, size))
+            out["join_ok"] = bool(np.allclose(red, expect_tail))
+            hvd.join()
+    else:
+        out["join_ok"] = True
+
+    # 8. barrier + runtime stats ----------------------------------------
+    hvd.barrier()
+    out["cache_hits"] = int(st.eager_runtime.cache_hits())
+    out["bytes_negotiated"] = int(st.eager_runtime.bytes_negotiated())
+
+    hvd.shutdown()
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
