@@ -1,109 +1,61 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-50 synthetic throughput (images/sec/chip).
+"""Headline benchmarks: ResNet-50 synthetic images/sec/chip (primary
+metric, matching the reference's only published absolute throughput) plus
+BERT-Large pretraining tokens/sec/chip — the two model families
+BASELINE.json names — with measured MFU for both.
 
-Mirrors the reference's synthetic benchmark vehicles
-(/root/reference/examples/pytorch/pytorch_synthetic_benchmark.py,
-examples/tensorflow2/tensorflow2_synthetic_benchmark.py): ResNet-50,
-synthetic ImageNet batches, images/sec measured over timed windows.
+Vehicles live in examples/ (resnet50_synthetic.py, bert_pretraining.py),
+mirroring the reference's examples/pytorch/pytorch_synthetic_benchmark.py
+and the BERT-L pretraining config; bench.py drives them and emits ONE
+JSON line.
 
-Baseline denominator: the reference's only published absolute throughput is
-ResNet-101 at 1656.82 images/sec on 16 Pascal GPUs (docs/benchmarks.rst:40)
-= 103.55 images/sec/GPU; vs_baseline = ours / 103.55.
-
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline denominator: the reference's published ResNet-101 throughput,
+1656.82 images/sec on 16 Pascal GPUs (docs/benchmarks.rst:40) = 103.55
+images/sec/GPU; vs_baseline = ours / 103.55.
 """
 
 import json
+import os
 import sys
-import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-sys.path.insert(0, ".")
-
-import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50
+from horovod_tpu.utils.script_loader import load_example
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.rst:40-43
 
-BATCH = 128
-IMAGE = 224
-WARMUP = 3
-ITERS = 10
-# first timed window is discarded: remote-tunnel execution (axon) shows a
-# spurious fast first window after warmup; median of the rest is stable
-WINDOWS = 4
-
 
 def main():
-    hvd.init()
-    n = hvd.size()
+    resnet = load_example("resnet50_synthetic")
+    bert = load_example("bert_pretraining")
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
-    rng = jax.random.PRNGKey(0)
-    x = jnp.asarray(
-        np.random.RandomState(0).rand(BATCH, IMAGE, IMAGE, 3),
-        dtype=jnp.bfloat16,
+    # 5 timed windows; median rides out the axon tunnel's occasional
+    # spurious-fast first window
+    img_per_chip, resnet_mfu = resnet.main(
+        ["--num-iters", "5", "--num-batches-per-iter", "10",
+         "--num-warmup-batches", "3"]
     )
-    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, BATCH))
+    tok_per_chip, bert_mfu = bert.main(
+        ["--num-iters", "3", "--num-batches-per-iter", "5",
+         "--num-warmup-batches", "2"]
+    )
 
-    variables = jax.jit(model.init)(rng, x)
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    opt = hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9))
-    opt_state = opt.init(params)
-
-    def loss_fn(p, bs, xb, yb):
-        logits, new_model_state = model.apply(
-            {"params": p, "batch_stats": bs}, xb, train=True,
-            mutable=["batch_stats"],
-        )
-        onehot = jax.nn.one_hot(yb, 1000)
-        loss = -jnp.mean(
-            jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1)
-        )
-        return loss, new_model_state["batch_stats"]
-
-    @jax.jit
-    def step(p, bs, s, xb, yb):
-        (loss, bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
-            p, bs, xb, yb
-        )
-        upd, s = opt.update(g, s, p)
-        p = optax.apply_updates(p, upd)
-        return p, bs, s, loss
-
-    # warmup (compile)
-    for _ in range(WARMUP):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, x, y
-        )
-    jax.block_until_ready(loss)
-
-    rates = []
-    for _ in range(WINDOWS):
-        t0 = time.perf_counter()
-        for _ in range(ITERS):
-            params, batch_stats, opt_state, loss = step(
-                params, batch_stats, opt_state, x, y
-            )
-        float(loss)  # host sync
-        dt = time.perf_counter() - t0
-        rates.append(BATCH * ITERS / dt)
-
-    img_per_sec = float(np.median(rates[1:]))
-    per_chip = img_per_sec / max(jax.local_device_count(), 1)
     print(
         json.dumps(
             {
                 "metric": "resnet50_synthetic_images_per_sec_per_chip",
-                "value": round(per_chip, 2),
+                "value": round(img_per_chip, 2),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(
-                    per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3
+                    img_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3
                 ),
+                "extra_metrics": {
+                    "resnet50_mfu": round(resnet_mfu, 4),
+                    "bertlarge_pretrain_tokens_per_sec_per_chip": round(
+                        tok_per_chip, 1
+                    ),
+                    "bertlarge_mfu": round(bert_mfu, 4),
+                },
             }
         )
     )

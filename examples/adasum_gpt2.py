@@ -1,0 +1,122 @@
+"""GPT-2 training with Adasum gradient combining — convergence smoke.
+
+The BASELINE.json config "Adasum allreduce on Llama-2 7B
+(reducescatter+allgather path)" exercised at GPT-2 scale: the same
+op=Adasum path (ops/adasum.py recursive-doubling combine; hierarchical
+reduce-scatter → adasum → allgather variant available via
+hierarchical_adasum). Adasum needs no LR rescaling by world size — that
+is its point (reference docs/adasum_user_guide.rst) — so the LR here is
+NOT multiplied by hvd.size().
+
+Run:
+    python examples/adasum_gpt2.py --steps 30
+"""
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models.transformer import (
+    GPT2_SMALL,
+    Transformer,
+    causal_lm_loss,
+)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="GPT-2 + Adasum smoke")
+    p.add_argument("--batch-size", type=int, default=4,
+                   help="per-rank batch size")
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=512)
+    args = p.parse_args(argv)
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.mesh()
+
+    cfg = dataclasses.replace(
+        GPT2_SMALL,
+        num_layers=args.layers,
+        hidden_size=args.hidden,
+        num_heads=max(1, args.hidden // 64),
+        vocab_size=args.vocab,
+        max_seq_len=args.seq_len,
+    )
+    model = Transformer(cfg)
+
+    B, T = args.batch_size * n, args.seq_len
+    # a learnable synthetic language: tokens follow a fixed random bigram
+    # table, so the model has real structure to fit
+    r = np.random.RandomState(0)
+    table = r.randint(0, args.vocab, (args.vocab, 4))
+    toks = np.zeros((B, T), dtype=np.int64)
+    toks[:, 0] = r.randint(0, args.vocab, B)
+    choice = r.randint(0, 4, (B, T))
+    for t in range(1, T):
+        toks[:, t] = table[toks[:, t - 1], choice[:, t]]
+
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), dtype=jnp.int32)
+    )["params"]
+    # Adasum: NO lr scaling by world size
+    opt = hvd.DistributedOptimizer(optax.adam(args.lr), op=hvd.Adasum)
+    opt_state = opt.init(params)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(p, tok):
+        logits = model.apply({"params": p}, tok)
+        loss, _ = causal_lm_loss(logits, tok)
+        return loss
+
+    def step_fn(p, s, tok):
+        loss, g = jax.value_and_grad(loss_fn)(p, tok)
+        upd, s = opt.update(g, s, p)
+        p = optax.apply_updates(p, upd)
+        return p, s, jax.lax.psum(loss, "hvd").reshape(1) / n
+
+    step = jax.jit(
+        jax.shard_map(
+            step_fn, mesh=mesh,
+            in_specs=(P(), P(), P("hvd")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+
+    tok = jax.device_put(toks, NamedSharding(mesh, P("hvd")))
+    first = None
+    t0 = time.time()
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, tok)
+        lv = float(loss[0])
+        if first is None:
+            first = lv
+        if hvd.rank() == 0 and (i % 10 == 0 or i == args.steps - 1):
+            print(f"step {i}: loss {lv:.4f}", flush=True)
+    if hvd.rank() == 0:
+        print(
+            f"loss {first:.4f} -> {lv:.4f} in {args.steps} steps "
+            f"({time.time() - t0:.1f}s, adasum over {n} ranks)",
+            flush=True,
+        )
+    return first, lv
+
+
+if __name__ == "__main__":
+    main()

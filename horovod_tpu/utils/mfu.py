@@ -1,0 +1,69 @@
+"""Model-FLOPs-utilization accounting.
+
+The reference reports raw images/sec (docs/benchmarks.rst:40); on TPU the
+meaningful denominator is the chip's peak matmul throughput, so benchmarks
+here also report MFU = achieved model FLOP/s / peak bf16 FLOP/s. Peak
+numbers are the published per-chip bf16 figures for each TPU generation.
+"""
+
+from __future__ import annotations
+
+import os
+
+# published peak bf16 TFLOP/s per chip
+_PEAK_TFLOPS = {
+    "v3": 123.0,
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+}
+
+
+def peak_flops_per_chip(default_gen: str = "v5e") -> float:
+    """Peak bf16 FLOP/s for the chip we're running on. Generation comes
+    from the PALLAS_AXON_TPU_GEN env (the harness sets it) or the device
+    kind string; CPU test worlds fall back to `default_gen` so MFU stays
+    a comparable ratio rather than a meaningless number."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").strip().lower()
+    if gen not in _PEAK_TFLOPS:
+        try:
+            import jax
+
+            # device_kind strings: "TPU v3", "TPU v4", "TPU v5 lite",
+            # "TPU v5p", "TPU v6 lite" — lite suffix marks the e variants
+            kind = jax.devices()[0].device_kind.lower()
+            for version in ("v6", "v5", "v4", "v3"):
+                if version in kind:
+                    if version in ("v5", "v6"):
+                        gen = (
+                            version + "e" if "lit" in kind else version + "p"
+                        )
+                    else:
+                        gen = version
+                    break
+        except Exception:
+            pass
+    if gen not in _PEAK_TFLOPS:
+        gen = default_gen
+    return _PEAK_TFLOPS[gen] * 1e12
+
+
+def transformer_train_flops(n_params: int, tokens: int) -> float:
+    """Training FLOPs for a dense transformer: the standard 6·N·D
+    estimate (fwd 2ND + bwd 4ND), N = non-embedding ≈ total params for
+    the sizes benchmarked here."""
+    return 6.0 * float(n_params) * float(tokens)
+
+
+def resnet50_train_flops(images: int, image_size: int = 224) -> float:
+    """ResNet-50 training FLOPs: ~4.1 GFLOPs forward per 224² image
+    (He et al. 2015 Table 1 ×2 for multiply+add), ×3 for fwd+bwd."""
+    fwd = 4.1e9 * (image_size / 224.0) ** 2
+    return 3.0 * fwd * float(images)
+
+
+def count_params(tree) -> int:
+    import jax
+
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))

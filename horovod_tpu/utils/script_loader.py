@@ -1,0 +1,24 @@
+"""Load a repo example script as a module (examples/ is intentionally NOT
+a package — each script is a self-contained file users copy). Shared by
+bench.py and the example smoke tests."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def load_example(name: str):
+    """Import examples/<name>.py by path and return the module."""
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    path = os.path.join(repo_root, "examples", f"{name}.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no example script {path}")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod

@@ -1,0 +1,44 @@
+"""Example-script smoke tests (reference tier-3 pattern: the examples ARE
+the integration surface users copy; SURVEY.md §4). Each runs in-process
+on the 8-device CPU mesh with tiny configs."""
+
+import numpy as np
+
+from horovod_tpu.utils.script_loader import load_example as _load
+
+
+def test_mnist_example_learns():
+    acc = _load("mnist").main(
+        ["--epochs", "1", "--train-size", "512", "--test-size", "128"]
+    )
+    # synthetic templates are separable: one epoch should beat chance by far
+    assert acc > 0.5
+
+
+def test_adasum_gpt2_converges():
+    first, last = _load("adasum_gpt2").main(["--steps", "20"])
+    assert last < first - 0.5, (first, last)
+
+
+def test_elastic_gpt2_runs_to_completion():
+    final = _load("gpt2_elastic").main(["--steps", "12", "--commit-every", "4"])
+    assert np.isfinite(final)
+
+
+def test_bert_pretraining_tiny():
+    per_chip, mfu = _load("bert_pretraining").main(
+        ["--layers", "2", "--hidden", "128", "--seq-len", "64",
+         "--batch-size", "2", "--num-iters", "1",
+         "--num-batches-per-iter", "2", "--num-warmup-batches", "1"]
+    )
+    assert per_chip > 0
+    assert 0 <= mfu < 1
+
+
+def test_resnet_synthetic_tiny():
+    per_chip, mfu = _load("resnet50_synthetic").main(
+        ["--batch-size", "2", "--image-size", "32", "--num-iters", "1",
+         "--num-batches-per-iter", "1", "--num-warmup-batches", "1",
+         "--num-classes", "10", "--bf16-allreduce"]
+    )
+    assert per_chip > 0
