@@ -121,7 +121,9 @@ def main(argv=None):
         )
     for _ in range(args.num_warmup_batches):
         params, opt_state, loss = step(params, opt_state, tok, lab, msk)
-    float(loss[0])  # host sync (block_until_ready is lazy on remote paths)
+    if args.num_warmup_batches:
+        # host sync (block_until_ready is lazy on remote paths)
+        float(loss[0])
 
     rates = []
     for it in range(args.num_iters):

@@ -86,18 +86,23 @@ def main(argv=None):
     params = jax.jit(model.init)(
         jax.random.PRNGKey(0), jnp.zeros((1, args.seq_len), dtype=jnp.int32)
     )["params"]
-    opt = hvd.DistributedOptimizer(optax.adam(args.lr * hvd.size()))
-    opt_state = opt.init(params)
+    # optax.adam's state layout doesn't depend on the LR, so init with the
+    # current world's optimizer; train() rebuilds it per world size
+    opt_state = hvd.DistributedOptimizer(
+        optax.adam(args.lr * hvd.size())
+    ).init(params)
 
     state = hvd.elastic.TpuState(
-        params=params, opt_state=opt_state, step=0
+        params=params, opt_state=opt_state, step=0, last_loss=float("nan")
     )
 
     @hvd.elastic.run
     def train(state):
-        # (re)build for the CURRENT world — size/mesh change across resizes
+        # (re)build for the CURRENT world — size, mesh, and the LR scale
+        # all change across resizes
         n = hvd.size()
         mesh = hvd.mesh()
+        opt = hvd.DistributedOptimizer(optax.adam(args.lr * n))
         step = build_step(model, opt, n, mesh)
         r = np.random.RandomState(0)
         toks = r.randint(
@@ -109,17 +114,20 @@ def main(argv=None):
                 state.params, state.opt_state, tok
             )
             state.step += 1
+            state.last_loss = float(loss[0])
             if state.step % args.commit_every == 0:
                 # snapshot + surface pending host updates (the elastic
                 # heartbeat; reference common/elastic.py:60)
                 state.commit()
                 if hvd.rank() == 0:
                     print(
-                        f"step {state.step}: loss {float(loss[0]):.4f} "
+                        f"step {state.step}: loss {state.last_loss:.4f} "
                         f"(world {n})",
                         flush=True,
                     )
-        return float(loss[0])
+        # state, not a local: a re-entry after the final commit's interrupt
+        # skips the loop entirely
+        return state.last_loss
 
     t0 = time.time()
     final = train(state)

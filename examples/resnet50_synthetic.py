@@ -109,7 +109,9 @@ def main(argv=None):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, xs, ys
         )
-    float(loss[0])  # host sync (block_until_ready is lazy on remote paths)
+    if args.num_warmup_batches:
+        # host sync (block_until_ready is lazy on remote paths)
+        float(loss[0])
 
     rates = []
     for it in range(args.num_iters):

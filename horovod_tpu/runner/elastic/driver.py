@@ -209,6 +209,18 @@ class ElasticDriver:
                 code = 1
             if code == 0:
                 self._registry.record_success(slot.hostname, slot.local_rank)
+            elif (
+                code < 0 and events and any(e.is_set() for e in events)
+            ):
+                # Killed by signal while the round was aborting: the
+                # launcher terminated this worker because ANOTHER slot
+                # failed first (any-failure-kills-the-round). Terminal for
+                # the barrier, but not this host's fault — it stays
+                # eligible for the next round with its rank preserved.
+                # A worker that exited nonzero on its own (code > 0) is a
+                # real FAILURE even if the event fired meanwhile — two
+                # simultaneous crashes must both blacklist.
+                self._registry.record_aborted(slot.hostname, slot.local_rank)
             else:
                 self._registry.record_failure(slot.hostname, slot.local_rank)
             return code

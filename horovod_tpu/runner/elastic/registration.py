@@ -14,6 +14,13 @@ from typing import Callable, Dict, Optional
 READY = "READY"
 SUCCESS = "SUCCESS"
 FAILURE = "FAILURE"
+# killed by the launcher because ANOTHER worker failed (round abort):
+# terminal for the barrier, but not the worker's own fault — its host must
+# not be blacklisted (reference keeps surviving workers alive instead;
+# the respawn model terminates and re-launches them)
+ABORTED = "ABORTED"
+
+_TERMINAL = (SUCCESS, FAILURE, ABORTED)
 
 
 class WorkerStateRegistry:
@@ -39,11 +46,11 @@ class WorkerStateRegistry:
         fire: Optional[Dict[str, str]] = None
         with self._lock:
             # first terminal state wins (a FAILURE then exit-0 is FAILURE)
-            if self._states.get(key) in (SUCCESS, FAILURE):
+            if self._states.get(key) in _TERMINAL:
                 return
             self._states[key] = state
             terminal = [
-                s for s in self._states.values() if s in (SUCCESS, FAILURE)
+                s for s in self._states.values() if s in _TERMINAL
             ]
             if self._expected and len(terminal) >= self._expected:
                 fire = dict(self._states)
@@ -58,6 +65,9 @@ class WorkerStateRegistry:
 
     def record_failure(self, host: str, local_rank: int) -> None:
         self._record(f"{host}:{local_rank}", FAILURE)
+
+    def record_aborted(self, host: str, local_rank: int) -> None:
+        self._record(f"{host}:{local_rank}", ABORTED)
 
     def count(self, state: str) -> int:
         with self._lock:

@@ -1,0 +1,107 @@
+"""Worker for the end-to-end elastic integration test.
+
+Spawned by the ElasticDriver as a real process, one per slot. Trains a
+toy "model" (the training step is a real negotiated allreduce over the
+batch's sample indices) with an ElasticSampler, committing progress to
+disk after every batch — the respawn-model analog of the reference's
+in-memory `state.commit()` (common/elastic.py:60): a worker killed by a
+world change resumes from the last committed sampler cursor.
+
+The rank-1 worker of the FIRST round kills itself (os._exit(1)) after
+its third commit, mid-epoch — the fault the driver must absorb: blacklist
+the failed host, keep the survivor's rank, re-launch on the new host set,
+and lose no committed samples.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+DATASET = 48
+BATCH = 2
+EPOCHS = 2
+
+
+def atomic_write(path, obj):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.data.sampler import ElasticSampler
+
+    hvd.init()
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    host = os.environ.get("ELASTIC_E2E_HOST", "?")
+    workdir = os.environ["ELASTIC_E2E_DIR"]
+    state_path = os.path.join(workdir, "state.json")
+    log_path = os.path.join(workdir, "processed.log")
+    marker = os.path.join(workdir, "killed_once")
+
+    with open(os.path.join(workdir, "assignments.log"), "a") as f:
+        f.write(f"{host} {rank} {size}\n")
+
+    sampler = ElasticSampler(DATASET, shuffle=True, seed=7)
+    start_epoch = 0
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            st = json.load(f)
+        sampler.load_state_dict(st["sampler"])
+        start_epoch = st["epoch"]
+    sampler.set_world(rank, size)
+
+    commits = 0
+    for epoch in range(start_epoch, EPOCHS):
+        if sampler.epoch != epoch:
+            sampler.set_epoch(epoch)
+        mine = list(sampler)
+        for off in range(0, len(mine), BATCH):
+            batch = mine[off:off + BATCH]
+            # the "training step": a real negotiated cross-process
+            # collective through the native runtime + XLA executor
+            total = hvd.allreduce(
+                np.asarray(batch, dtype=np.float64), op=hvd.Sum,
+                name="batch_sum",
+            )
+            np.asarray(total)
+            sampler.record_batch(off // BATCH, BATCH)
+            if rank == 0:
+                atomic_write(
+                    state_path,
+                    {"epoch": epoch, "sampler": sampler.state_dict()},
+                )
+            with open(log_path, "a") as f:
+                f.write(
+                    f"{epoch} {host} {rank} "
+                    f"{','.join(str(i) for i in batch)}\n"
+                )
+            commits += 1
+            if (
+                rank == 1
+                and epoch == 0
+                and commits == 3
+                and not os.path.exists(marker)
+            ):
+                with open(marker, "w") as f:
+                    f.write("x")
+                os._exit(1)  # simulated host death, mid-epoch
+        sampler.set_epoch(epoch + 1)
+        if rank == 0:
+            atomic_write(
+                state_path,
+                {"epoch": epoch + 1, "sampler": sampler.state_dict()},
+            )
+    hvd.shutdown()
+    print(f"worker {host} rank {rank}: completed", flush=True)
+
+
+if __name__ == "__main__":
+    main()
