@@ -109,13 +109,16 @@ def main(argv=None):
             0, args.vocab, (args.batch_size * n, args.seq_len)
         )
         tok = jax.device_put(toks, NamedSharding(mesh, P("hvd")))
+        loss = None
         while state.step < args.steps:
             state.params, state.opt_state, loss = step(
                 state.params, state.opt_state, tok
             )
             state.step += 1
-            state.last_loss = float(loss[0])
             if state.step % args.commit_every == 0:
+                # host-sync only at commit boundaries: per-step float()
+                # would serialize the async dispatch pipeline
+                state.last_loss = float(loss[0])
                 # snapshot + surface pending host updates (the elastic
                 # heartbeat; reference common/elastic.py:60)
                 state.commit()
@@ -125,6 +128,8 @@ def main(argv=None):
                         f"(world {n})",
                         flush=True,
                     )
+        if loss is not None:
+            state.last_loss = float(loss[0])
         # state, not a local: a re-entry after the final commit's interrupt
         # skips the loop entirely
         return state.last_loss

@@ -90,6 +90,7 @@ def load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_double, ctypes.c_double,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
     ]
     lib.hvd_native_enqueue.restype = ctypes.c_longlong
     lib.hvd_native_join.restype = ctypes.c_longlong
@@ -211,13 +212,16 @@ class NativeRuntime:
                 shape: Sequence[int], reduce_op: int = 1,
                 root_rank: int = 0, prescale: float = 1.0,
                 postscale: float = 1.0,
-                splits: Optional[Sequence[int]] = None) -> int:
+                splits: Optional[Sequence[int]] = None,
+                group: Optional[str] = None,
+                group_size: int = 0) -> int:
         arr = (ctypes.c_longlong * len(shape))(*shape)
         sp = (ctypes.c_longlong * len(splits))(*splits) if splits else None
         h = self._lib.hvd_native_enqueue(
             name.encode(), op, _NUMPY_TO_DTYPE[dtype], arr, len(shape),
             reduce_op, root_rank, prescale, postscale,
             sp, len(splits) if splits else 0,
+            group.encode() if group else None, group_size,
         )
         if h < 0:
             raise RuntimeError(

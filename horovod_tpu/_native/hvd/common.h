@@ -100,6 +100,12 @@ struct Request {
   // alltoall only: rows of dim 0 destined to each rank (reference
   // operations.cc:1858 uneven splits); empty = even split
   std::vector<int64_t> splits;
+  // grouped collectives (reference group_table.h:25): all members of a
+  // group become ready together or not at all. The tag is derived from
+  // the member names (identical across ranks); group_size is the member
+  // count the coordinator waits for. Empty tag = ungrouped.
+  std::string group;
+  int32_t group_size = 0;
 
   int64_t NumElements() const {
     int64_t n = 1;
@@ -134,6 +140,10 @@ struct Response {
   // alltoall: the full splits matrix, row r = rank r's outgoing splits,
   // flattened [rank * size + dest]; empty when every rank is even
   std::vector<int64_t> all_splits;
+  // non-empty when the constituent tensors were group members: joined
+  // ranks must also skip caching them (grouped responses are uncached so
+  // the cache fast path can never split a group across cycles)
+  std::string group;
 };
 
 struct RequestList {

@@ -127,6 +127,12 @@ void TcpController::IncrementTensorCount(const Request& req, int32_t rank) {
   // and shape must be consistent; allgather tolerates differing first dim
   if (req.op != first.op) {
     rec.error = "mismatched op types for tensor '" + req.name + "'";
+  } else if (req.group != first.group ||
+             req.group_size != first.group_size) {
+    rec.error = "mismatched group membership for tensor '" + req.name +
+                "' (group '" + req.group + "'/" +
+                std::to_string(req.group_size) + " vs '" + first.group +
+                "'/" + std::to_string(first.group_size) + ")";
   } else if (req.dtype != first.dtype) {
     rec.error = "mismatched dtypes for tensor '" + req.name + "'";
   } else if (req.op == OpType::kBroadcast &&
@@ -176,6 +182,7 @@ Response TcpController::ConstructResponse(const std::string& name) {
   resp.dtype = first.dtype;
   resp.first_shape = first.shape;
   resp.tensor_shapes = {first.shape};
+  resp.group = first.group;
   // allgather: total bytes sums every rank's first dim; the negotiated
   // per-rank dim-0 sizes ship in the response so ragged gathers execute
   // (reference allgather size collection, controller.cc:497)
@@ -227,12 +234,15 @@ std::vector<Response> TcpController::FuseResponses(
       out.push_back(std::move(r));
       continue;
     }
+    // group is part of the key: a mixed grouped/ungrouped bucket would
+    // inherit one constituent's group tag and silently break the
+    // grouped-responses-are-never-cached invariant for the others
     std::string key = std::to_string(static_cast<int>(r.op)) + "/" +
                       std::to_string(static_cast<int>(r.dtype)) + "/" +
                       std::to_string(r.reduce_op) + "/" +
                       std::to_string(r.root_rank) + "/" +
                       std::to_string(r.prescale) + "/" +
-                      std::to_string(r.postscale);
+                      std::to_string(r.postscale) + "/" + r.group;
     auto it = open.find(key);
     if (it != open.end() &&
         out[it->second].total_bytes + r.total_bytes <=
@@ -323,12 +333,51 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
     ready.push_back(resp);
   }
   std::vector<std::string> done;
+  // covered group members withheld until their whole group is covered
+  std::map<std::string, std::vector<std::string>> group_covered;
+  std::set<std::string> errored_groups;
   for (auto& kv : message_table_) {
+    const Request& first = kv.second.requests.begin()->second;
+    if (!first.group.empty() && !kv.second.error.empty()) {
+      errored_groups.insert(first.group);
+    }
     size_t covered = kv.second.ranks.size();
     for (int32_t jr : joined_ranks_) {
       if (!kv.second.ranks.count(jr)) ++covered;
     }
-    if (static_cast<int32_t>(covered) >= opts_.size) {
+    if (static_cast<int32_t>(covered) < opts_.size) continue;
+    if (first.group.empty()) {
+      done.push_back(kv.first);
+    } else {
+      group_covered[first.group].push_back(kv.first);
+    }
+  }
+  // all-or-nothing group readiness (reference group_table.h:25,
+  // operations.cc:1518): a group releases only when every member is
+  // globally covered; a member missing on any rank holds the whole group
+  // (and eventually trips the stall inspector for the missing names)
+  for (auto& kv : group_covered) {
+    if (errored_groups.count(kv.first)) continue;  // failed below
+    const std::string& any = kv.second.front();
+    int32_t expect = message_table_[any].requests.begin()->second.group_size;
+    if (static_cast<int32_t>(kv.second.size()) >= expect) {
+      for (auto& n : kv.second) done.push_back(n);
+    }
+  }
+  // A group with any errored member fails as a WHOLE, immediately and on
+  // every rank — covered or not. Waiting for full coverage could block
+  // forever (e.g. mismatched group sizes mean the larger count never
+  // arrives) and would bury the recorded error. Error responses are safe
+  // to emit for partially-covered names: ranks without a local entry
+  // simply have no handle to fail.
+  for (const auto& gname : errored_groups) {
+    for (auto& kv : message_table_) {
+      const Request& first = kv.second.requests.begin()->second;
+      if (first.group != gname) continue;
+      if (kv.second.error.empty()) {
+        kv.second.error =
+            "group '" + gname + "' failed on another member";
+      }
       done.push_back(kv.first);
     }
   }

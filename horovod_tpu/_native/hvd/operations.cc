@@ -151,7 +151,11 @@ bool RunLoopOnce() {
   auto drained = g->tensor_queue.PopMessages(512);
   bool cache_on = g->cache && g->cache->capacity() > 0;
   for (auto& req : drained) {
-    if (cache_on) {
+    // grouped requests never ride the cache fast path: a partial set of
+    // agreed cache hits could release some group members while others
+    // negotiate, splitting the group across cycles — exactly what
+    // all-or-nothing readiness forbids (group_table.h:25)
+    if (cache_on && req.group.empty()) {
       auto state = g->cache->Lookup(req);
       if (state == ResponseCache::State::kHit) {
         // key copied before the move: C++17 sequences the RHS (which
@@ -288,7 +292,7 @@ bool RunLoopOnce() {
     // responses for tensors they never enqueued and must mutate their
     // cache identically to keep positions replicated,
     // response_cache.h:45).
-    if (cache_on && resp.op != OpType::kBarrier) {
+    if (cache_on && resp.op != OpType::kBarrier && resp.group.empty()) {
       std::unordered_map<std::string, const Request*> local;
       for (const auto& e : entries) local[e.request.name] = &e.request;
       for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
@@ -430,7 +434,8 @@ long long hvd_native_enqueue(const char* name, int op, int dtype,
                              const long long* shape, int ndim, int reduce_op,
                              int root_rank, double prescale,
                              double postscale, const long long* splits,
-                             int nsplits) {
+                             int nsplits, const char* group,
+                             int group_size) {
   if (g == nullptr || !g->initialized.load() || g->broken.load()) return -1;
   Request req;
   req.rank = g->rank;
@@ -443,6 +448,8 @@ long long hvd_native_enqueue(const char* name, int op, int dtype,
   req.postscale = postscale;
   for (int i = 0; i < ndim; ++i) req.shape.push_back(shape[i]);
   for (int i = 0; i < nsplits; ++i) req.splits.push_back(splits[i]);
+  if (group != nullptr) req.group = group;
+  req.group_size = group_size;
   int64_t h = g->handle_counter.fetch_add(1);
   SetHandle(h, kPending);
   if (!g->tensor_queue.Add(req, h)) {
@@ -468,7 +475,8 @@ long long hvd_native_join() {
 long long hvd_native_barrier() {
   long long shape[1] = {0};
   return hvd_native_enqueue("__barrier__", static_cast<int>(OpType::kBarrier),
-                            0, shape, 0, 0, 0, 1.0, 1.0, nullptr, 0);
+                            0, shape, 0, 0, 0, 1.0, 1.0, nullptr, 0,
+                            nullptr, 0);
 }
 
 int hvd_native_poll(long long handle) {

@@ -13,6 +13,8 @@ static void WriteRequest(Writer* w, const Request& r) {
   w->F64(r.postscale);
   w->Vec(r.shape);
   w->Vec(r.splits);
+  w->Str(r.group);
+  w->I32(r.group_size);
 }
 
 static Request ReadRequest(Reader* r) {
@@ -27,6 +29,8 @@ static Request ReadRequest(Reader* r) {
   q.postscale = r->F64();
   q.shape = r->Vec<int64_t>();
   q.splits = r->Vec<int64_t>();
+  q.group = r->Str();
+  q.group_size = r->I32();
   return q;
 }
 
@@ -72,6 +76,7 @@ static void WriteResponse(Writer* w, const Response& resp) {
   for (const auto& s : resp.tensor_shapes) w->Vec(s);
   w->Vec(resp.rank_dim0);
   w->Vec(resp.all_splits);
+  w->Str(resp.group);
 }
 
 static Response ReadResponse(Reader* r) {
@@ -95,6 +100,7 @@ static Response ReadResponse(Reader* r) {
   }
   resp.rank_dim0 = r->Vec<int64_t>();
   resp.all_splits = r->Vec<int64_t>();
+  resp.group = r->Str();
   return resp;
 }
 

@@ -1010,17 +1010,30 @@ def _native_rt_for_async(process_set=None):
 
 def _native_async(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
                   postscale=1.0, root_rank=0, name=None,
-                  splits=None) -> int:
+                  splits=None, grouped=False) -> int:
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
     namer = _leaf_namer(name)
+    names = [namer() or _auto_name(op_kind) for _ in leaves]
+    group, group_size = None, 0
+    if grouped and len(names) > 1:
+        # all-or-nothing readiness (reference group_table.h:25): the tag
+        # is derived from the member names so every rank computes the
+        # same group identity without a registration round-trip
+        import hashlib
+
+        group = hashlib.sha1(
+            "|".join(names).encode()
+        ).hexdigest()[:16]
+        group_size = len(names)
     hs = []
-    for leaf in leaves:
+    for leaf_name, leaf in zip(names, leaves):
         hs.append(
             rt.enqueue(
-                namer() or _auto_name(op_kind), np.asarray(leaf),
+                leaf_name, np.asarray(leaf),
                 _NATIVE_OPS[op_kind], reduce_op=int(op),
                 root_rank=int(root_rank), prescale=float(prescale),
                 postscale=float(postscale), splits=splits,
+                group=group, group_size=group_size,
             )
         )
     return _handles.allocate(
@@ -1102,12 +1115,14 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         raise ValueError("specify either average= or op=, not both")
     rt = _native_rt_for_async(process_set)
     if rt is not None:
-        # one enqueue per tensor in the same cycle: the controller's
-        # FuseResponses packs them into fused batches — the real runtime
-        # fusion path, not the compile-time bucketing of ops/fusion.py
+        # one enqueue per tensor, tagged as a group: the controller holds
+        # all members until every one is globally ready (all-or-nothing,
+        # group_table.h:25) and FuseResponses packs them into fused
+        # batches — the real runtime fusion path, not the compile-time
+        # bucketing of ops/fusion.py
         return _native_async(
             rt, "allreduce", list(tensors), op, prescale_factor,
-            postscale_factor, name=name,
+            postscale_factor, name=name, grouped=True,
         )
     return _async(grouped_allreduce, tensors, op=op, name=name,
                   prescale_factor=prescale_factor,

@@ -193,13 +193,15 @@ class EagerRuntime:
     def enqueue(self, name: str, tensor, op: int = OP_ALLREDUCE,
                 reduce_op: int = _REDUCE_SUM, root_rank: int = 0,
                 prescale: float = 1.0, postscale: float = 1.0,
-                splits: Optional[List[int]] = None) -> int:
+                splits: Optional[List[int]] = None,
+                group: Optional[str] = None, group_size: int = 0) -> int:
         arr = np.asarray(tensor)
         handle = self._native.enqueue(
             name, op, str(arr.dtype), list(arr.shape),
             reduce_op=reduce_op, root_rank=root_rank,
             prescale=prescale, postscale=postscale,
             splits=[int(s) for s in splits] if splits is not None else None,
+            group=group, group_size=group_size,
         )
         # span opens only after the native enqueue accepted the tensor — a
         # raise above would otherwise leave an unclosed 'B' corrupting the

@@ -112,6 +112,20 @@ def main():
         and [int(x) for x in np.asarray(recv_splits)] == [rank + 1] * size
     )
 
+    # 6b. grouped allreduce: members enqueue under one group tag; the
+    # controller releases them all-or-nothing and fuses them into one
+    # batch (reference group_table.h:25 + FuseResponses)
+    tensors = [
+        np.full((3,), float((rank + 1) * (i + 1)), dtype=np.float32)
+        for i in range(3)
+    ]
+    gh = hvd.grouped_allreduce_async(tensors, op=hvd.Sum, name="gblk")
+    gres = hvd.synchronize(gh)
+    out["grouped_ok"] = all(
+        np.allclose(np.asarray(gres[i]), s_world * (i + 1))
+        for i in range(3)
+    )
+
     # 7. join: rank 0 runs out of data; the others keep reducing and the
     # joined rank contributes zeros through the XLA executor (reference
     # JoinOp, collective_operations.h:325)

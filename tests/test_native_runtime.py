@@ -372,6 +372,122 @@ def test_three_rank_world():
     assert names == ["p0", "p1", "p2", "p3", "p4"]
 
 
+# ---------------------------------------------------------- groups
+
+
+def scenario_grouped_complete(native, rt, rank, size):
+    """All ranks submit the full group (in different orders): every member
+    completes, released in the same negotiation cycle."""
+    names = ["gm0", "gm1", "gm2"]
+    order = names if rank == 0 else list(reversed(names))
+    hs = [
+        rt.enqueue(n, native.OP_ALLREDUCE, "float32", [8],
+                   group="grp-a", group_size=3)
+        for n in order
+    ]
+    log = _drain_until(rt, hs)
+    return {"log": log, "states": [rt.poll(h) for h in hs]}
+
+
+def test_grouped_members_complete_together():
+    out = _run_world(2, scenario_grouped_complete)
+    assert out[0]["log"] == out[1]["log"]
+    all_names = sorted(n for _, names in out[0]["log"] for n in names)
+    assert all_names == ["gm0", "gm1", "gm2"]
+    assert all(s == rt_mod_DONE for s in out[0]["states"])
+    # same dtype/op → the whole group fuses into ONE batch
+    assert len(out[0]["log"]) == 1, out[0]["log"]
+
+
+def scenario_grouped_partial(native, rt, rank, size):
+    """Rank 1 submits only one member of a 2-group: the whole group must
+    block (no member executes) and the stall shutdown must fail BOTH
+    ranks consistently (group_table.h all-or-nothing + the negotiation
+    error channel)."""
+    hs = [rt.enqueue("pg0", native.OP_ALLREDUCE, "float32", [4],
+                     group="grp-p", group_size=2)]
+    if rank == 0:
+        hs.append(rt.enqueue("pg1", native.OP_ALLREDUCE, "float32", [4],
+                             group="grp-p", group_size=2))
+    deadline = time.time() + 25
+    pending = set(hs)
+    while pending and time.time() < deadline:
+        b = rt.next_batch(timeout_s=0.2)
+        if b is not None:
+            rt.batch_done(b, ok=True)
+        done = {h for h in pending
+                if rt.poll(h) in (rt_mod_DONE, rt_mod_FAILED)}
+        pending -= done
+    return {"states": [rt.poll(h) for h in hs]}
+
+
+def _worker_stall(rank, size, port, scenario, q):
+    """Worker with a short stall-shutdown so blocked groups error out."""
+    native = _load_native()
+    rt = native.NativeRuntime()
+    rt.init(rank, size, "127.0.0.1", port, cycle_ms=1.0,
+            cache_capacity=64, stall_warning_s=1.0, stall_shutdown_s=3.0)
+    try:
+        result = scenario(native, rt, rank, size)
+        q.put((rank, "ok", result))
+    except Exception as e:
+        q.put((rank, "err", repr(e)))
+    finally:
+        rt.shutdown()
+
+
+def test_grouped_partial_submission_blocks_and_errors():
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker_stall,
+                    args=(r, 2, port, scenario_grouped_partial, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = time.time() + 60
+    while len(results) < 2 and time.time() < deadline:
+        try:
+            rank, status, payload = q.get(timeout=1.0)
+            results[rank] = (status, payload)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.terminate()
+    assert len(results) == 2, f"only {len(results)}/2 reported"
+    for rank, (status, payload) in results.items():
+        assert status == "ok", f"rank {rank}: {payload}"
+        # nothing may complete; the stall shutdown fails everything on
+        # every rank — consistently, not by deadlock
+        assert all(s == rt_mod_FAILED for s in payload["states"]), payload
+
+
+def scenario_group_mismatch(native, rt, rank, size):
+    """Same tensor, different group metadata across ranks → consistent
+    negotiated error."""
+    gs = 2 if rank == 0 else 3
+    h = rt.enqueue("gmx", native.OP_ALLREDUCE, "float32", [4],
+                   group="grp-m", group_size=gs)
+    h2 = rt.enqueue("gmx2", native.OP_ALLREDUCE, "float32", [4],
+                    group="grp-m", group_size=gs)
+    state = rt.wait(h, timeout_s=20.0)
+    state2 = rt.wait(h2, timeout_s=20.0)
+    return {"state": state, "state2": state2}
+
+
+def test_group_metadata_mismatch_errors_consistently():
+    out = _run_world(2, scenario_group_mismatch)
+    for r in range(2):
+        # the whole group fails — both members, on both ranks
+        assert out[r]["state"] == rt_mod_FAILED, out[r]
+        assert out[r]["state2"] == rt_mod_FAILED, out[r]
+
+
 # ---------------------------------------------------------- single process
 
 
