@@ -82,9 +82,13 @@ def load() -> ctypes.CDLL:
     lib.hvd_native_init.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_double, ctypes.c_longlong, ctypes.c_int,
-        ctypes.c_double, ctypes.c_double,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.hvd_native_init.restype = ctypes.c_int
+    lib.hvd_native_tuned_cycle_ms.restype = ctypes.c_double
+    lib.hvd_native_tuned_threshold.restype = ctypes.c_longlong
+    lib.hvd_native_tuned_pinned.restype = ctypes.c_int
     lib.hvd_native_enqueue.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
@@ -191,11 +195,15 @@ class NativeRuntime:
              coordinator_port: int = 0, cycle_ms: float = 1.0,
              fusion_threshold: int = 128 << 20, cache_capacity: int = 1024,
              stall_warning_s: float = 60.0,
-             stall_shutdown_s: float = 0.0) -> None:
+             stall_shutdown_s: float = 0.0,
+             autotune: bool = False,
+             autotune_warmup: int = -1,
+             autotune_cycles_per_sample: int = -1) -> None:
         rc = self._lib.hvd_native_init(
             rank, size, coordinator_addr.encode(), coordinator_port,
             cycle_ms, fusion_threshold, cache_capacity, stall_warning_s,
-            stall_shutdown_s,
+            stall_shutdown_s, 1 if autotune else 0, autotune_warmup,
+            autotune_cycles_per_sample,
         )
         if rc != 0:
             raise RuntimeError(
@@ -298,3 +306,12 @@ class NativeRuntime:
 
     def coordinator_port(self) -> int:
         return self._lib.hvd_native_coordinator_port()
+
+    def tuned_cycle_ms(self) -> float:
+        return self._lib.hvd_native_tuned_cycle_ms()
+
+    def tuned_threshold(self) -> int:
+        return self._lib.hvd_native_tuned_threshold()
+
+    def tuned_pinned(self) -> bool:
+        return bool(self._lib.hvd_native_tuned_pinned())

@@ -163,6 +163,13 @@ struct ResponseList {
   std::vector<uint64_t> agreed_invalid_bits;
   bool shutdown = false;
   int32_t join_count = 0;
+  // Control-plane autotune (reference parameter_manager.cc:528, which
+  // broadcasts the winning parameters): the coordinator owns the search
+  // and ships the currently-applied values with every cycle, so all
+  // ranks hold identical parameters by construction. 0 = autotune off.
+  double tuned_cycle_ms = 0.0;
+  int64_t tuned_threshold = 0;
+  bool tuned_pinned = false;
 };
 
 }  // namespace hvd

@@ -1,14 +1,45 @@
 #include "controller.h"
 
+#include <chrono>
 #include <cstdio>
 
 #include "wire.h"
 
 namespace hvd {
 
+namespace {
+// autotune candidate grids (coordinate descent; reference searches a
+// joint space with a GP — a 2-phase sweep covers this 2-D space without
+// Eigen/LBFGS baggage)
+const int64_t kAtThresholds[] = {
+    1ll << 20, 4ll << 20, 16ll << 20, 64ll << 20,
+    128ll << 20, 256ll << 20,
+};
+const double kAtCycles[] = {0.25, 0.5, 1.0, 2.5, 5.0};
+
+double MonoSeconds() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+}  // namespace
+
 TcpController::TcpController(const ControllerOptions& opts)
     : opts_(opts),
-      stall_inspector_(opts.stall_warning_s, opts.stall_shutdown_s) {}
+      stall_inspector_(opts.stall_warning_s, opts.stall_shutdown_s),
+      fusion_threshold_(opts.fusion_threshold_bytes),
+      tuned_cycle_ms_(opts.cycle_ms),
+      at_warmup_left_(opts.autotune_warmup_samples) {
+  // a 1-cycle sample has no measurable interval (the anchor cycle opens
+  // the window); two counted cycles is the floor for a meaningful score
+  if (opts_.autotune_cycles_per_sample < 2) {
+    opts_.autotune_cycles_per_sample = 2;
+  }
+  if (opts_.autotune && opts_.autotune_warmup_samples <= 0) {
+    at_phase_ = 1;  // warmup disabled: start the threshold sweep at once
+    fusion_threshold_ = kAtThresholds[0];
+  }
+}
 
 bool TcpController::Initialize() {
   if (opts_.size == 1) return true;
@@ -246,7 +277,7 @@ std::vector<Response> TcpController::FuseResponses(
     auto it = open.find(key);
     if (it != open.end() &&
         out[it->second].total_bytes + r.total_bytes <=
-            opts_.fusion_threshold_bytes) {
+            fusion_threshold_) {
       out[it->second].tensor_names.push_back(r.tensor_names[0]);
       out[it->second].tensor_shapes.push_back(
           r.tensor_shapes.empty() ? r.first_shape : r.tensor_shapes[0]);
@@ -427,12 +458,96 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
   rl.agreed_invalid_bits = std::move(agreed_invalid);
   rl.shutdown = shutdown;
 
+  // 6b. autotune: score this cycle's traffic, maybe advance the search,
+  // and ship the currently-applied parameters so every rank holds the
+  // same values (reference parameter_manager.cc:528 SyncParams)
+  if (opts_.autotune && !autotune_pinned_) AutotuneObserve(rl);
+  if (opts_.autotune) {
+    rl.tuned_cycle_ms = tuned_cycle_ms_;
+    rl.tuned_threshold = fusion_threshold_;
+    rl.tuned_pinned = autotune_pinned_;
+  }
+
   // 7. broadcast the agreed list
   auto frame = SerializeResponseList(rl);
   for (int32_t r = 1; r < opts_.size; ++r) {
     worker_socks_[r - 1].SendFrame(frame);
   }
   return rl;
+}
+
+void TcpController::AutotuneObserve(const ResponseList& rl) {
+  int64_t bytes = 0;
+  for (const auto& r : rl.responses) {
+    if (r.op == OpType::kError || r.op == OpType::kJoin ||
+        r.op == OpType::kBarrier) {
+      continue;
+    }
+    bytes += r.total_bytes;
+  }
+  if (bytes == 0) return;  // idle cycle: no signal
+  double now = MonoSeconds();
+  if (at_sample_busy_ == 0) {
+    // anchor cycle: opens the window; its bytes are not counted so N
+    // busy cycles score N-1 complete intervals (a 1-cycle window would
+    // measure microseconds of its own bookkeeping)
+    at_last_busy_ = now;
+    at_sample_elapsed_ = 0.0;
+    at_sample_bytes_ = 0;
+    at_sample_busy_ = 1;
+    return;
+  }
+  // per-interval cap: an idle pause between busy cycles (data stall,
+  // eval break) must not poison the candidate's score — it appears as
+  // one capped interval instead of the full gap
+  double cap = std::max(10.0 * tuned_cycle_ms_ / 1000.0, 0.05);
+  at_sample_elapsed_ += std::min(now - at_last_busy_, cap);
+  at_last_busy_ = now;
+  at_sample_bytes_ += bytes;
+  if (++at_sample_busy_ < opts_.autotune_cycles_per_sample + 1) return;
+
+  double elapsed = at_sample_elapsed_;
+  double score = at_sample_bytes_ / (elapsed > 1e-9 ? elapsed : 1e-9);
+  at_sample_bytes_ = 0;
+  at_sample_busy_ = 0;
+
+  const size_t n_thr = sizeof(kAtThresholds) / sizeof(kAtThresholds[0]);
+  const size_t n_cyc = sizeof(kAtCycles) / sizeof(kAtCycles[0]);
+  if (at_phase_ == 0) {
+    if (--at_warmup_left_ > 0) return;
+    at_phase_ = 1;
+    at_idx_ = 0;
+    at_best_score_ = 0.0;
+    fusion_threshold_ = kAtThresholds[0];
+    return;
+  }
+  if (at_phase_ == 1) {
+    if (score > at_best_score_) {
+      at_best_score_ = score;
+      at_best_threshold_ = fusion_threshold_;
+    }
+    if (++at_idx_ < n_thr) {
+      fusion_threshold_ = kAtThresholds[at_idx_];
+      return;
+    }
+    fusion_threshold_ = at_best_threshold_;
+    at_phase_ = 2;
+    at_idx_ = 0;
+    at_best_score_ = 0.0;
+    tuned_cycle_ms_ = kAtCycles[0];
+    return;
+  }
+  // phase 2: cycle-time sweep at the pinned threshold
+  if (score > at_best_score_) {
+    at_best_score_ = score;
+    at_best_cycle_ = tuned_cycle_ms_;
+  }
+  if (++at_idx_ < n_cyc) {
+    tuned_cycle_ms_ = kAtCycles[at_idx_];
+    return;
+  }
+  tuned_cycle_ms_ = at_best_cycle_;
+  autotune_pinned_ = true;
 }
 
 }  // namespace hvd

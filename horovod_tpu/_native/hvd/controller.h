@@ -43,6 +43,11 @@ struct ControllerOptions {
   int64_t fusion_threshold_bytes = 128ll * 1024 * 1024;
   double stall_warning_s = 60.0;
   double stall_shutdown_s = 0.0;
+  // control-plane autotune (reference parameter_manager.h:42)
+  bool autotune = false;
+  double cycle_ms = 1.0;  // initial cycle time (autotune phase-2 base)
+  int32_t autotune_warmup_samples = 3;
+  int32_t autotune_cycles_per_sample = 32;
 };
 
 class TcpController {
@@ -93,6 +98,28 @@ class TcpController {
 
   StallInspector stall_inspector_;
   int64_t stall_warnings_ = 0;
+
+  // --- autotune (coordinator-only; the reference runs ParameterManager
+  // on the coordinator and broadcasts winners, parameter_manager.cc:528).
+  // Search = coordinate descent: sweep fusion thresholds at the initial
+  // cycle time, pin the best, then sweep cycle times. Scores are
+  // bytes/sec over windows of busy (response-emitting) cycles. The
+  // threshold applies only HERE (fusion is a coordinator decision); the
+  // cycle time ships to workers in the ResponseList.
+  void AutotuneObserve(const ResponseList& rl);
+  int64_t fusion_threshold_;  // live value FuseResponses uses
+  double tuned_cycle_ms_;
+  bool autotune_pinned_ = false;
+  int at_phase_ = 0;  // 0 warmup, 1 thresholds, 2 cycles
+  size_t at_idx_ = 0;
+  int at_warmup_left_ = 0;
+  int64_t at_sample_bytes_ = 0;
+  int at_sample_busy_ = 0;      // busy cycles seen incl. the anchor
+  double at_last_busy_ = 0.0;   // time of the previous busy cycle
+  double at_sample_elapsed_ = 0.0;  // capped busy-interval sum
+  double at_best_score_ = 0.0;
+  int64_t at_best_threshold_ = 0;
+  double at_best_cycle_ = 0.0;
 
  public:
   // The coordinator needs a cache replica to resolve cache-bit positions

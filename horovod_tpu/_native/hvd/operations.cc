@@ -91,9 +91,14 @@ struct Global {
   // requests to re-submit through full negotiation next cycle
   std::vector<Request> retry_requests;
 
-  double cycle_ms = 1.0;
+  std::atomic<double> cycle_ms{1.0};
   int32_t rank = 0;
   int32_t size = 1;
+
+  // autotuned values distributed by the coordinator (ResponseList)
+  std::atomic<double> tuned_cycle_ms{0.0};
+  std::atomic<long long> tuned_threshold{0};
+  std::atomic<bool> tuned_pinned{false};
 
   std::mutex err_mu;
   std::string last_error;
@@ -218,6 +223,16 @@ bool RunLoopOnce() {
 
   ResponseList rl = g->controller->RunCycle(own);
 
+  // coordinator-distributed autotune values: every rank applies the same
+  // cycle time in the same cycle (threshold is applied inside the
+  // coordinator's FuseResponses; recorded here for observability)
+  if (rl.tuned_cycle_ms > 0.0) {
+    g->cycle_ms.store(rl.tuned_cycle_ms);
+    g->tuned_cycle_ms.store(rl.tuned_cycle_ms);
+  }
+  if (rl.tuned_threshold > 0) g->tuned_threshold.store(rl.tuned_threshold);
+  if (rl.tuned_pinned) g->tuned_pinned.store(true);
+
   // Apply the coordinated invalidations before any Put from this cycle's
   // responses: same order on every rank, identical cache state after.
   if (cache_on && !rl.agreed_invalid_bits.empty()) {
@@ -340,7 +355,7 @@ bool RunLoopOnce() {
   if (cache_on && !g->pending_hits.empty()) {
     const int max_park_cycles = std::max(
         8, static_cast<int>(kHitParkSeconds * 1000.0 /
-                            std::max(0.01, g->cycle_ms)));
+                            std::max(0.01, g->cycle_ms.load())));
     for (auto it = g->pending_hits.begin(); it != g->pending_hits.end();) {
       if (++it->second.age >= max_park_cycles) {
         g->retry_requests.push_back(std::move(it->second.request));
@@ -355,8 +370,10 @@ bool RunLoopOnce() {
 }
 
 void BackgroundLoop() {
-  auto cycle = std::chrono::duration<double, std::milli>(g->cycle_ms);
   while (true) {
+    // re-read each iteration: autotune retunes the cycle time live
+    auto cycle = std::chrono::duration<double, std::milli>(
+        g->cycle_ms.load());
     auto start = std::chrono::steady_clock::now();
     // Shutdown exits ONLY through the protocol: the flag rides out in
     // own.shutdown, the coordinator ORs all ranks' flags and echoes the
@@ -389,7 +406,8 @@ extern "C" {
 int hvd_native_init(int rank, int size, const char* coord_addr,
                     int coord_port, double cycle_ms, long long fusion_bytes,
                     int cache_capacity, double stall_warning_s,
-                    double stall_shutdown_s) {
+                    double stall_shutdown_s, int autotune,
+                    int autotune_warmup, int autotune_cycles_per_sample) {
   if (g != nullptr && g->initialized.load()) return 0;
   delete g;
   g = new Global();
@@ -406,6 +424,14 @@ int hvd_native_init(int rank, int size, const char* coord_addr,
   opts.fusion_threshold_bytes = fusion_bytes;
   opts.stall_warning_s = stall_warning_s;
   opts.stall_shutdown_s = stall_shutdown_s;
+  opts.autotune = autotune != 0;
+  opts.cycle_ms = cycle_ms;
+  // negative = "use the built-in default"; an explicit 0 is honored
+  // (warmup 0 = start sweeping immediately)
+  if (autotune_warmup >= 0) opts.autotune_warmup_samples = autotune_warmup;
+  if (autotune_cycles_per_sample >= 0) {
+    opts.autotune_cycles_per_sample = autotune_cycles_per_sample;
+  }
   g->controller.reset(new TcpController(opts));
   g->controller->cache = g->cache.get();
   if (!g->controller->Initialize()) {
@@ -613,6 +639,20 @@ long long hvd_native_bytes_negotiated() {
 
 int hvd_native_coordinator_port() {
   return g && g->controller ? g->controller->bound_port() : 0;
+}
+
+// Autotuned parameters as distributed by the coordinator — identical on
+// every rank (the agreement test's observable).
+double hvd_native_tuned_cycle_ms() {
+  return g ? g->tuned_cycle_ms.load() : 0.0;
+}
+
+long long hvd_native_tuned_threshold() {
+  return g ? g->tuned_threshold.load() : 0;
+}
+
+int hvd_native_tuned_pinned() {
+  return g && g->tuned_pinned.load() ? 1 : 0;
 }
 
 }  // extern "C"

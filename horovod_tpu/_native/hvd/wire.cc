@@ -109,6 +109,9 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.U8(rl.shutdown ? 1 : 0);
   w.I32(rl.join_count);
   w.Vec(rl.agreed_invalid_bits);
+  w.F64(rl.tuned_cycle_ms);
+  w.I64(rl.tuned_threshold);
+  w.U8(rl.tuned_pinned ? 1 : 0);
   w.I32(static_cast<int32_t>(rl.responses.size()));
   for (const auto& r : rl.responses) WriteResponse(&w, r);
   return w.data();
@@ -120,6 +123,9 @@ bool DeserializeResponseList(const uint8_t* data, size_t len,
   rl->shutdown = r.U8() != 0;
   rl->join_count = r.I32();
   rl->agreed_invalid_bits = r.Vec<uint64_t>();
+  rl->tuned_cycle_ms = r.F64();
+  rl->tuned_threshold = r.I64();
+  rl->tuned_pinned = r.U8() != 0;
   int32_t n = r.I32();
   rl->responses.clear();
   for (int32_t i = 0; i < n && r.ok(); ++i) {

@@ -208,7 +208,11 @@ def init(
             mark_cycles=st.knobs.timeline_mark_cycles,
         )
 
-        if st.knobs.autotune:
+        if st.knobs.autotune and not st.knobs.native_eager:
+            # compile-time bucket tuner for the SPMD path (single
+            # controller — no cross-rank agreement needed). In native
+            # eager mode the coordinator owns tuning and distributes the
+            # winning parameters in its ResponseLists.
             from ..ops.autotune import ParameterManager
 
             st.parameter_manager = ParameterManager(st.knobs)
@@ -256,6 +260,9 @@ def _start_native_eager(st) -> None:
         ),
         stall_warning_s=st.knobs.stall_warning_time_seconds,
         stall_shutdown_s=st.knobs.stall_shutdown_time_seconds,
+        autotune=st.knobs.autotune,
+        autotune_warmup=st.knobs.autotune_warmup_samples,
+        autotune_cycles_per_sample=st.knobs.autotune_steps_per_sample,
     )
 
 

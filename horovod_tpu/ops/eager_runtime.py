@@ -167,13 +167,18 @@ class EagerRuntime:
         cache_capacity: int = 1024,
         stall_warning_s: float = 60.0,
         stall_shutdown_s: float = 0.0,
+        autotune: bool = False,
+        autotune_warmup: int = -1,
+        autotune_cycles_per_sample: int = -1,
     ):
         self._native = NativeRuntime()
         self._native.init(
             rank, size, coordinator_addr, coordinator_port,
             cycle_ms=cycle_ms, fusion_threshold=fusion_threshold,
             cache_capacity=cache_capacity, stall_warning_s=stall_warning_s,
-            stall_shutdown_s=stall_shutdown_s,
+            stall_shutdown_s=stall_shutdown_s, autotune=autotune,
+            autotune_warmup=autotune_warmup,
+            autotune_cycles_per_sample=autotune_cycles_per_sample,
         )
         self._executor = executor or LoopbackExecutor(size, rank)
         self._lock = threading.Lock()
@@ -383,6 +388,16 @@ class EagerRuntime:
 
     def stall_warnings(self) -> int:
         return self._native.stall_warnings()
+
+    def tuned_parameters(self) -> dict:
+        """Coordinator-distributed autotune values — identical on every
+        rank by construction (the coordinator ships them in each
+        ResponseList; reference parameter_manager.cc:528)."""
+        return {
+            "cycle_ms": self._native.tuned_cycle_ms(),
+            "fusion_threshold_bytes": self._native.tuned_threshold(),
+            "pinned": self._native.tuned_pinned(),
+        }
 
     def shutdown(self) -> None:
         self._shutdown.set()

@@ -488,6 +488,87 @@ def test_group_metadata_mismatch_errors_consistently():
         assert out[r]["state2"] == rt_mod_FAILED, out[r]
 
 
+# ---------------------------------------------------------- autotune
+
+
+def _worker_autotune(rank, size, port, scenario, q):
+    """Worker with fast autotune settings: warmup 1 sample, 2 busy cycles
+    per sample → the 2-phase sweep (6 thresholds + 5 cycles) pins after
+    ~24 busy cycles."""
+    native = _load_native()
+    rt = native.NativeRuntime()
+    rt.init(rank, size, "127.0.0.1", port, cycle_ms=1.0,
+            cache_capacity=64, stall_warning_s=60.0,
+            autotune=True, autotune_warmup=1,
+            autotune_cycles_per_sample=2)
+    try:
+        q.put((rank, "ok", scenario(native, rt, rank, size)))
+    except Exception as e:
+        q.put((rank, "err", repr(e)))
+    finally:
+        rt.shutdown()
+
+
+def scenario_autotune(native, rt, rank, size):
+    """Steady traffic until the coordinator pins; every rank reads the
+    distributed parameters."""
+    deadline = time.time() + 40
+    step = 0
+    while not rt.tuned_pinned() and time.time() < deadline:
+        hs = [
+            rt.enqueue(f"at{i}", native.OP_ALLREDUCE, "float32", [256])
+            for i in range(3)
+        ]
+        _drain_until(rt, hs, timeout_s=10.0)
+        step += 1
+    return {
+        "pinned": rt.tuned_pinned(),
+        "cycle_ms": rt.tuned_cycle_ms(),
+        "threshold": rt.tuned_threshold(),
+        "steps": step,
+    }
+
+
+def test_autotune_all_ranks_pin_identical_parameters():
+    """The coordinator searches {threshold x cycle_ms} and distributes
+    the applied values in every ResponseList — so agreement is by
+    construction, matching the reference's broadcast of winning
+    parameters (parameter_manager.cc:528)."""
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker_autotune,
+                    args=(r, 2, port, scenario_autotune, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = time.time() + 90
+    while len(results) < 2 and time.time() < deadline:
+        try:
+            rank, status, payload = q.get(timeout=1.0)
+            results[rank] = (status, payload)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.terminate()
+    assert len(results) == 2, f"only {len(results)}/2 reported"
+    payloads = {}
+    for rank, (status, payload) in results.items():
+        assert status == "ok", f"rank {rank}: {payload}"
+        assert payload["pinned"], payload
+        payloads[rank] = payload
+    # the agreement criterion: identical pinned parameters on all ranks
+    assert payloads[0]["cycle_ms"] == payloads[1]["cycle_ms"], payloads
+    assert payloads[0]["threshold"] == payloads[1]["threshold"], payloads
+    assert payloads[0]["cycle_ms"] in (0.25, 0.5, 1.0, 2.5, 5.0)
+    assert payloads[0]["threshold"] >= 1 << 20
+
+
 # ---------------------------------------------------------- single process
 
 
