@@ -61,6 +61,7 @@ from .core.process_sets import (  # noqa: F401
 from .ops import (  # noqa: F401
     Adasum,
     Average,
+    IndexedSlices,
     Max,
     Min,
     Product,
@@ -75,6 +76,7 @@ from .ops import (  # noqa: F401
     barrier,
     broadcast,
     broadcast_async,
+    dense_to_sparse,
     grouped_allgather,
     grouped_allreduce,
     grouped_allreduce_async,
@@ -84,6 +86,8 @@ from .ops import (  # noqa: F401
     poll,
     reducescatter,
     reducescatter_async,
+    sparse_allreduce,
+    sparse_to_dense,
     synchronize,
 )
 from .optim import (  # noqa: F401

@@ -585,12 +585,14 @@ def _eager_collective(op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
 # public API — allreduce family
 # ---------------------------------------------------------------------------
 
-def _dispatch(tensor, spmd_fn, eager_fn, axes):
+def _dispatch(tensor, spmd_fn, eager_fn, axes, is_leaf=None):
     """Route to SPMD form when the dp axis is bound, else eager form."""
     live = _bound_axes(axes)
     if live:
-        return jax.tree_util.tree_map(lambda x: spmd_fn(x, live), tensor)
-    return jax.tree_util.tree_map(eager_fn, tensor)
+        return jax.tree_util.tree_map(
+            lambda x: spmd_fn(x, live), tensor, is_leaf=is_leaf
+        )
+    return jax.tree_util.tree_map(eager_fn, tensor, is_leaf=is_leaf)
 
 
 def allreduce(
@@ -615,26 +617,46 @@ def allreduce(
         op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
     elif average is not None:
         raise ValueError("specify either average= or op=, not both")
+    from .sparse import IndexedSlices, sparse_allreduce
+
+    _is_sparse_leaf = lambda x: isinstance(x, IndexedSlices)  # noqa: E731
+
+    if isinstance(tensor, IndexedSlices):
+        # sparse gradients reduce by gathering slices from all ranks
+        # (reference tensorflow/__init__.py:56)
+        return sparse_allreduce(
+            tensor, op=op, name=name, process_set=process_set,
+            axis_name=axis_name,
+        )
     if op == ReduceOp.ADASUM:
         from .adasum import adasum_allreduce
+
+        def _adasum_leaf_guard(x):
+            if isinstance(x, IndexedSlices):
+                raise ValueError(
+                    "adasum does not support sparse (IndexedSlices) "
+                    "gradients; use op=Average/Sum"
+                )
+            return x
 
         axes = _resolve_axis(axis_name)
         live = _bound_axes(axes)
         if live:
             return jax.tree_util.tree_map(
                 lambda x: adasum_allreduce(
-                    x, live[0], process_set=process_set
+                    _adasum_leaf_guard(x), live[0], process_set=process_set
                 ),
-                tensor,
+                tensor, is_leaf=_is_sparse_leaf,
             )
         if global_state().eager_runtime is not None:
             # negotiated path: real multi-process adasum via the executor
             return jax.tree_util.tree_map(
                 lambda x: _eager_collective(
-                    "allreduce", x, op, prescale_factor, postscale_factor,
+                    "allreduce", _adasum_leaf_guard(x), op,
+                    prescale_factor, postscale_factor,
                     process_set=process_set, name=name,
                 ),
-                tensor,
+                tensor, is_leaf=_is_sparse_leaf,
             )
         # eager single-controller: identical tensors ⇒ adasum(a,a) == a
         return tensor
@@ -642,7 +664,12 @@ def allreduce(
     axes = _resolve_axis(axis_name)
     ps = process_set
 
+    # nested IndexedSlices are leaves, never flattened — tree_map over a
+    # NamedTuple would otherwise average the int32 indices across ranks
     def spmd(x, live):
+        if isinstance(x, IndexedSlices):
+            return sparse_allreduce(x, op=op, process_set=ps,
+                                    axis_name=axis_name)
         return _spmd_allreduce_leaf(
             x, op, live, ps, prescale_factor, postscale_factor
         )
@@ -650,12 +677,16 @@ def allreduce(
     namer = _leaf_namer(name)
 
     def eager(x):
+        leaf_name = namer()
+        if isinstance(x, IndexedSlices):
+            return sparse_allreduce(x, op=op, name=leaf_name,
+                                    process_set=ps, axis_name=axis_name)
         return _eager_collective(
             "allreduce", x, op, prescale_factor, postscale_factor,
-            process_set=ps, name=namer(),
+            process_set=ps, name=leaf_name,
         )
 
-    return _dispatch(tensor, spmd, eager, axes)
+    return _dispatch(tensor, spmd, eager, axes, is_leaf=_is_sparse_leaf)
 
 
 def grouped_allreduce(
@@ -677,10 +708,10 @@ def grouped_allreduce(
     collective per bucket, then unpacked. See ops/fusion.py.
     """
     from .fusion import fuse_apply
+    from .sparse import IndexedSlices
 
     if op is None:
         op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
-    del name
 
     def reducer(flat_bucket):
         return allreduce(
@@ -696,7 +727,32 @@ def grouped_allreduce(
             axis_name=axis_name,
         )
 
-    return fuse_apply(list(tensors), reducer)
+    tensors = list(tensors)
+    # IndexedSlices members can't ride the fusion buffer (their indices
+    # and static dense_shape would be summed as data); route each through
+    # the sparse path, fuse only the dense members (reference
+    # tensorflow/__init__.py:249 handles grouped IndexedSlices the same
+    # way: per-member allgathers)
+    sparse_idx = [
+        i for i, t in enumerate(tensors) if isinstance(t, IndexedSlices)
+    ]
+    results: list = [None] * len(tensors)
+    namer = _leaf_namer(name)
+    dense_idx = []
+    for i, t in enumerate(tensors):
+        leaf_name = namer()
+        if i in sparse_idx:
+            results[i] = allreduce(
+                t, op=op, name=leaf_name, process_set=process_set,
+                axis_name=axis_name,
+            )
+        else:
+            dense_idx.append(i)
+    if dense_idx:
+        dense_out = fuse_apply([tensors[i] for i in dense_idx], reducer)
+        for i, r in zip(dense_idx, dense_out):
+            results[i] = r
+    return results
 
 
 def _group_size(ps: Optional[ProcessSet], axis_name) -> int:

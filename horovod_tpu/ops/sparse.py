@@ -1,0 +1,89 @@
+"""Sparse (IndexedSlices-style) gradient allreduce.
+
+Reference: TF turns an allreduce of `tf.IndexedSlices` into an allgather
+of values+indices (/root/reference/horovod/tensorflow/__init__.py:56 —
+"sparse gradients are aggregated by gathering slices from all ranks"),
+and torch exposes `sparse_allreduce_async` for COO tensors
+(/root/reference/horovod/torch/mpi_ops.py:556). The result keeps
+duplicate indices (it is a sparse SUM of per-rank slices, not a
+densified tensor); averaging scales values by 1/world.
+
+TPU-native shape: the gather is the framework's allgather —
+one XLA all-gather HLO inside shard_map (uniform slice counts, the SPMD
+norm), or the negotiated ragged allgather in the native eager runtime
+when per-rank nnz differ. Densification (`sparse_to_dense`) is a single
+scatter-add, which XLA lowers efficiently.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import collectives
+from .collectives import ReduceOp
+
+
+class IndexedSlices(NamedTuple):
+    """A sparse slab of a dense tensor: `values[k]` is the slice of the
+    dense tensor at first-dim index `indices[k]` (the TF IndexedSlices /
+    torch-COO-on-dim-0 model the reference handles)."""
+
+    values: Any           # [nnz, ...] slice values
+    indices: Any          # [nnz] int32/int64 first-dim indices
+    dense_shape: Tuple[int, ...]
+
+
+def sparse_allreduce(
+    slices: IndexedSlices,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    name: Optional[str] = None,
+    process_set=None,
+    axis_name=None,
+) -> IndexedSlices:
+    """All-reduce an IndexedSlices: gather every rank's (values, indices)
+    and scale for averaging. Duplicate indices remain — downstream
+    scatter-add (or a sparse optimizer) resolves them, exactly like the
+    reference's gathered IndexedSlices.
+    """
+    if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        raise ValueError(
+            "sparse allreduce supports Average and Sum "
+            "(reference tensorflow/__init__.py:56)"
+        )
+    values = collectives.allgather(
+        slices.values, name=None if name is None else f"{name}.values",
+        process_set=process_set, axis_name=axis_name,
+    )
+    indices = collectives.allgather(
+        slices.indices, name=None if name is None else f"{name}.indices",
+        process_set=process_set, axis_name=axis_name,
+    )
+    if op == ReduceOp.AVERAGE:
+        n = collectives._group_size(process_set, axis_name)
+        values = (values / n).astype(slices.values.dtype)
+    return IndexedSlices(values, indices, tuple(slices.dense_shape))
+
+
+def sparse_to_dense(slices: IndexedSlices):
+    """Densify by scatter-add (duplicate indices accumulate)."""
+    z = jnp.zeros(slices.dense_shape, dtype=slices.values.dtype)
+    return z.at[slices.indices].add(slices.values)
+
+
+def dense_to_sparse(grad, threshold: float = 0.0) -> IndexedSlices:
+    """Extract the non-zero rows of a dense gradient as IndexedSlices —
+    the embedding-gradient shape. Row selection is data-dependent, so
+    this is an eager/host-side helper (jit-side code should build
+    IndexedSlices directly from the known token ids)."""
+    import numpy as np
+
+    g = jax.device_get(grad)
+    row_mass = np.abs(g).reshape(g.shape[0], -1).max(axis=1)
+    idx = np.nonzero(row_mass > threshold)[0]
+    return IndexedSlices(
+        jnp.asarray(g[idx]), jnp.asarray(idx.astype(np.int32)),
+        tuple(g.shape),
+    )

@@ -71,13 +71,30 @@ def _to_torch(a, like):
 
 # ---------------------------------------------------------------------------
 # handle-based async op family (reference torch/mpi_ops.py:107-1290).
-# Execution is dispatched immediately (XLA's dispatch is itself async);
-# handles exist for API parity: poll() is always true once the result
-# materializes, synchronize() fetches it.
+#
+# Two regimes, mirroring ops/collectives.py's handle layer:
+#   * single-controller: execution is dispatched immediately (XLA's
+#     dispatch is itself async) and the handle wraps the finished value —
+#     poll() is True because the op IS complete.
+#   * native runtime: async ops enqueue into the background negotiation
+#     runtime WITHOUT blocking (submitting then waiting per-op would
+#     deadlock peers that enqueue in a different order); poll() asks the
+#     runtime, synchronize() collects and converts.
 # ---------------------------------------------------------------------------
 
 _handles: Dict[int, Any] = {}
 _next_handle = [1]
+
+
+class _Pending:
+    """A native-runtime handle plus the torch-side conversion recipe."""
+
+    def __init__(self, chandle: int, like, inplace_target=None,
+                 grouped_likes=None):
+        self.chandle = chandle
+        self.like = like
+        self.inplace_target = inplace_target
+        self.grouped_likes = grouped_likes
 
 
 def _register(result) -> int:
@@ -88,14 +105,53 @@ def _register(result) -> int:
 
 
 def poll(handle: int) -> bool:
-    return handle in _handles
+    """True when the op has completed (reference torch/mpi_ops.py:1210 —
+    completion, not mere existence)."""
+    if handle not in _handles:
+        raise ValueError(f"unknown handle {handle}")
+    v = _handles[handle]
+    if isinstance(v, _Pending):
+        return _c.poll(v.chandle)
+    return True  # already-materialized value
 
 
 def synchronize(handle: int):
     try:
-        return _handles.pop(handle)
+        v = _handles.pop(handle)
     except KeyError:
         raise ValueError(f"unknown handle {handle}")
+    if not isinstance(v, _Pending):
+        return v
+    out = _c.synchronize(v.chandle)
+    if v.grouped_likes is not None:
+        return [
+            _to_torch(np.asarray(o), t)
+            for o, t in zip(out, v.grouped_likes)
+        ]
+    t = _to_torch(np.asarray(out), v.like)
+    if v.inplace_target is not None:
+        v.inplace_target.copy_(t)
+        return v.inplace_target
+    return t
+
+
+def _native_async_active(process_set=None) -> bool:
+    return _c._native_rt_for_async(process_set) is not None
+
+
+def _maybe_native_async(c_async_fn, like, inplace=None, grouped_likes=None,
+                        process_set=None, **kw):
+    """Route an async op through the non-blocking native enqueue when the
+    runtime is active; None = caller falls back to immediate dispatch.
+    One place encodes the routing so the seven torch wrappers cannot
+    diverge from the ops layer."""
+    if not _native_async_active(process_set):
+        return None
+    h = c_async_fn(process_set=process_set, **kw)
+    return _register(
+        _Pending(h, like, inplace_target=inplace,
+                 grouped_likes=grouped_likes)
+    )
 
 
 def _run(op_fn, tensor, *args, **kwargs):
@@ -130,6 +186,13 @@ def _to_torch_dtype(t, like):
 def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0,
                     process_set=None):
+    h = _maybe_native_async(
+        _c.allreduce_async, tensor, process_set=process_set,
+        tensor=_to_np(tensor), average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    )
+    if h is not None:
+        return h
     return _register(
         allreduce(tensor, average=average, name=name, op=op,
                   prescale_factor=prescale_factor,
@@ -151,6 +214,14 @@ def allreduce_(tensor, average=None, name=None, op=None,
 def allreduce_async_(tensor, average=None, name=None, op=None,
                      prescale_factor=1.0, postscale_factor=1.0,
                      process_set=None):
+    h = _maybe_native_async(
+        _c.allreduce_async, tensor, inplace=tensor,
+        process_set=process_set, tensor=_to_np(tensor), average=average,
+        name=name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+    )
+    if h is not None:
+        return h
     allreduce_(tensor, average=average, name=name, op=op,
                prescale_factor=prescale_factor,
                postscale_factor=postscale_factor, process_set=process_set)
@@ -168,6 +239,13 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
 
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
                             process_set=None):
+    h = _maybe_native_async(
+        _c.grouped_allreduce_async, None, grouped_likes=list(tensors),
+        process_set=process_set, tensors=[_to_np(t) for t in tensors],
+        average=average, name=name, op=op,
+    )
+    if h is not None:
+        return h
     return _register(
         grouped_allreduce(tensors, average=average, name=name, op=op,
                           process_set=process_set)
@@ -181,6 +259,12 @@ def allgather(tensor, name=None, process_set=None):
 
 
 def allgather_async(tensor, name=None, process_set=None):
+    h = _maybe_native_async(
+        _c.allgather_async, tensor, process_set=process_set,
+        tensor=_to_np(tensor), name=name,
+    )
+    if h is not None:
+        return h
     return _register(allgather(tensor, name=name, process_set=process_set))
 
 
@@ -190,6 +274,12 @@ def broadcast(tensor, root_rank: int = 0, name=None, process_set=None):
 
 
 def broadcast_async(tensor, root_rank: int = 0, name=None, process_set=None):
+    h = _maybe_native_async(
+        _c.broadcast_async, tensor, process_set=process_set,
+        tensor=_to_np(tensor), root_rank=root_rank, name=name,
+    )
+    if h is not None:
+        return h
     return _register(
         broadcast(tensor, root_rank=root_rank, name=name,
                   process_set=process_set)
@@ -204,17 +294,39 @@ def broadcast_(tensor, root_rank: int = 0, name=None, process_set=None):
 
 def broadcast_async_(tensor, root_rank: int = 0, name=None,
                      process_set=None):
+    h = _maybe_native_async(
+        _c.broadcast_async, tensor, inplace=tensor,
+        process_set=process_set, tensor=_to_np(tensor),
+        root_rank=root_rank, name=name,
+    )
+    if h is not None:
+        return h
     broadcast_(tensor, root_rank=root_rank, name=name,
                process_set=process_set)
     return _register(tensor)
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):
-    return _run(_c.alltoall, tensor, splits=splits, name=name,
-                process_set=process_set)
+    torch = _torch()
+    out = _c.alltoall(_to_np(tensor), splits=splits, name=name,
+                      process_set=process_set)
+    if isinstance(out, tuple):
+        # with splits the reference returns (output, received_splits)
+        recv = torch.from_numpy(
+            np.asarray(out[1]).astype(np.int64)
+        )
+        return _to_torch(np.asarray(out[0]), tensor), recv
+    return _to_torch(np.asarray(out), tensor)
 
 
 def alltoall_async(tensor, splits=None, name=None, process_set=None):
+    if splits is None:
+        h = _maybe_native_async(
+            _c.alltoall_async, tensor, process_set=process_set,
+            tensor=_to_np(tensor), name=name,
+        )
+        if h is not None:
+            return h
     return _register(alltoall(tensor, splits=splits, name=name,
                               process_set=process_set))
 
@@ -225,8 +337,60 @@ def reducescatter(tensor, op=None, name=None, process_set=None):
 
 
 def reducescatter_async(tensor, op=None, name=None, process_set=None):
+    h = _maybe_native_async(
+        _c.reducescatter_async, tensor, process_set=process_set,
+        tensor=_to_np(tensor), name=name,
+        **({} if op is None else {"op": op}),
+    )
+    if h is not None:
+        return h
     return _register(reducescatter(tensor, op=op, name=name,
                                    process_set=process_set))
+
+
+# -- sparse allreduce (reference torch/mpi_ops.py:556) ----------------------
+
+def sparse_allreduce_async(tensor, name=None, op=None, process_set=None):
+    """All-reduce a torch sparse COO tensor: gather every rank's
+    (indices, values) and average — the reference's
+    sparse_allreduce_async. The result keeps duplicate indices; call
+    .coalesce() to merge them. Only dim-0 sparsity (embedding-gradient
+    shape) is supported, matching IndexedSlices semantics."""
+    torch = _torch()
+    if op is None:
+        op = Average
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce_async requires a sparse tensor")
+    st = tensor.coalesce()
+    idx = st.indices()  # [ndim, nnz]
+    if idx.shape[0] != 1:
+        # general COO → dim-0 slices: treat trailing dims as dense rows
+        raise ValueError(
+            "only dim-0 sparse tensors are supported (IndexedSlices "
+            "layout); densify other sparsity patterns first"
+        )
+    from ..ops.sparse import IndexedSlices, sparse_allreduce
+
+    slices = IndexedSlices(
+        values=_to_np(st.values()),
+        indices=_to_np(idx[0]),
+        dense_shape=tuple(st.shape),
+    )
+    red = sparse_allreduce(slices, op=op, name=name,
+                           process_set=process_set)
+    out = torch.sparse_coo_tensor(
+        _to_torch(np.asarray(red.indices), idx)[None].to(torch.int64),
+        _to_torch(np.asarray(red.values), st.values()),
+        size=tuple(st.shape),
+    )
+    return _register(out)
+
+
+def sparse_allreduce(tensor, name=None, op=None, process_set=None):
+    return synchronize(
+        sparse_allreduce_async(tensor, name=name, op=op,
+                               process_set=process_set)
+    )
 
 
 def join(device=-1) -> int:
@@ -390,6 +554,16 @@ class _DistributedOptimizer:
     def _allreduce_grad_async(self, p):
         name = self._name_of.get(p, "grad")
         grad = p.grad
+        if grad.is_sparse:
+            # sparse embedding gradients take the gathered-slices path,
+            # uncompressed (reference optimizer.py:189 →
+            # mpi_ops.py:556 sparse_allreduce_async)
+            return synchronize(
+                sparse_allreduce_async(
+                    grad, name=f"grad.{name}", op=self._op,
+                    process_set=self._process_set,
+                )
+            )
         if self._predivide != 1.0:
             grad = grad / self._predivide
         compressed, ctx = self._compression.compress(grad)
@@ -403,7 +577,12 @@ class _DistributedOptimizer:
 
     def synchronize(self) -> None:
         for p, result in self._pending.items():
-            p.grad.copy_(result.to(p.grad.dtype))
+            if result.is_sparse:
+                # nnz differs from the local gradient's: rebind rather
+                # than copy_ into the old layout
+                p.grad = result.to(p.grad.dtype)
+            else:
+                p.grad.copy_(result.to(p.grad.dtype))
         self._pending.clear()
 
     def step(self, closure=None):

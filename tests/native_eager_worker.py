@@ -112,6 +112,24 @@ def main():
         and [int(x) for x in np.asarray(recv_splits)] == [rank + 1] * size
     )
 
+    # 6a. sparse allreduce with rank-distinct nnz: values+indices ride
+    # the negotiated ragged allgather (reference tensorflow/__init__.py:56)
+    from horovod_tpu.ops.sparse import IndexedSlices, sparse_to_dense
+
+    V, D = 12, 2
+    nnz = rank + 1
+    ids = np.arange(nnz, dtype=np.int32) * 2 + rank
+    vals = np.full((nnz, D), float(rank + 1), dtype=np.float32)
+    red = hvd.sparse_allreduce(
+        IndexedSlices(vals, ids, (V, D)), op=hvd.Sum, name="emb"
+    )
+    dense = np.asarray(sparse_to_dense(red))
+    expect_dense = np.zeros((V, D), np.float32)
+    for r in range(size):
+        for k in range(r + 1):
+            expect_dense[k * 2 + r] += r + 1
+    out["sparse_ok"] = bool(np.allclose(dense, expect_dense))
+
     # 6b. grouped allreduce: members enqueue under one group tag; the
     # controller releases them all-or-nothing and fuses them into one
     # batch (reference group_table.h:25 + FuseResponses)
