@@ -477,6 +477,27 @@ _NATIVE_OPS = {
 }
 
 
+def _contains_indexed_slices(tensor) -> bool:
+    from .sparse import IndexedSlices
+
+    leaves = jax.tree_util.tree_leaves(
+        tensor, is_leaf=lambda x: isinstance(x, IndexedSlices)
+    )
+    return any(isinstance(l, IndexedSlices) for l in leaves)
+
+
+def _reject_indexed_slices(tensor, op_name: str) -> None:
+    """Ops without sparse semantics must fail loudly at the call site —
+    tree-flattening an IndexedSlices would run collectives over its
+    int indices and static dense_shape and return corrupt slices."""
+    if _contains_indexed_slices(tensor):
+        raise TypeError(
+            f"{op_name} does not accept IndexedSlices; sparse tensors "
+            "reduce via allreduce/sparse_allreduce "
+            "(reference tensorflow/__init__.py:56)"
+        )
+
+
 def _leaf_namer(name):
     """Per-leaf names for pytree ops: the first leaf keeps the user name,
     later leaves get `.k` suffixes (deterministic pytree order keeps the
@@ -733,15 +754,12 @@ def grouped_allreduce(
     # the sparse path, fuse only the dense members (reference
     # tensorflow/__init__.py:249 handles grouped IndexedSlices the same
     # way: per-member allgathers)
-    sparse_idx = [
-        i for i, t in enumerate(tensors) if isinstance(t, IndexedSlices)
-    ]
     results: list = [None] * len(tensors)
     namer = _leaf_namer(name)
     dense_idx = []
     for i, t in enumerate(tensors):
         leaf_name = namer()
-        if i in sparse_idx:
+        if isinstance(t, IndexedSlices):
             results[i] = allreduce(
                 t, op=op, name=leaf_name, process_set=process_set,
                 axis_name=axis_name,
@@ -775,6 +793,7 @@ def allgather(
     (torch/mpi_ops.py:752 allgather). SPMD shapes are rank-uniform by
     construction; ragged first dims are an eager-runtime feature
     (ops/eager_runtime.py)."""
+    _reject_indexed_slices(tensor, "allgather")
     axes = _resolve_axis(axis_name)
     ps = process_set
     namer = _leaf_namer(name)
@@ -799,6 +818,7 @@ def broadcast(
     """Broadcast root_rank's tensor to every rank
     (torch/mpi_ops.py:858). root_rank is a *global* rank, also for process
     sets (matching the reference's semantics)."""
+    _reject_indexed_slices(tensor, "broadcast")
     axes = _resolve_axis(axis_name)
     ps = process_set
     if ps is not None and ps.process_set_id != 0 and root_rank not in ps.ranks:
@@ -828,6 +848,7 @@ def reducescatter(
 ):
     """Reduce then scatter chunks of dim 0 (torch/mpi_ops.py:1022);
     rank i receives chunk i. Default op is Average like the reference."""
+    _reject_indexed_slices(tensor, "reducescatter")
     axes = _resolve_axis(axis_name)
     ps = process_set
     namer = _leaf_namer(name)
@@ -874,6 +895,7 @@ def alltoall(
     Returns the exchanged tensor; with `splits` also returns
     received_splits, matching the reference's (output, received_splits).
     """
+    _reject_indexed_slices(tensor, "alltoall")
     axes = _resolve_axis(axis_name)
     ps = process_set
 
@@ -1067,6 +1089,12 @@ def _native_rt_for_async(process_set=None):
 def _native_async(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
                   postscale=1.0, root_rank=0, name=None,
                   splits=None, grouped=False) -> int:
+    # The negotiated wire path is dense-only; flattening an
+    # IndexedSlices here would enqueue its int indices and dense_shape
+    # as independent collectives. Sparse allreduce_async falls back to
+    # the sync sparse path before reaching this point; everything else
+    # must fail loudly.
+    _reject_indexed_slices(tensor, f"native async {op_kind}")
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
     namer = _leaf_namer(name)
     names = [namer() or _auto_name(op_kind) for _ in leaves]
@@ -1106,7 +1134,11 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     elif average is not None:
         raise ValueError("specify either average= or op=, not both")
     rt = _native_rt_for_async(process_set)
-    if rt is not None:
+    # IndexedSlices reduce via the gather-based sparse path (reference
+    # torch/mpi_ops.py:556 sparse_allreduce_async), which the sync
+    # allreduce() already routes; the native dense wire path can't
+    # carry them.
+    if rt is not None and not _contains_indexed_slices(tensor):
         return _native_async(
             rt, "allreduce", tensor, op, prescale_factor,
             postscale_factor, name=name,
@@ -1169,15 +1201,16 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         op = ReduceOp.AVERAGE if (average is None or average) else ReduceOp.SUM
     elif average is not None:
         raise ValueError("specify either average= or op=, not both")
+    tensors = list(tensors)
     rt = _native_rt_for_async(process_set)
-    if rt is not None:
+    if rt is not None and not _contains_indexed_slices(tensors):
         # one enqueue per tensor, tagged as a group: the controller holds
         # all members until every one is globally ready (all-or-nothing,
         # group_table.h:25) and FuseResponses packs them into fused
         # batches — the real runtime fusion path, not the compile-time
         # bucketing of ops/fusion.py
         return _native_async(
-            rt, "allreduce", list(tensors), op, prescale_factor,
+            rt, "allreduce", tensors, op, prescale_factor,
             postscale_factor, name=name, grouped=True,
         )
     return _async(grouped_allreduce, tensors, op=op, name=name,

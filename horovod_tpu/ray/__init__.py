@@ -12,9 +12,12 @@ Import is gated: ray is an optional dependency.
 from __future__ import annotations
 
 import socket
+import time
+import traceback
 from typing import Any, Callable, List, Optional
 
 from ..runner.util.hosts import HostInfo, get_host_assignments
+from ..utils.logging import LOGGER
 
 
 def _require_ray():
@@ -110,11 +113,186 @@ class RayExecutor:
         self._workers = []
 
 
+class RayHostDiscovery:
+    """Host discovery backed by Ray cluster state (reference
+    ray/elastic.py:39): every alive node contributes
+    floor(CPU / cpus_per_slot) slots (bounded by GPUs when use_gpu).
+    Plugs into runner/elastic/discovery.HostManager unchanged."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = max(1, cpus_per_slot)
+        self.gpus_per_slot = max(1, gpus_per_slot)
+
+    def find_available_hosts_and_slots(self) -> dict:
+        ray = _require_ray()
+        hosts: dict = {}
+        for node in ray.nodes():
+            if not node.get("Alive"):
+                continue
+            res = node.get("Resources", {}) or {}
+            slots = int(res.get("CPU", 0) // self.cpus_per_slot)
+            if self.use_gpu:
+                slots = min(
+                    slots, int(res.get("GPU", 0) // self.gpus_per_slot)
+                )
+            # keyed by node IP: Ray's built-in `node:<ip>` resource pins
+            # actors to it without node-id plumbing
+            addr = node.get("NodeManagerAddress") or node.get(
+                "NodeManagerHostname"
+            )
+            if slots > 0 and addr:
+                hosts[addr] = hosts.get(addr, 0) + slots
+        return hosts
+
+
+# One remote-class export per process, not per slot per round: Ray
+# pickles and registers every @ray.remote class with the GCS, so
+# defining it inside _execute_slot would re-export identical bytes for
+# each slot of each elastic round.
+_SLOT_WORKER_CLS = None
+
+
+def _slot_worker_cls(ray):
+    global _SLOT_WORKER_CLS
+    if _SLOT_WORKER_CLS is None:
+        class _SlotWorker:
+            def ping(self):
+                # scheduling probe: resolves as soon as the actor is
+                # placed and running on its node
+                return True
+
+            def execute(self, fn, env, args, kwargs):
+                import os
+
+                os.environ.update({k: str(v) for k, v in env.items()})
+                return fn(*args, **kwargs)
+
+        _SLOT_WORKER_CLS = ray.remote(max_restarts=0)(_SlotWorker)
+    return _SLOT_WORKER_CLS
+
+
 class ElasticRayExecutor:
-    def __init__(self, *a, **kw):
-        _require_ray()
-        raise NotImplementedError(
-            "elastic Ray jobs: plug RayHostDiscovery (ray cluster state) "
-            "into horovod_tpu.runner.elastic.HostManager (reference "
-            "ray/elastic.py:39 maps onto runner/elastic/discovery.py)"
+    """Elastic training on a dynamic Ray cluster (reference
+    ray/elastic.py:150): the elastic driver's discovery is Ray cluster
+    state, its slots are Ray actors pinned to the discovered nodes, and
+    failed nodes are blacklisted while training resumes on the rest."""
+
+    def __init__(self, min_np: int = 1, max_np: Optional[int] = None,
+                 cpus_per_slot: int = 1, use_gpu: bool = False,
+                 override_discovery=None, env: Optional[dict] = None,
+                 elastic_timeout_s: float = 600.0, reset_limit: int = 0):
+        self._ray = _require_ray()
+        self._discovery = override_discovery or RayHostDiscovery(
+            use_gpu=use_gpu, cpus_per_slot=cpus_per_slot
         )
+        self._min_np = min_np
+        self._max_np = max_np  # None = unbounded (scale to the cluster)
+        self._env = dict(env or {})
+        self._timeout_s = elastic_timeout_s
+        self._reset_limit = reset_limit
+        self._host_manager = None
+        self._results: dict = {}
+        self._last_error: Optional[BaseException] = None
+
+    def start(self) -> None:
+        from ..runner.elastic.discovery import HostManager
+
+        self._host_manager = HostManager(self._discovery)
+
+    def _execute_slot(self, fn, args, kwargs, env, slot, events):
+        """Run `fn` in a Ray actor pinned to the slot's node; the round
+        abort event kills the actor (classified ABORTED, like a launcher
+        SIGTERM). Returns (exit_code, result_or_None)."""
+        ray = self._ray
+        # Scheduling deadline only: the node:<ip> pin is a resource no
+        # node may provide (e.g. discovery fell back to a hostname key,
+        # or the node died after discovery) — without a deadline the
+        # actor stays pending forever and the round barrier never
+        # completes. Once the ping resolves the actor is placed, and
+        # execution runs as long as training needs (a wall-clock cap on
+        # fn would kill every legitimately long job).
+        sched_deadline = time.monotonic() + self._timeout_s
+        scheduled = False
+        try:
+            actor = _slot_worker_cls(ray).options(
+                resources={f"node:{slot.hostname}": 0.001}
+            ).remote()
+            ping = actor.ping.remote()
+            ref = actor.execute.remote(fn, env, args, kwargs)
+            while True:
+                done, _ = ray.wait([ref], timeout=0.5)
+                if done:
+                    return 0, ray.get(done[0])
+                if events and any(e.is_set() for e in events):
+                    ray.kill(actor)
+                    # signal-like: round abort, not this slot's failure
+                    return -15, None
+                if not scheduled:
+                    pdone, _ = ray.wait([ping], timeout=0)
+                    if pdone:
+                        scheduled = True
+                    elif time.monotonic() > sched_deadline:
+                        ray.kill(actor)
+                        raise TimeoutError(
+                            f"slot {slot.rank}: no Ray node provides "
+                            f"node:{slot.hostname} after "
+                            f"{self._timeout_s}s — actor unschedulable"
+                        )
+        except Exception as e:
+            self._last_error = e
+            LOGGER.error(
+                "elastic Ray slot %d on %s failed:\n%s",
+                slot.rank, slot.hostname, traceback.format_exc(),
+            )
+            return 1, None
+
+    def run(self, fn: Callable, args: tuple = (),
+            kwargs: Optional[dict] = None) -> List[Any]:
+        from ..runner.elastic.driver import ElasticDriver
+        from ..runner.elastic.settings import ElasticSettings
+
+        if self._host_manager is None:
+            self.start()
+        kwargs = kwargs or {}
+        # results keyed by (round, rank): slots that finished inside a
+        # later-aborted round must not leak into the final return
+        self._results = {}
+        self._last_error = None
+
+        def exec_fn(command, env, slot, events):
+            # late binding: exec_fn only runs inside driver.run(), after
+            # `driver` below is bound
+            round_id = driver._registry.round
+            code, value = self._execute_slot(
+                fn, args, kwargs, env, slot, events
+            )
+            if code == 0:
+                self._results[(round_id, slot.rank)] = value
+            return code
+
+        driver = ElasticDriver(
+            self._host_manager,
+            ElasticSettings(
+                min_np=self._min_np, max_np=self._max_np,
+                timeout_s=self._timeout_s, reset_limit=self._reset_limit,
+            ),
+            command=["<ray-actor>"],  # exec_fn ignores it
+            env=self._env,
+            exec_fn=exec_fn,
+        )
+        rc = driver.run()
+        if rc != 0:
+            raise RuntimeError(
+                "elastic Ray job failed"
+            ) from self._last_error
+        final_round = driver._registry.round
+        final = {
+            rank: v for (rid, rank), v in self._results.items()
+            if rid == final_round
+        }
+        return [final[r] for r in sorted(final)]
+
+    def shutdown(self) -> None:
+        self._host_manager = None

@@ -92,9 +92,111 @@ def run(
     return [r for _, r in sorted(results)]
 
 
-def run_elastic(*a, **kw):
-    raise NotImplementedError(
-        "elastic Spark jobs: use hvdrun --host-discovery-script with a "
-        "script that queries the Spark cluster (reference "
-        "spark/runner.py:312 maps onto the elastic driver here)"
-    )
+def _cluster_parallelism(sc) -> int:
+    """Current schedulable slots reported by the Spark cluster."""
+    return max(1, int(sc.defaultParallelism))
+
+
+def run_elastic(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    num_proc: Optional[int] = None,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    reset_limit: int = 0,
+    elastic_timeout_s: float = 600.0,
+    extra_env: Optional[dict] = None,
+    verbose: int = 1,
+) -> List[Any]:
+    """Elastic training over a dynamic Spark cluster (reference
+    spark/runner.py:312 run_elastic).
+
+    The respawn-round model of this framework's elastic driver, at the
+    Spark level: each round is one barrier job sized to the slots the
+    cluster currently offers (clamped to [min_np, max_np]); a failed
+    round — lost executors, preempted nodes — re-sizes and re-runs. `fn`
+    should follow the elastic-state recipe (hvd.elastic.TpuState + commit)
+    so resumed rounds continue from committed state; Spark's own task
+    blacklisting keeps failing executors out of later rounds.
+    """
+    import time as _time
+
+    pyspark = _require_pyspark()
+    from pyspark.sql import SparkSession
+
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    if num_proc is None:
+        num_proc = _cluster_parallelism(sc)
+    min_np = min_np or 1
+    max_np = max_np or num_proc
+    kwargs = kwargs or {}
+
+    def _wait_for_min_slots() -> int:
+        """Block until the cluster offers >= min_np schedulable slots
+        (the driver-level wait_for_available_slots analog,
+        runner/elastic/driver.py) — submitting a barrier job wider than
+        the cluster fails at scheduling, which must read as "wait for
+        recovery", never as a deterministic failure."""
+        wait_start = _time.monotonic()
+        while True:
+            available = _cluster_parallelism(sc)
+            if available >= min_np:
+                return available
+            if _time.monotonic() - wait_start > elastic_timeout_s:
+                raise RuntimeError(
+                    f"cluster offered {available} < min_np={min_np} "
+                    f"slots for {elastic_timeout_s}s"
+                )
+            if verbose:
+                print(
+                    f"horovod_tpu.spark: waiting for >= {min_np} slots "
+                    f"(cluster offers {available})",
+                    flush=True,
+                )
+            _time.sleep(1.0)
+
+    resets = 0
+    fast_failures = 0
+    current = max(min_np, min(num_proc, max_np))
+    while True:
+        round_start = _time.monotonic()
+        try:
+            return run(
+                fn, args=args, kwargs=kwargs, num_proc=current,
+                extra_env=extra_env, verbose=verbose,
+            )
+        except Exception as e:
+            resets += 1
+            if reset_limit and resets >= reset_limit:
+                raise RuntimeError(
+                    f"elastic Spark job failed after {resets} resets"
+                ) from e
+            available = _wait_for_min_slots()
+            # A round that dies immediately is a deterministic failure
+            # (user bug, broken config), not an executor loss — elastic
+            # retries cannot fix it. Three in a row terminates even with
+            # an unlimited reset_limit, so a TypeError in fn can't
+            # resubmit barrier jobs forever. Only rounds the cluster
+            # could actually schedule count: if it shrank below what we
+            # submitted, the fast death was a scheduling/loss artifact.
+            if (_time.monotonic() - round_start < 5.0
+                    and available >= current):
+                fast_failures += 1
+                if fast_failures >= 3:
+                    raise RuntimeError(
+                        "elastic Spark job failed 3 consecutive rounds "
+                        "within seconds — the failure looks "
+                        "deterministic, not an executor loss"
+                    ) from e
+            else:
+                fast_failures = 0
+            current = max(min_np, min(available, max_np))
+            if verbose:
+                print(
+                    f"horovod_tpu.spark: round failed ({e}); retrying "
+                    f"with {current} slots",
+                    flush=True,
+                )
+            _time.sleep(1.0)  # backoff before resubmitting the round

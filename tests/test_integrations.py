@@ -1,6 +1,12 @@
-"""Spark/Ray gating + compute service registry."""
+"""Spark/Ray integrations: barrier/env logic with a mocked
+BarrierTaskContext (the reference's local-mode-Spark tier without the
+pyspark dependency), Ray discovery/elastic flow with a stubbed ray, and
+the compute service registry."""
 
+import os
+import sys
 import threading
+import types
 
 import pytest
 
@@ -9,6 +15,273 @@ from horovod_tpu.runner.compute_service import (
     ComputeService,
 )
 from horovod_tpu.runner.util.secret import make_secret_key
+
+
+# --------------------------------------------------------------- fake spark
+#
+# A minimal pyspark stand-in: barrier stage of N sequential partitions,
+# every task sees the same TaskInfos — enough to execute spark.run()'s
+# real rank/local/cross/env logic (reference test pattern: mock-heavy
+# test/single/test_run.py).
+
+
+@pytest.fixture(autouse=True)
+def _restore_environ():
+    """The fake barrier tasks run in-process, so spark.run()'s slot env
+    (HOROVOD_RANK, HVD_TPU_COORDINATOR_ADDRESS, ...) would leak into
+    this pytest process and make later tests' hvd.init() believe it is
+    one rank of a multi-process world. Real Spark sets these only in
+    executor processes; undo the in-process leak."""
+    saved = dict(os.environ)
+    yield
+    os.environ.clear()
+    os.environ.update(saved)
+
+class _FakeTaskInfo:
+    def __init__(self, address):
+        self.address = address
+
+
+class _FakeBarrierTaskContext:
+    _current = None
+
+    @classmethod
+    def get(cls):
+        return cls._current
+
+    def __init__(self, rank, addresses):
+        self._rank = rank
+        self._addresses = addresses
+        self.barrier_calls = 0
+
+    def partitionId(self):
+        return self._rank
+
+    def getTaskInfos(self):
+        return [_FakeTaskInfo(a) for a in self._addresses]
+
+    def barrier(self):
+        self.barrier_calls += 1
+
+
+class _FakeBarrierRDD:
+    def __init__(self, n, addresses):
+        self._n = n
+        self._addresses = addresses
+
+    def mapPartitions(self, task):
+        self._task = task
+        return self
+
+    def collect(self):
+        out = []
+        for rank in range(self._n):
+            ctx = _FakeBarrierTaskContext(rank, self._addresses)
+            _FakeBarrierTaskContext._current = ctx
+            out.extend(list(self._task(iter([rank]))))
+        return out
+
+
+class _FakeRDD:
+    def __init__(self, n, addresses):
+        self._n = n
+        self._addresses = addresses
+
+    def barrier(self):
+        return _FakeBarrierRDD(self._n, self._addresses)
+
+
+class _FakeSparkContext:
+    def __init__(self, addresses, default_parallelism):
+        self._addresses = addresses
+        self.defaultParallelism = default_parallelism
+
+    def parallelize(self, rng, n):
+        return _FakeRDD(n, self._addresses[:n])
+
+
+class _FakeSession:
+    class builder:  # noqa: N801 - mimics pyspark API
+        @staticmethod
+        def getOrCreate():
+            return _FakeSession._instance
+
+    _instance = None
+
+    def __init__(self, sc):
+        self.sparkContext = sc
+
+
+def _install_fake_pyspark(monkeypatch, addresses, default_parallelism=None):
+    sc = _FakeSparkContext(
+        addresses, default_parallelism or len(addresses)
+    )
+    _FakeSession._instance = _FakeSession(sc)
+    fake = types.ModuleType("pyspark")
+    fake.BarrierTaskContext = _FakeBarrierTaskContext
+    fake_sql = types.ModuleType("pyspark.sql")
+    fake_sql.SparkSession = _FakeSession
+    fake.sql = fake_sql
+    monkeypatch.setitem(sys.modules, "pyspark", fake)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", fake_sql)
+    return sc
+
+
+def _grab_env():
+    return {
+        k: os.environ[k]
+        for k in (
+            "HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+            "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
+            "HOROVOD_CROSS_SIZE", "HVD_TPU_COORDINATOR_ADDRESS",
+        )
+    }
+
+
+def test_spark_run_sets_slot_env(monkeypatch):
+    """spark.run's barrier/env logic: 4 tasks on 2 hosts -> correct
+    rank/local/cross assignment on every task (reference
+    spark/runner.py:200 + driver_service host math)."""
+    import horovod_tpu.spark as sp
+
+    _install_fake_pyspark(
+        monkeypatch,
+        ["h1:35001", "h1:35002", "h2:35001", "h2:35002"],
+    )
+    results = sp.run(_grab_env, num_proc=4)
+    assert len(results) == 4
+    for rank, env in enumerate(results):
+        assert env["HOROVOD_RANK"] == str(rank)
+        assert env["HOROVOD_SIZE"] == "4"
+        assert env["HVD_TPU_COORDINATOR_ADDRESS"].startswith("h1:")
+    # h1 carries ranks 0,1 (local 0,1); h2 carries 2,3
+    assert results[0]["HOROVOD_LOCAL_RANK"] == "0"
+    assert results[1]["HOROVOD_LOCAL_RANK"] == "1"
+    assert results[2]["HOROVOD_LOCAL_RANK"] == "0"
+    assert results[2]["HOROVOD_CROSS_RANK"] == "1"
+    assert results[0]["HOROVOD_CROSS_SIZE"] == "2"
+    assert results[0]["HOROVOD_LOCAL_SIZE"] == "2"
+
+
+def test_spark_run_elastic_retries_with_resized_world(monkeypatch):
+    """run_elastic: a failed round re-sizes to the cluster's current
+    parallelism and retries (reference spark/runner.py:312)."""
+    import horovod_tpu.spark as sp
+
+    sc = _install_fake_pyspark(
+        monkeypatch, ["h1:1", "h1:2", "h1:3", "h1:4"],
+        default_parallelism=4,
+    )
+    calls = []
+
+    def flaky():
+        size = int(os.environ["HOROVOD_SIZE"])
+        calls.append(size)
+        if size == 4:  # the 4-wide round loses an executor
+            raise RuntimeError("executor lost")
+        return int(os.environ["HOROVOD_RANK"])
+
+    sc.defaultParallelism = 2  # cluster shrinks between rounds
+    out = sp.run_elastic(flaky, num_proc=4, min_np=1, reset_limit=5)
+    assert out == [0, 1]
+    assert calls[0] == 4 and calls[-1] == 2
+
+
+def test_spark_run_elastic_waits_for_cluster_recovery(monkeypatch):
+    """A cluster temporarily below min_np must read as 'wait for
+    recovery', never as a deterministic fast failure: the retry loop
+    polls until >= min_np slots are offered, then resizes to them."""
+    import horovod_tpu.spark as sp
+
+    _install_fake_pyspark(
+        monkeypatch, ["h1:1", "h1:2", "h1:3", "h1:4"],
+        default_parallelism=4,
+    )
+    calls = []
+
+    def flaky():
+        size = int(os.environ["HOROVOD_SIZE"])
+        calls.append(size)
+        if size == 4:
+            raise RuntimeError("lost executors")
+        return size
+
+    # after the failure the cluster reports 1 slot (< min_np) twice,
+    # then recovers to 3
+    seq = [1, 1, 3]
+    monkeypatch.setattr(
+        sp, "_cluster_parallelism",
+        lambda sc: seq.pop(0) if len(seq) > 1 else seq[0],
+    )
+    out = sp.run_elastic(flaky, num_proc=4, min_np=2, reset_limit=5)
+    assert out == [3, 3, 3]
+    assert calls[0] == 4 and calls[-1] == 3
+
+
+def test_spark_run_elastic_respects_reset_limit(monkeypatch):
+    import horovod_tpu.spark as sp
+
+    _install_fake_pyspark(monkeypatch, ["h1:1", "h1:2"])
+
+    def always_fail():
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="after 2 resets"):
+        sp.run_elastic(always_fail, num_proc=2, reset_limit=2)
+
+
+# ----------------------------------------------------------------- fake ray
+
+
+def _install_fake_ray(monkeypatch, nodes):
+    fake = types.ModuleType("ray")
+    fake.nodes = lambda: nodes
+    monkeypatch.setitem(sys.modules, "ray", fake)
+    return fake
+
+
+def test_ray_host_discovery_parses_cluster_state(monkeypatch):
+    from horovod_tpu.ray import RayHostDiscovery
+
+    _install_fake_ray(monkeypatch, [
+        {"Alive": True, "NodeManagerAddress": "10.0.0.1",
+         "Resources": {"CPU": 4.0, "GPU": 2.0}},
+        {"Alive": True, "NodeManagerAddress": "10.0.0.2",
+         "Resources": {"CPU": 2.0}},
+        {"Alive": False, "NodeManagerAddress": "10.0.0.3",
+         "Resources": {"CPU": 8.0}},
+    ])
+    disc = RayHostDiscovery(cpus_per_slot=2)
+    assert disc.find_available_hosts_and_slots() == {
+        "10.0.0.1": 2, "10.0.0.2": 1,
+    }
+    gpu_disc = RayHostDiscovery(use_gpu=True, cpus_per_slot=1)
+    assert gpu_disc.find_available_hosts_and_slots() == {"10.0.0.1": 2}
+
+
+def test_elastic_ray_executor_runs_through_driver(monkeypatch):
+    """ElasticRayExecutor drives the real elastic driver; slot execution
+    is stubbed (no ray runtime) and records per-rank env."""
+    from horovod_tpu.ray import ElasticRayExecutor
+    from horovod_tpu.runner.elastic.discovery import FixedHosts
+
+    _install_fake_ray(monkeypatch, [])
+    ex = ElasticRayExecutor(
+        min_np=2, max_np=2,
+        override_discovery=FixedHosts({"10.0.0.1": 1, "10.0.0.2": 1}),
+    )
+    seen = {}
+
+    def fake_execute(fn, args, kwargs, env, slot, events):
+        seen[slot.rank] = (slot.hostname, env["HOROVOD_SIZE"])
+        return 0, fn(*args, **kwargs) + slot.rank
+
+    monkeypatch.setattr(ex, "_execute_slot", fake_execute)
+    out = ex.run(lambda: 100)
+    assert out == [100, 101]
+    assert sorted(seen) == [0, 1]
+    assert {h for h, _ in seen.values()} == {"10.0.0.1", "10.0.0.2"}
+    assert all(s == "2" for _, s in seen.values())
 
 
 def test_spark_gated_without_pyspark():

@@ -188,3 +188,38 @@ def test_torch_sparse_allreduce_matches_dense(hvd8):
     red = thvd.sparse_allreduce(st, name="emb.grad")
     out = red.coalesce().to_dense().numpy()
     np.testing.assert_allclose(out, dense, rtol=1e-5)
+
+
+def test_async_sparse_routing_with_native_runtime(hvd8):
+    """With the native eager runtime active, allreduce_async on an
+    IndexedSlices must route through the sparse path (the dense wire
+    format can't carry it), and non-sparse async ops must reject it
+    loudly instead of flattening indices into collectives."""
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.ops import collectives as C
+    from horovod_tpu.ops.eager_runtime import EagerRuntime
+
+    st = global_state()
+    rt = EagerRuntime(0, 1, cycle_ms=1.0, cache_capacity=8)
+    st.eager_runtime = rt
+    try:
+        ids, vals, dense = _embedding_grads(0)
+        slc = IndexedSlices(
+            values=jnp.asarray(vals), indices=jnp.asarray(ids),
+            dense_shape=(V, D),
+        )
+        # the native runtime is a world of 1, so the gathered slices are
+        # exactly this rank's contribution (routing through the sparse
+        # path, not the dense wire format, is what's under test)
+        h = C.allreduce_async(slc, op=C.ReduceOp.SUM, name="emb")
+        out = C.synchronize(h)
+        np.testing.assert_allclose(
+            np.asarray(sparse_to_dense(out)), dense, rtol=1e-5
+        )
+        for fn in (C.allgather_async, lambda t: C.broadcast_async(t, 0),
+                   C.reducescatter_async, C.alltoall_async):
+            with pytest.raises(TypeError, match="IndexedSlices"):
+                fn(slc)
+    finally:
+        st.eager_runtime = None
+        rt.shutdown()
