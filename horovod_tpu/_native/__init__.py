@@ -28,6 +28,8 @@ OP_REDUCESCATTER = 4
 OP_JOIN = 5
 OP_BARRIER = 6
 OP_ERROR = 7
+OP_REGISTER_SET = 8
+OP_DEREGISTER_SET = 9
 
 # DataType values (hvd/common.h)
 _NUMPY_TO_DTYPE = {
@@ -94,9 +96,19 @@ def load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_double, ctypes.c_double,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
-        ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
     ]
     lib.hvd_native_enqueue.restype = ctypes.c_longlong
+    lib.hvd_native_register_set.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+    ]
+    lib.hvd_native_register_set.restype = ctypes.c_longlong
+    lib.hvd_native_deregister_set.argtypes = [ctypes.c_int]
+    lib.hvd_native_deregister_set.restype = ctypes.c_longlong
+    lib.hvd_native_set_members.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+    ]
+    lib.hvd_native_set_members.restype = ctypes.c_int
     lib.hvd_native_join.restype = ctypes.c_longlong
     lib.hvd_native_barrier.restype = ctypes.c_longlong
     lib.hvd_native_poll.argtypes = [ctypes.c_longlong]
@@ -128,12 +140,15 @@ class ExecutionBatch:
     def __init__(self, batch_id, op, reduce_op, root_rank, prescale,
                  postscale, dtype, total_bytes, names, handles, first_shape,
                  error_reason, cycle=0, rank_dim0=(), all_splits=(),
-                 shapes=()):
+                 shapes=(), process_set_id=0, set_ranks=()):
         self.batch_id = batch_id
         self.cycle = cycle
-        self.rank_dim0 = list(rank_dim0)    # allgather: per-rank dim-0
-        self.all_splits = list(all_splits)  # alltoall: flattened matrix
+        self.rank_dim0 = list(rank_dim0)    # allgather: per-MEMBER dim-0
+        self.all_splits = list(all_splits)  # alltoall: set-local matrix
         self.shapes = [list(s) for s in shapes]  # per-tensor, ∥ names
+        self.process_set_id = process_set_id
+        # sorted global ranks of the op's process set; [] = global set
+        self.set_ranks = [int(r) for r in set_ranks]
         self.op = op
         self.reduce_op = reduce_op
         self.root_rank = root_rank
@@ -222,20 +237,52 @@ class NativeRuntime:
                 postscale: float = 1.0,
                 splits: Optional[Sequence[int]] = None,
                 group: Optional[str] = None,
-                group_size: int = 0) -> int:
+                group_size: int = 0,
+                process_set_id: int = 0) -> int:
         arr = (ctypes.c_longlong * len(shape))(*shape)
         sp = (ctypes.c_longlong * len(splits))(*splits) if splits else None
         h = self._lib.hvd_native_enqueue(
             name.encode(), op, _NUMPY_TO_DTYPE[dtype], arr, len(shape),
             reduce_op, root_rank, prescale, postscale,
             sp, len(splits) if splits else 0,
-            group.encode() if group else None, group_size,
+            group.encode() if group else None, group_size, process_set_id,
         )
         if h < 0:
             raise RuntimeError(
                 f"enqueue failed: {self.last_error()}"
             )
         return h
+
+    def register_set(self, set_id: int, ranks: Sequence[int]) -> int:
+        """Negotiated process-set registration (all world ranks must call
+        with identical membership); returns a handle to wait on."""
+        arr = (ctypes.c_longlong * len(ranks))(*ranks)
+        h = self._lib.hvd_native_register_set(set_id, arr, len(ranks))
+        if h < 0:
+            raise RuntimeError(
+                f"register_set failed: {self.last_error()}"
+            )
+        return h
+
+    def deregister_set(self, set_id: int) -> int:
+        h = self._lib.hvd_native_deregister_set(set_id)
+        if h < 0:
+            raise RuntimeError(
+                f"deregister_set failed: {self.last_error()}"
+            )
+        return h
+
+    def set_members(self, set_id: int) -> Optional[List[int]]:
+        """Sorted global ranks of a registered set; None if unknown."""
+        cap = 4096
+        arr = (ctypes.c_longlong * cap)()
+        n = self._lib.hvd_native_set_members(set_id, arr, cap)
+        if n <= 0:
+            return None
+        if n > cap:  # world larger than cap: retry exact
+            arr = (ctypes.c_longlong * n)()
+            n = self._lib.hvd_native_set_members(set_id, arr, n)
+        return [int(arr[i]) for i in range(n)]
 
     def join(self) -> int:
         return self._lib.hvd_native_join()
@@ -280,11 +327,14 @@ class NativeRuntime:
         rank_dim0 = r.vec64()
         all_splits = r.vec64()
         shapes = [r.vec64() for _ in range(r.i32())]
+        process_set_id = r.i32()
+        set_ranks = r.vec64()
         return ExecutionBatch(batch_id, op, reduce_op, root_rank, prescale,
                               postscale, dtype, total_bytes, names, handles,
                               first_shape, error_reason, cycle=cycle,
                               rank_dim0=rank_dim0, all_splits=all_splits,
-                              shapes=shapes)
+                              shapes=shapes, process_set_id=process_set_id,
+                              set_ranks=set_ranks)
 
     def batch_done(self, batch: ExecutionBatch, ok: bool = True) -> None:
         arr = (ctypes.c_longlong * len(batch.handles))(*batch.handles)

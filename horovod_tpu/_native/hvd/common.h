@@ -61,6 +61,14 @@ enum class OpType : int32_t {
   kJoin = 5,
   kBarrier = 6,
   kError = 7,  // response-only: negotiation failure delivered to all ranks
+  // process-set registration (reference process_set.h:89 ProcessSetTable
+  // + process_sets.py:123 add_process_set): membership rides Request.shape,
+  // the set id rides Request.root_rank. Negotiated like any tensor — all
+  // world ranks must submit identical membership (the reference's
+  // synchronized registration), mismatches fail via the ordinary
+  // metadata-validation channel.
+  kRegisterSet = 8,
+  kDeregisterSet = 9,
 };
 
 enum class StatusType : int32_t {
@@ -106,6 +114,11 @@ struct Request {
   // count the coordinator waits for. Empty tag = ungrouped.
   std::string group;
   int32_t group_size = 0;
+  // process set this op negotiates in (reference process_set.h:89): 0 =
+  // global. Readiness counts only the set's members; the Python layer
+  // qualifies tensor names per set so name-keyed tables never collide
+  // across sets.
+  int32_t process_set_id = 0;
 
   int64_t NumElements() const {
     int64_t n = 1;
@@ -144,6 +157,15 @@ struct Response {
   // ranks must also skip caching them (grouped responses are uncached so
   // the cache fast path can never split a group across cycles)
   std::string group;
+  // the process set this response belongs to; non-member ranks still
+  // mutate their response cache identically (replicated positions) but
+  // never execute the batch. For kRegisterSet acks, first_shape carries
+  // the agreed membership.
+  int32_t process_set_id = 0;
+  // kError only: the single rank this error addresses, or -1 for all.
+  // A non-member enqueue fails just the offender — the broadcast error
+  // must not pop a member's legitimately pending entry of the same name.
+  int32_t error_rank = -1;
 };
 
 struct RequestList {

@@ -30,6 +30,9 @@ TcpController::TcpController(const ControllerOptions& opts)
       fusion_threshold_(opts.fusion_threshold_bytes),
       tuned_cycle_ms_(opts.cycle_ms),
       at_warmup_left_(opts.autotune_warmup_samples) {
+  // set 0 = the global set (reference process_set.h:42 Global)
+  SetState& global = sets_[0];
+  for (int32_t r = 0; r < opts_.size; ++r) global.members.push_back(r);
   // a 1-cycle sample has no measurable interval (the anchor cycle opens
   // the window); two counted cycles is the floor for a meaningful score
   if (opts_.autotune_cycles_per_sample < 2) {
@@ -104,7 +107,8 @@ ResponseList TcpController::WorkerCycle(const RequestList& own) {
 }
 
 // Per-request (rank-independent) validity: alltoall splits must address
-// every rank and cover the tensor exactly (reference operations.cc:1858).
+// every rank *of the op's process set* and cover the tensor exactly
+// (reference operations.cc:1858).
 static std::string ValidateSplits(const Request& req, int32_t size) {
   if (req.op != OpType::kAlltoall) return "";
   int64_t d0 = req.shape.empty() ? 0 : req.shape[0];
@@ -135,16 +139,42 @@ static std::string ValidateSplits(const Request& req, int32_t size) {
   return "";
 }
 
-void TcpController::IncrementTensorCount(const Request& req, int32_t rank) {
+void TcpController::IncrementTensorCount(
+    const Request& req, int32_t rank,
+    std::vector<Response>* immediate_errors) {
+  // resolve the op's process set; unknown sets / non-member submissions
+  // cannot accumulate coverage and fail immediately (only the submitting
+  // rank holds a handle for the set-qualified name)
+  auto sit = sets_.find(req.process_set_id);
+  if (sit == sets_.end() ||
+      (req.op != OpType::kRegisterSet && req.op != OpType::kDeregisterSet &&
+       !sit->second.Contains(rank))) {
+    Response err;
+    err.op = OpType::kError;
+    err.tensor_names = {req.name};
+    err.process_set_id = req.process_set_id;
+    err.error_rank = rank;  // fail only the offender's handle
+    err.error_reason =
+        sit == sets_.end()
+            ? "tensor '" + req.name + "' names unregistered process set " +
+                  std::to_string(req.process_set_id)
+            : "rank " + std::to_string(rank) +
+                  " is not a member of process set " +
+                  std::to_string(req.process_set_id);
+    immediate_errors->push_back(std::move(err));
+    return;
+  }
+  auto& table = sit->second.table;
   // reference: controller.cc:1006 — first request creates the record;
   // metadata must agree with what rank 0 of the record submitted
-  auto it = message_table_.find(req.name);
-  if (it == message_table_.end()) {
+  auto it = table.find(req.name);
+  if (it == table.end()) {
     TensorRecord rec;
-    rec.error = ValidateSplits(req, opts_.size);
+    rec.error = ValidateSplits(
+        req, static_cast<int32_t>(sit->second.members.size()));
     rec.requests[rank] = req;
     rec.ranks.insert(rank);
-    message_table_[req.name] = std::move(rec);
+    table[req.name] = std::move(rec);
     stall_inspector_.RecordRank(req.name, rank);
     return;
   }
@@ -187,21 +217,96 @@ void TcpController::IncrementTensorCount(const Request& req, int32_t rank) {
     }
   }
   if (rec.error.empty()) {
-    rec.error = ValidateSplits(req, opts_.size);
+    rec.error = ValidateSplits(
+        req, static_cast<int32_t>(sit->second.members.size()));
   }
   rec.requests[rank] = req;
   rec.ranks.insert(rank);
   stall_inspector_.RecordRank(req.name, rank);
 }
 
-Response TcpController::ConstructResponse(const std::string& name) {
-  TensorRecord& rec = message_table_[name];
+Response TcpController::ConstructResponse(int32_t set_id,
+                                          const std::string& name) {
+  SetState& set = sets_[set_id];
+  TensorRecord& rec = set.table[name];
   const Request& first = rec.requests.begin()->second;
   Response resp;
+  resp.process_set_id = set_id;
   if (!rec.error.empty()) {
     resp.op = OpType::kError;
     resp.error_reason = rec.error;
     resp.tensor_names = {name};
+    return resp;
+  }
+  if (first.op == OpType::kRegisterSet ||
+      first.op == OpType::kDeregisterSet) {
+    // membership agreed by all world ranks (shape equality validated
+    // above); activate/retire the set here so the very next cycle
+    // negotiates in it (reference process_set_table.cc Register)
+    int32_t target = first.root_rank;  // set id rides root_rank
+    resp.op = first.op;
+    resp.tensor_names = {name};
+    resp.process_set_id = target;
+    resp.first_shape = first.shape;
+    resp.tensor_shapes = {first.shape};
+    if (first.op == OpType::kRegisterSet) {
+      std::vector<int32_t> members(first.shape.begin(), first.shape.end());
+      std::sort(members.begin(), members.end());
+      auto tit = sets_.find(target);
+      if (target <= 0) {
+        resp.op = OpType::kError;
+        resp.error_reason = "process set id must be positive, got " +
+                            std::to_string(target);
+      } else if (members.empty() ||
+                 std::adjacent_find(members.begin(), members.end()) !=
+                     members.end() ||
+                 members.front() < 0 || members.back() >= opts_.size) {
+        resp.op = OpType::kError;
+        resp.error_reason =
+            "invalid membership for process set " + std::to_string(target);
+      } else if (tit != sets_.end() && tit->second.members != members) {
+        resp.op = OpType::kError;
+        resp.error_reason = "process set " + std::to_string(target) +
+                            " already registered with different members";
+      } else {
+        sets_[target].members = std::move(members);  // idempotent re-ack
+      }
+    } else {
+      auto tit = sets_.find(target);
+      if (target == 0 || tit == sets_.end()) {
+        resp.op = OpType::kError;
+        resp.error_reason = "cannot deregister process set " +
+                            std::to_string(target);
+      } else {
+        // in-flight tensors of a retired set can never complete; fail
+        // them in this same cycle via the error channel
+        for (auto& kv : tit->second.table) {
+          Response dead;
+          dead.op = OpType::kError;
+          dead.tensor_names = {kv.first};
+          dead.process_set_id = target;
+          dead.error_reason = "process set " + std::to_string(target) +
+                              " was deregistered";
+          pending_set_errors_.push_back(std::move(dead));
+          stall_inspector_.RemoveTensor(kv.first);
+        }
+        // a half-arrived set barrier likewise: fail the arrived members'
+        // handles (and clear their queue entries) instead of letting
+        // them block the full timeout — and leaving a permanent
+        // duplicate-name entry that would poison a re-registered set
+        if (!tit->second.barrier_ranks.empty() &&
+            !tit->second.barrier_name.empty()) {
+          Response dead;
+          dead.op = OpType::kError;
+          dead.tensor_names = {tit->second.barrier_name};
+          dead.process_set_id = target;
+          dead.error_reason = "process set " + std::to_string(target) +
+                              " was deregistered during its barrier";
+          pending_set_errors_.push_back(std::move(dead));
+        }
+        sets_.erase(tit);
+      }
+    }
     return resp;
   }
   resp.op = first.op;
@@ -214,28 +319,36 @@ Response TcpController::ConstructResponse(const std::string& name) {
   resp.first_shape = first.shape;
   resp.tensor_shapes = {first.shape};
   resp.group = first.group;
-  // allgather: total bytes sums every rank's first dim; the negotiated
-  // per-rank dim-0 sizes ship in the response so ragged gathers execute
-  // (reference allgather size collection, controller.cc:497)
+  const auto& members = set.members;
+  const int32_t ssize = static_cast<int32_t>(members.size());
+  auto set_local = [&](int32_t global_rank) {
+    return static_cast<int32_t>(
+        std::lower_bound(members.begin(), members.end(), global_rank) -
+        members.begin());
+  };
+  // allgather: total bytes sums every member's first dim; the negotiated
+  // per-member dim-0 sizes ship in the response in SET-LOCAL order so
+  // ragged gathers execute (reference allgather size collection,
+  // controller.cc:497)
   if (first.op == OpType::kAllgather) {
-    resp.rank_dim0.resize(opts_.size, 0);
+    resp.rank_dim0.resize(ssize, 0);
     for (const auto& kv : rec.requests) {
       resp.total_bytes += kv.second.ByteSize();
-      resp.rank_dim0[kv.first] =
+      resp.rank_dim0[set_local(kv.first)] =
           kv.second.shape.empty() ? 0 : kv.second.shape[0];
     }
   } else if (first.op == OpType::kAlltoall) {
-    // full splits matrix, row r = rank r's outgoing splits (even rows
-    // synthesized as dim0/size), so every rank knows its recv layout
+    // full splits matrix in set-local coordinates, row i = member i's
+    // outgoing splits (even rows synthesized as dim0/set_size)
     resp.total_bytes = first.ByteSize();
-    resp.all_splits.assign(
-        static_cast<size_t>(opts_.size) * opts_.size, 0);
+    resp.all_splits.assign(static_cast<size_t>(ssize) * ssize, 0);
     for (const auto& kv : rec.requests) {
       const Request& r = kv.second;
       int64_t d0 = r.shape.empty() ? 0 : r.shape[0];
-      for (int32_t j = 0; j < opts_.size; ++j) {
-        resp.all_splits[kv.first * opts_.size + j] =
-            r.splits.empty() ? d0 / opts_.size : r.splits[j];
+      int32_t i = set_local(kv.first);
+      for (int32_t j = 0; j < ssize; ++j) {
+        resp.all_splits[i * ssize + j] =
+            r.splits.empty() ? d0 / ssize : r.splits[j];
       }
     }
   } else {
@@ -267,13 +380,16 @@ std::vector<Response> TcpController::FuseResponses(
     }
     // group is part of the key: a mixed grouped/ungrouped bucket would
     // inherit one constituent's group tag and silently break the
-    // grouped-responses-are-never-cached invariant for the others
+    // grouped-responses-are-never-cached invariant for the others.
+    // process_set_id likewise: a fused batch is one collective over one
+    // set's sub-mesh — members of another set couldn't execute it.
     std::string key = std::to_string(static_cast<int>(r.op)) + "/" +
                       std::to_string(static_cast<int>(r.dtype)) + "/" +
                       std::to_string(r.reduce_op) + "/" +
                       std::to_string(r.root_rank) + "/" +
                       std::to_string(r.prescale) + "/" +
-                      std::to_string(r.postscale) + "/" + r.group;
+                      std::to_string(r.postscale) + "/" + r.group + "/" +
+                      std::to_string(r.process_set_id);
     auto it = open.find(key);
     if (it != open.end() &&
         out[it->second].total_bytes + r.total_bytes <=
@@ -317,70 +433,130 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
   }
 
   // 2. cache coordination (reference CoordinateCacheAndState,
-  // controller.cc:802): agreed hits = AND of all hit bitvectors; agreed
-  // invalidations = OR of all invalid bitvectors. Any rank invalidating a
-  // position vetoes its hit and forces every rank to erase that entry in
-  // this same cycle, so per-rank position tables never diverge. Joined
-  // ranks agree with everything (they contribute zeros to the AND).
+  // controller.cc:802): a hit position executes from cache only when
+  // every non-joined MEMBER of the entry's process set claimed it —
+  // non-members replicate the entry (positions stay identical on all
+  // ranks) but never enqueue the tensor, so a world-wide AND would
+  // permanently disable the fast path for subset collectives. Agreed
+  // invalidations stay a world-wide OR: every rank holds the entry and
+  // must erase it in the same cycle.
   std::vector<uint32_t> agreed_positions;
   std::vector<uint64_t> agreed_invalid;
   if (cache != nullptr && cache->capacity() > 0) {
     std::vector<std::vector<uint64_t>> bitsets;
+    std::vector<uint64_t> any_bits;  // OR of all claims
     for (int32_t r = 0; r < opts_.size; ++r) {
-      if (!joined_ranks_.count(r)) bitsets.push_back(all[r].cache_bits);
+      if (!joined_ranks_.count(r)) {
+        bitsets.push_back(all[r].cache_bits);
+        for (size_t w = 0; w < all[r].cache_bits.size(); ++w) {
+          if (w >= any_bits.size()) any_bits.resize(w + 1, 0);
+          any_bits[w] |= all[r].cache_bits[w];
+        }
+      }
       for (size_t w = 0; w < all[r].invalid_bits.size(); ++w) {
         if (w >= agreed_invalid.size()) agreed_invalid.resize(w + 1, 0);
         agreed_invalid[w] |= all[r].invalid_bits[w];
       }
     }
     if (!bitsets.empty()) {
+      // Fast path (the steady-state common case, all entries global):
+      // word-wide AND over every non-joined rank, exactly the reference
+      // CacheCoordinator. Subset entries can never pass it — their
+      // non-members never claim — so positions claimed by someone but
+      // not unanimous get a member-scoped check below; global entries
+      // there are simply not agreed yet.
       auto hits = ResponseCache::Intersect(bitsets);
-      for (size_t w = 0; w < hits.size() && w < agreed_invalid.size(); ++w) {
+      for (size_t w = 0; w < hits.size() && w < agreed_invalid.size();
+           ++w) {
         hits[w] &= ~agreed_invalid[w];
       }
+      std::vector<uint64_t> partial = any_bits;
+      for (size_t w = 0; w < partial.size(); ++w) {
+        uint64_t h = w < hits.size() ? hits[w] : 0ull;
+        uint64_t inv = w < agreed_invalid.size() ? agreed_invalid[w] : 0ull;
+        partial[w] &= ~h & ~inv;
+      }
       agreed_positions = ResponseCache::BitsToPositions(hits);
+      for (uint32_t pos : ResponseCache::BitsToPositions(partial)) {
+        if (cache->NameAt(pos).empty()) continue;  // stale claim
+        int32_t sid = cache->Get(pos).process_set_id;
+        if (sid == 0) continue;  // global entry, not unanimous
+        auto sit = sets_.find(sid);
+        if (sit == sets_.end()) continue;  // deregistered since caching
+        bool agreed = true;
+        for (int32_t m : sit->second.members) {
+          if (joined_ranks_.count(m)) continue;
+          const auto& bits = all[m].cache_bits;
+          size_t w = pos / 64;
+          if (w >= bits.size() || !((bits[w] >> (pos % 64)) & 1)) {
+            agreed = false;
+            break;
+          }
+        }
+        if (agreed) agreed_positions.push_back(pos);
+      }
+      // deterministic execution order every rank agrees on
+      std::sort(agreed_positions.begin(), agreed_positions.end());
     }
   }
 
-  // 3. count full submissions
+  // 3. count full submissions (routed to each op's process-set table)
+  std::vector<Response> immediate_errors;
   for (int32_t r = 0; r < opts_.size; ++r) {
     for (const auto& req : all[r].requests) {
       if (req.op == OpType::kBarrier) {
-        barrier_ranks_.insert(r);
+        auto sit = sets_.find(req.process_set_id);
+        if (sit == sets_.end() || !sit->second.Contains(r)) {
+          Response err;
+          err.op = OpType::kError;
+          err.tensor_names = {req.name};
+          err.process_set_id = req.process_set_id;
+          err.error_rank = r;  // fail only the offender's handle
+          err.error_reason =
+              "barrier on unregistered process set or from non-member "
+              "rank " + std::to_string(r);
+          immediate_errors.push_back(std::move(err));
+          continue;
+        }
+        sit->second.barrier_ranks.insert(r);
+        sit->second.barrier_name = req.name;
         continue;
       }
-      auto before = message_table_.count(req.name)
-                        ? message_table_[req.name].ranks.size()
-                        : 0;
-      IncrementTensorCount(req, r);
-      (void)before;
+      IncrementTensorCount(req, r, &immediate_errors);
     }
   }
 
-  // 4. readiness: submitted ∪ joined covers the world
+  // 4. readiness per set: submitted ∪ (joined ∩ members) covers the set
   std::vector<Response> ready;
   for (uint32_t pos : agreed_positions) {
     Response resp = cache->Get(pos);
     ready.push_back(resp);
   }
-  std::vector<std::string> done;
-  // covered group members withheld until their whole group is covered
-  std::map<std::string, std::vector<std::string>> group_covered;
-  std::set<std::string> errored_groups;
-  for (auto& kv : message_table_) {
-    const Request& first = kv.second.requests.begin()->second;
-    if (!first.group.empty() && !kv.second.error.empty()) {
-      errored_groups.insert(first.group);
-    }
-    size_t covered = kv.second.ranks.size();
-    for (int32_t jr : joined_ranks_) {
-      if (!kv.second.ranks.count(jr)) ++covered;
-    }
-    if (static_cast<int32_t>(covered) < opts_.size) continue;
-    if (first.group.empty()) {
-      done.push_back(kv.first);
-    } else {
-      group_covered[first.group].push_back(kv.first);
+  std::vector<std::pair<int32_t, std::string>> done;
+  // covered group members withheld until their whole group is covered;
+  // groups are scoped to their set (a fused batch is one sub-mesh op)
+  std::map<std::pair<int32_t, std::string>, std::vector<std::string>>
+      group_covered;
+  std::set<std::pair<int32_t, std::string>> errored_groups;
+  for (auto& skv : sets_) {
+    const int32_t sid = skv.first;
+    SetState& set = skv.second;
+    for (auto& kv : set.table) {
+      const Request& first = kv.second.requests.begin()->second;
+      auto gkey = std::make_pair(sid, first.group);
+      if (!first.group.empty() && !kv.second.error.empty()) {
+        errored_groups.insert(gkey);
+      }
+      size_t covered = kv.second.ranks.size();
+      for (int32_t jr : joined_ranks_) {
+        if (set.Contains(jr) && !kv.second.ranks.count(jr)) ++covered;
+      }
+      if (covered < set.members.size()) continue;
+      if (first.group.empty()) {
+        done.emplace_back(sid, kv.first);
+      } else {
+        group_covered[gkey].push_back(kv.first);
+      }
     }
   }
   // all-or-nothing group readiness (reference group_table.h:25,
@@ -390,9 +566,12 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
   for (auto& kv : group_covered) {
     if (errored_groups.count(kv.first)) continue;  // failed below
     const std::string& any = kv.second.front();
-    int32_t expect = message_table_[any].requests.begin()->second.group_size;
+    int32_t expect = sets_[kv.first.first]
+                         .table[any]
+                         .requests.begin()
+                         ->second.group_size;
     if (static_cast<int32_t>(kv.second.size()) >= expect) {
-      for (auto& n : kv.second) done.push_back(n);
+      for (auto& n : kv.second) done.emplace_back(kv.first.first, n);
     }
   }
   // A group with any errored member fails as a WHOLE, immediately and on
@@ -401,27 +580,38 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
   // arrives) and would bury the recorded error. Error responses are safe
   // to emit for partially-covered names: ranks without a local entry
   // simply have no handle to fail.
-  for (const auto& gname : errored_groups) {
-    for (auto& kv : message_table_) {
+  for (const auto& gkey : errored_groups) {
+    for (auto& kv : sets_[gkey.first].table) {
       const Request& first = kv.second.requests.begin()->second;
-      if (first.group != gname) continue;
+      if (first.group != gkey.second) continue;
       if (kv.second.error.empty()) {
         kv.second.error =
-            "group '" + gname + "' failed on another member";
+            "group '" + gkey.second + "' failed on another member";
       }
-      done.push_back(kv.first);
+      done.emplace_back(gkey.first, kv.first);
     }
   }
-  // deterministic order: sort newly-ready by name (completion order across
-  // a cycle is unordered anyway since all arrive in the same gather)
+  // deterministic order: sort newly-ready by (set, name) — completion
+  // order across a cycle is unordered anyway since all arrive in the
+  // same gather
   std::sort(done.begin(), done.end());
-  for (const auto& name : done) {
-    ready.push_back(ConstructResponse(name));
-    message_table_.erase(name);
-    stall_inspector_.RemoveTensor(name);
+  for (const auto& sn : done) {
+    auto sit = sets_.find(sn.first);
+    // a deregistration processed earlier in this loop may have retired
+    // the set (its stranded tensors were failed via pending_set_errors_)
+    if (sit == sets_.end() || !sit->second.table.count(sn.second)) {
+      continue;
+    }
+    ready.push_back(ConstructResponse(sn.first, sn.second));
+    sit = sets_.find(sn.first);  // deregister may erase inside Construct
+    if (sit != sets_.end()) sit->second.table.erase(sn.second);
+    stall_inspector_.RemoveTensor(sn.second);
   }
+  for (auto& e : pending_set_errors_) ready.push_back(std::move(e));
+  pending_set_errors_.clear();
+  for (auto& e : immediate_errors) ready.push_back(std::move(e));
 
-  // 5. join / barrier completion
+  // 5. join / per-set barrier completion
   ResponseList rl;
   if (static_cast<int32_t>(joined_ranks_.size()) >= opts_.size) {
     Response j;
@@ -430,12 +620,22 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
     ready.push_back(j);
     joined_ranks_.clear();
   }
-  if (static_cast<int32_t>(barrier_ranks_.size()) >= opts_.size) {
+  for (auto& skv : sets_) {
+    SetState& set = skv.second;
+    if (set.barrier_ranks.empty()) continue;
+    size_t covered = set.barrier_ranks.size();
+    for (int32_t jr : joined_ranks_) {
+      if (set.Contains(jr) && !set.barrier_ranks.count(jr)) ++covered;
+    }
+    if (covered < set.members.size()) continue;
     Response b;
     b.op = OpType::kBarrier;
-    b.tensor_names = {"__barrier__"};  // resolves the worker-side handle
+    b.process_set_id = skv.first;
+    // resolves the worker-side handle (Python qualifies per set)
+    b.tensor_names = {
+        set.barrier_name.empty() ? "__barrier__" : set.barrier_name};
     ready.push_back(b);
-    barrier_ranks_.clear();
+    set.barrier_ranks.clear();
   }
 
   // 6. stall check

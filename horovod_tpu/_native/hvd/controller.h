@@ -18,6 +18,7 @@
 // identical order on all ranks (SURVEY.md §5.8).
 #pragma once
 
+#include <algorithm>
 #include <cstdint>
 #include <map>
 #include <memory>
@@ -73,8 +74,13 @@ class TcpController {
   ResponseList WorkerCycle(const RequestList& own);
 
   // --- coordinator-side negotiation state (reference controller.cc) ---
-  void IncrementTensorCount(const Request& req, int32_t rank);
-  Response ConstructResponse(const std::string& name);
+  // A request for an unknown set or from a non-member cannot wait for
+  // coverage (membership is unknowable / will never arrive): it fails
+  // immediately via `immediate_errors`, delivered only to the submitting
+  // rank's handle (names are set-qualified, so nothing else resolves).
+  void IncrementTensorCount(const Request& req, int32_t rank,
+                            std::vector<Response>* immediate_errors);
+  Response ConstructResponse(int32_t set_id, const std::string& name);
   std::vector<Response> FuseResponses(std::vector<Response> ready);
   static ResponseList ErrorList(const std::string& reason);
 
@@ -92,9 +98,25 @@ class TcpController {
     std::set<int32_t> ranks;
     std::string error;  // first metadata mismatch
   };
-  std::unordered_map<std::string, TensorRecord> message_table_;
+  // Per-process-set negotiation state (reference process_set.h:89: each
+  // set owns its controller/table; here one transport carries every
+  // set's traffic and the coordinator keys state by set id). Set 0 = the
+  // global set, always present. Readiness for a set counts only its
+  // members; barrier likewise.
+  struct SetState {
+    std::vector<int32_t> members;  // sorted global ranks
+    std::unordered_map<std::string, TensorRecord> table;
+    std::set<int32_t> barrier_ranks;
+    std::string barrier_name;  // qualified name from the requests
+    bool Contains(int32_t r) const {
+      return std::binary_search(members.begin(), members.end(), r);
+    }
+  };
+  std::map<int32_t, SetState> sets_;
+  // error responses generated while constructing another response (e.g.
+  // tensors stranded by a deregistered set), emitted in the same cycle
+  std::vector<Response> pending_set_errors_;
   std::set<int32_t> joined_ranks_;
-  std::set<int32_t> barrier_ranks_;
 
   StallInspector stall_inspector_;
   int64_t stall_warnings_ = 0;

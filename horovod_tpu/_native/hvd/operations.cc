@@ -17,8 +17,10 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdio>
 #include <cstring>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -47,6 +49,10 @@ struct Batch {
   int64_t cycle = 0;  // negotiation cycle that produced this batch
   Response response;
   std::vector<int64_t> handles;
+  // set membership SNAPSHOTTED at batch creation: a deregistration
+  // landing between negotiation and the executor's pop must not turn a
+  // subset batch into an (empty-members = global) one
+  std::vector<int64_t> set_members;
 };
 
 struct Global {
@@ -94,6 +100,12 @@ struct Global {
   std::atomic<double> cycle_ms{1.0};
   int32_t rank = 0;
   int32_t size = 1;
+
+  // registered process sets, mirrored from kRegisterSet acks (reference
+  // process_set.h:89 ProcessSetTable): set id -> sorted member ranks.
+  // Batches for sets this rank is not a member of are never emitted.
+  std::mutex sets_mu;
+  std::map<int32_t, std::vector<int32_t>> process_sets;
 
   // autotuned values distributed by the coordinator (ResponseList)
   std::atomic<double> tuned_cycle_ms{0.0};
@@ -262,6 +274,13 @@ bool RunLoopOnce() {
   g->pending_invalid.clear();
 
   for (auto& resp : rl.responses) {
+    if (resp.op == OpType::kError && resp.error_rank >= 0 &&
+        resp.error_rank != g->rank) {
+      // a per-rank error (e.g. a non-member enqueue) addressed to
+      // another rank: our pending entry of the same qualified name — if
+      // any — is legitimate and still negotiating
+      continue;
+    }
     if (resp.op == OpType::kError && resp.tensor_names.empty()) {
       // global/transport error: fail everything pending (DrainAll covers
       // parked hits and retries — their table entries were never popped)
@@ -289,9 +308,54 @@ bool RunLoopOnce() {
       PushBatch(std::move(b));
       continue;
     }
+    if (resp.op == OpType::kRegisterSet ||
+        resp.op == OpType::kDeregisterSet) {
+      // registration acks mutate the local set table and complete their
+      // handles directly — there is nothing for the data plane to run
+      {
+        std::lock_guard<std::mutex> l(g->sets_mu);
+        if (resp.op == OpType::kRegisterSet) {
+          g->process_sets[resp.process_set_id] = std::vector<int32_t>(
+              resp.first_shape.begin(), resp.first_shape.end());
+        } else {
+          g->process_sets.erase(resp.process_set_id);
+        }
+      }
+      auto regs = g->tensor_queue.PopEntriesWithRequests(resp.tensor_names);
+      {
+        std::lock_guard<std::mutex> l(g->handle_mu);
+        for (const auto& e : regs) g->handle_states[e.handle] = kDone;
+      }
+      g->handle_cv.notify_all();
+      continue;
+    }
+    // a response for a set this rank is not a member of: replicate the
+    // cache mutation below (position tables must stay identical on every
+    // rank) but never execute — the sub-mesh collective belongs to the
+    // members alone
+    bool member = true;
+    std::vector<int64_t> snapshot_members;
+    if (resp.process_set_id != 0 && resp.op != OpType::kError) {
+      std::lock_guard<std::mutex> l(g->sets_mu);
+      auto psit = g->process_sets.find(resp.process_set_id);
+      member = psit != g->process_sets.end() &&
+               std::binary_search(psit->second.begin(), psit->second.end(),
+                                  g->rank);
+      if (member) {
+        snapshot_members.assign(psit->second.begin(), psit->second.end());
+      }
+    }
 
-    std::vector<PendingEntry> entries =
-        g->tensor_queue.PopEntriesWithRequests(resp.tensor_names);
+    // Non-members must NOT pop pending entries for the response's names:
+    // a non-member's same-named entry is its own (illegitimate) enqueue
+    // into that set, which the coordinator fails with a TARGETED error —
+    // popping it here on the members' success response would orphan its
+    // handle as forever-pending. kError responses keep member=true, so
+    // the offender's targeted error still resolves its entry.
+    std::vector<PendingEntry> entries;
+    if (member) {
+      entries = g->tensor_queue.PopEntriesWithRequests(resp.tensor_names);
+    }
     std::vector<int64_t> handles;
     handles.reserve(entries.size());
     for (const auto& e : entries) handles.push_back(e.handle);
@@ -312,7 +376,10 @@ bool RunLoopOnce() {
       for (const auto& e : entries) local[e.request.name] = &e.request;
       for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
         const std::string& name = resp.tensor_names[i];
-        g->pending_hits.erase(name);
+        // members only: a non-member's parked hit on this name is its
+        // own illegitimate request — it must age out and renegotiate
+        // into a targeted error, not vanish with an orphaned handle
+        if (member) g->pending_hits.erase(name);
         Request req;
         auto it = local.find(name);
         if (it != local.end()) {
@@ -325,6 +392,7 @@ bool RunLoopOnce() {
           req.root_rank = resp.root_rank;
           req.prescale = resp.prescale;
           req.postscale = resp.postscale;
+          req.process_set_id = resp.process_set_id;
           req.shape = i < resp.tensor_shapes.size() ? resp.tensor_shapes[i]
                                                     : resp.first_shape;
         }
@@ -335,15 +403,17 @@ bool RunLoopOnce() {
         single.total_bytes = req.ByteSize();
         g->cache->Put(single, req);
       }
-    } else {
+    } else if (member) {
       for (const auto& n : resp.tensor_names) g->pending_hits.erase(n);
     }
+    if (!member) continue;  // cache replicated; execution is members-only
     g->bytes_negotiated.fetch_add(resp.total_bytes);
     Batch b;
     b.id = g->batch_counter.fetch_add(1);
     b.cycle = cycle;
     b.response = resp;
     b.handles = handles;
+    b.set_members = std::move(snapshot_members);
     for (int64_t h : handles) SetHandle(h, kBatched);
     PushBatch(std::move(b));
   }
@@ -414,6 +484,7 @@ int hvd_native_init(int rank, int size, const char* coord_addr,
   g->rank = rank;
   g->size = size;
   g->cycle_ms = cycle_ms;
+  for (int r = 0; r < size; ++r) g->process_sets[0].push_back(r);
   g->cache.reset(new ResponseCache(
       cache_capacity < 0 ? 0 : static_cast<size_t>(cache_capacity)));
   ControllerOptions opts;
@@ -461,7 +532,7 @@ long long hvd_native_enqueue(const char* name, int op, int dtype,
                              int root_rank, double prescale,
                              double postscale, const long long* splits,
                              int nsplits, const char* group,
-                             int group_size) {
+                             int group_size, int process_set_id) {
   if (g == nullptr || !g->initialized.load() || g->broken.load()) return -1;
   Request req;
   req.rank = g->rank;
@@ -476,6 +547,7 @@ long long hvd_native_enqueue(const char* name, int op, int dtype,
   for (int i = 0; i < nsplits; ++i) req.splits.push_back(splits[i]);
   if (group != nullptr) req.group = group;
   req.group_size = group_size;
+  req.process_set_id = process_set_id;
   int64_t h = g->handle_counter.fetch_add(1);
   SetHandle(h, kPending);
   if (!g->tensor_queue.Add(req, h)) {
@@ -502,7 +574,61 @@ long long hvd_native_barrier() {
   long long shape[1] = {0};
   return hvd_native_enqueue("__barrier__", static_cast<int>(OpType::kBarrier),
                             0, shape, 0, 0, 0, 1.0, 1.0, nullptr, 0,
-                            nullptr, 0);
+                            nullptr, 0, 0);
+}
+
+// Register a process set: negotiated like a tensor named "__set__<id>"
+// in the global set — every world rank must call this with identical
+// membership (reference process_sets.py:123 add_process_set under
+// HOROVOD_DYNAMIC_PROCESS_SETS). Returns a handle; kDone once the
+// coordinator activated the set on every rank.
+long long hvd_native_register_set(int set_id, const long long* ranks,
+                                  int n) {
+  if (g == nullptr || !g->initialized.load() || g->broken.load()) return -1;
+  Request req;
+  req.rank = g->rank;
+  req.op = OpType::kRegisterSet;
+  req.name = "__set__" + std::to_string(set_id);
+  req.root_rank = set_id;  // set id rides root_rank (common.h kRegisterSet)
+  for (int i = 0; i < n; ++i) req.shape.push_back(ranks[i]);
+  std::sort(req.shape.begin(), req.shape.end());
+  int64_t h = g->handle_counter.fetch_add(1);
+  SetHandle(h, kPending);
+  if (!g->tensor_queue.Add(req, h)) {
+    SetError("process set " + std::to_string(set_id) +
+             " registration already pending");
+    SetHandle(h, kFailed);
+  }
+  return h;
+}
+
+long long hvd_native_deregister_set(int set_id) {
+  if (g == nullptr || !g->initialized.load() || g->broken.load()) return -1;
+  Request req;
+  req.rank = g->rank;
+  req.op = OpType::kDeregisterSet;
+  req.name = "__unset__" + std::to_string(set_id);
+  req.root_rank = set_id;
+  int64_t h = g->handle_counter.fetch_add(1);
+  SetHandle(h, kPending);
+  if (!g->tensor_queue.Add(req, h)) {
+    SetError("process set " + std::to_string(set_id) +
+             " deregistration already pending");
+    SetHandle(h, kFailed);
+  }
+  return h;
+}
+
+// Members of a registered set in sorted order; returns the member count,
+// 0 for unknown sets (set 0 always answers the full world).
+int hvd_native_set_members(int set_id, long long* out, int cap) {
+  if (g == nullptr) return 0;
+  std::lock_guard<std::mutex> l(g->sets_mu);
+  auto it = g->process_sets.find(set_id);
+  if (it == g->process_sets.end()) return 0;
+  int n = static_cast<int>(it->second.size());
+  for (int i = 0; i < n && i < cap; ++i) out[i] = it->second[i];
+  return n;
 }
 
 int hvd_native_poll(long long handle) {
@@ -537,7 +663,8 @@ int hvd_native_wait(long long handle, double timeout_s) {
 
 // Serialized batch: id, cycle, op, reduce_op, root_rank, prescale,
 // postscale, dtype, total_bytes, names, handles, first_shape,
-// error_reason, rank_dim0, all_splits, tensor_shapes.
+// error_reason, rank_dim0, all_splits, tensor_shapes, process_set_id,
+// set_members.
 // Returns: >0 bytes written; 0 timeout/none; <0 the NEGATED required
 // buffer size — the batch stays queued so the caller can retry with a
 // larger buffer (an alltoall batch carries an O(size^2) splits matrix,
@@ -579,6 +706,13 @@ long long hvd_native_next_batch(unsigned char* buf, long long buflen,
   // contribute zeros of each tensor's true shape, not first_shape
   w.I32(static_cast<int32_t>(b.response.tensor_shapes.size()));
   for (const auto& s : b.response.tensor_shapes) w.Vec(s);
+  // process set: id + sorted global member ranks (empty = global set) —
+  // the executor builds the sub-mesh over exactly these processes. The
+  // membership was snapshotted when the batch was created: reading the
+  // live table here would race with deregistration and emit an
+  // empty-members (= global!) batch for a subset op.
+  w.I32(b.response.process_set_id);
+  w.Vec(b.set_members);
   if (static_cast<long long>(w.data().size()) > buflen) {
     // too small: requeue at the front (order preserved) and report the
     // needed size so the caller can retry — dropping a popped batch

@@ -15,6 +15,7 @@ static void WriteRequest(Writer* w, const Request& r) {
   w->Vec(r.splits);
   w->Str(r.group);
   w->I32(r.group_size);
+  w->I32(r.process_set_id);
 }
 
 static Request ReadRequest(Reader* r) {
@@ -31,6 +32,7 @@ static Request ReadRequest(Reader* r) {
   q.splits = r->Vec<int64_t>();
   q.group = r->Str();
   q.group_size = r->I32();
+  q.process_set_id = r->I32();
   return q;
 }
 
@@ -77,6 +79,8 @@ static void WriteResponse(Writer* w, const Response& resp) {
   w->Vec(resp.rank_dim0);
   w->Vec(resp.all_splits);
   w->Str(resp.group);
+  w->I32(resp.process_set_id);
+  w->I32(resp.error_rank);
 }
 
 static Response ReadResponse(Reader* r) {
@@ -101,6 +105,8 @@ static Response ReadResponse(Reader* r) {
   resp.rank_dim0 = r->Vec<int64_t>();
   resp.all_splits = r->Vec<int64_t>();
   resp.group = r->Str();
+  resp.process_set_id = r->I32();
+  resp.error_rank = r->I32();
   return resp;
 }
 

@@ -170,7 +170,13 @@ def global_process_set() -> ProcessSet:
 
 
 def add_process_set(ranks_or_set) -> ProcessSet:
-    """Register a new process set (reference: process_sets.py:123)."""
+    """Register a new process set (reference: process_sets.py:123).
+
+    Under the native eager runtime this is a *synchronized* registration,
+    like the reference's dynamic process sets: every rank must call it
+    with the same membership, and the call returns once the coordinator
+    has activated the set's own negotiation table on all ranks
+    (process_set.h:89 ProcessSetTable)."""
     st = global_state()
     if st.process_set_table is None:
         raise ProcessSetError("horovod_tpu is not initialized")
@@ -179,7 +185,16 @@ def add_process_set(ranks_or_set) -> ProcessSet:
         if isinstance(ranks_or_set, ProcessSet)
         else ProcessSet(ranks_or_set)
     )
-    return st.process_set_table.add(ps)
+    ps = st.process_set_table.add(ps)
+    if st.eager_runtime is not None:
+        try:
+            st.eager_runtime.register_process_set(
+                ps.process_set_id, ps.ranks
+            )
+        except Exception:
+            st.process_set_table.remove(ps.process_set_id)
+            raise
+    return ps
 
 
 def remove_process_set(ps_or_id) -> None:
@@ -187,7 +202,21 @@ def remove_process_set(ps_or_id) -> None:
     st = global_state()
     if st.process_set_table is None:
         raise ProcessSetError("horovod_tpu is not initialized")
-    st.process_set_table.remove(ps_or_id)
+    pid = (
+        ps_or_id.process_set_id
+        if isinstance(ps_or_id, ProcessSet)
+        else int(ps_or_id)
+    )
+    # validate locally first (unknown id / global set raise before any
+    # cross-rank traffic), then deregister natively BEFORE mutating the
+    # local table: if the synchronized deregistration fails, the local
+    # and native views stay consistent and the call can be retried
+    st.process_set_table.get(pid)
+    if pid == 0:
+        raise ProcessSetError("cannot remove the global process set")
+    if st.eager_runtime is not None:
+        st.eager_runtime.deregister_process_set(pid)
+    st.process_set_table.remove(pid)
 
 
 def get_process_set_by_id(pid: int) -> ProcessSet:

@@ -516,7 +516,8 @@ def _leaf_namer(name):
 
 
 def _native_eager(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
-                  postscale=1.0, root_rank=0, name=None, splits=None):
+                  postscale=1.0, root_rank=0, name=None, splits=None,
+                  process_set_id=0):
     """Route one top-level collective through the background negotiation
     runtime: enqueue → controller negotiation → fused XLA execution →
     synchronize (reference operations.cc:1400 EnqueueTensorAllreduces →
@@ -526,7 +527,7 @@ def _native_eager(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
         name or _auto_name(op_kind), x, _NATIVE_OPS[op_kind],
         reduce_op=int(op), root_rank=int(root_rank),
         prescale=float(prescale), postscale=float(postscale),
-        splits=splits,
+        splits=splits, process_set_id=process_set_id,
     )
     out = rt.synchronize(handle)
     if op_kind == "alltoall":
@@ -549,14 +550,23 @@ def _eager_collective(op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
 
     rt = st.eager_runtime
     if rt is not None:
+        sid = 0
         if ps is not None:
-            raise HorovodInternalError(
-                "process-set collectives under the native eager runtime "
-                "need per-set controllers; run subsets through the SPMD "
-                "form (shard_map + process_set) for now"
-            )
+            # per-set negotiation in the native runtime (reference
+            # process_set.h:89): the set must have been registered on
+            # every rank (add_process_set does this when the runtime is
+            # live); member ranks negotiate among themselves and execute
+            # over the set's sub-mesh
+            sid = ps.process_set_id
+            if rt.process_set_members(sid) is None:
+                raise HorovodInternalError(
+                    f"process set {sid} is not registered with the "
+                    "native runtime; call hvd.add_process_set on every "
+                    "rank first (reference process_sets.py:123)"
+                )
         out = _native_eager(
-            rt, op_kind, tensor, op, prescale, postscale, root_rank, name
+            rt, op_kind, tensor, op, prescale, postscale, root_rank, name,
+            process_set_id=sid,
         )
         return out[0] if op_kind == "alltoall" else out
 
@@ -1076,19 +1086,30 @@ def _async(fn, *args, **kw) -> int:
 
 
 def _native_rt_for_async(process_set=None):
-    """The native runtime, when this call should route through it."""
+    """The native runtime, when this call should route through it.
+    Subset ops require their set to be registered with the runtime
+    (add_process_set registers on every rank). An unregistered set under
+    a live runtime fails HERE, eagerly — the sync sub-mesh fallback
+    would re-enter _eager_collective and raise the same error from the
+    worker thread at synchronize time, which only obscures the fix."""
     st = global_state()
     rt = st.eager_runtime
     if rt is None or basics.in_spmd_context():
         return None
     if process_set is not None and process_set.process_set_id != 0:
-        return None
+        if rt.process_set_members(process_set.process_set_id) is None:
+            raise HorovodInternalError(
+                f"process set {process_set.process_set_id} is not "
+                "registered with the native runtime; call "
+                "hvd.add_process_set on every rank first (reference "
+                "process_sets.py:123)"
+            )
     return rt
 
 
 def _native_async(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
                   postscale=1.0, root_rank=0, name=None,
-                  splits=None, grouped=False) -> int:
+                  splits=None, grouped=False, process_set_id=0) -> int:
     # The negotiated wire path is dense-only; flattening an
     # IndexedSlices here would enqueue its int indices and dense_shape
     # as independent collectives. Sparse allreduce_async falls back to
@@ -1118,12 +1139,18 @@ def _native_async(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
                 root_rank=int(root_rank), prescale=float(prescale),
                 postscale=float(postscale), splits=splits,
                 group=group, group_size=group_size,
+                process_set_id=process_set_id,
             )
         )
     return _handles.allocate(
         _NativeAsync(rt, op_kind, treedef, hs,
                      with_splits=splits is not None)
     )
+
+
+
+def _ps_id(process_set) -> int:
+    return process_set.process_set_id if process_set is not None else 0
 
 
 def allreduce_async(tensor, average=None, name=None, op=None,
@@ -1141,7 +1168,7 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     if rt is not None and not _contains_indexed_slices(tensor):
         return _native_async(
             rt, "allreduce", tensor, op, prescale_factor,
-            postscale_factor, name=name,
+            postscale_factor, name=name, process_set_id=_ps_id(process_set),
         )
     return _async(allreduce, tensor, op=op, name=name,
                   prescale_factor=prescale_factor,
@@ -1153,7 +1180,8 @@ def allgather_async(tensor, name=None, process_set=None,
                     axis_name=None) -> int:
     rt = _native_rt_for_async(process_set)
     if rt is not None:
-        return _native_async(rt, "allgather", tensor, name=name)
+        return _native_async(rt, "allgather", tensor, name=name,
+                             process_set_id=_ps_id(process_set))
     return _async(allgather, tensor, name=name, process_set=process_set,
                   axis_name=axis_name)
 
@@ -1163,7 +1191,8 @@ def broadcast_async(tensor, root_rank: int = 0, name=None,
     rt = _native_rt_for_async(process_set)
     if rt is not None:
         return _native_async(rt, "broadcast", tensor, root_rank=root_rank,
-                             name=name)
+                             name=name,
+                             process_set_id=_ps_id(process_set))
     return _async(broadcast, tensor, root_rank=root_rank, name=name,
                   process_set=process_set, axis_name=axis_name)
 
@@ -1176,7 +1205,8 @@ def alltoall_async(tensor, splits=None, name=None, process_set=None,
             [int(s) for s in np.asarray(splits)]
             if splits is not None else None
         )
-        return _native_async(rt, "alltoall", tensor, name=name, splits=sp)
+        return _native_async(rt, "alltoall", tensor, name=name, splits=sp,
+                             process_set_id=_ps_id(process_set))
     return _async(alltoall, tensor, splits=splits, name=name,
                   process_set=process_set, axis_name=axis_name)
 
@@ -1187,7 +1217,8 @@ def reducescatter_async(tensor, op: ReduceOp = ReduceOp.AVERAGE, name=None,
     rt = _native_rt_for_async(process_set)
     if rt is not None:
         return _native_async(rt, "reducescatter", tensor, op,
-                             prescale_factor, postscale_factor, name=name)
+                             prescale_factor, postscale_factor, name=name,
+                             process_set_id=_ps_id(process_set))
     return _async(reducescatter, tensor, op=op, name=name,
                   prescale_factor=prescale_factor,
                   postscale_factor=postscale_factor,
@@ -1212,6 +1243,7 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
         return _native_async(
             rt, "allreduce", tensors, op, prescale_factor,
             postscale_factor, name=name, grouped=True,
+            process_set_id=_ps_id(process_set),
         )
     return _async(grouped_allreduce, tensors, op=op, name=name,
                   prescale_factor=prescale_factor,

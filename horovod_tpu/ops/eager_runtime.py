@@ -81,8 +81,17 @@ class LoopbackExecutor:
         self._n = world_size
         self._rank = rank
 
+    def _set_world(self, batch: ExecutionBatch):
+        """(size, local_rank) of the batch's process set — the set's
+        member count and this rank's position in it; the global world
+        when the batch is unscoped."""
+        if batch.set_ranks:
+            return len(batch.set_ranks), batch.set_ranks.index(self._rank)
+        return self._n, self._rank
+
     def __call__(self, batch: ExecutionBatch, tensors: Dict[str, np.ndarray]
                  ) -> Dict[str, np.ndarray]:
+        n, rank = self._set_world(batch)
         out = {}
         for name in batch.names:
             if name not in tensors:
@@ -93,15 +102,15 @@ class LoopbackExecutor:
                 # n identical contributions: sum = x*n, min/max/adasum = x,
                 # product = x**n
                 if batch.reduce_op == _REDUCE_PRODUCT:
-                    r = scaled ** self._n
+                    r = scaled ** n
                 elif batch.reduce_op in (
                     _REDUCE_ADASUM, _REDUCE_MIN, _REDUCE_MAX
                 ):
                     r = scaled
                 else:
-                    r = scaled * self._n
+                    r = scaled * n
                     if batch.reduce_op == _REDUCE_AVERAGE:
-                        r = r / self._n
+                        r = r / n
                 out[name] = r * batch.postscale
             elif batch.op == OP_ALLGATHER:
                 dims = batch.rank_dim0
@@ -114,20 +123,20 @@ class LoopbackExecutor:
                         f"allgather '{name}' (negotiated dims {dims}); "
                         f"use the XLA executor (make_xla_executor)"
                     )
-                out[name] = np.concatenate([x] * self._n, axis=0)
+                out[name] = np.concatenate([x] * n, axis=0)
             elif batch.op == OP_BROADCAST:
                 out[name] = x
             elif batch.op == OP_REDUCESCATTER:
-                chunk = x.shape[0] // self._n
-                r = x[:chunk] * batch.prescale * self._n
+                chunk = x.shape[0] // n
+                r = x[:chunk] * batch.prescale * n
                 if batch.reduce_op == _REDUCE_AVERAGE:
-                    r = r / self._n
+                    r = r / n
                 out[name] = r * batch.postscale
             elif batch.op == OP_ALLTOALL:
                 # identical inputs: each peer sends us the chunk destined
                 # to our rank; with the negotiated splits matrix the recv
                 # layout is column `rank` (reference operations.cc:1858)
-                n, r = self._n, self._rank
+                r = rank
                 m = np.asarray(batch.all_splits, dtype=np.int64).reshape(
                     (n, n)
                 )
@@ -195,18 +204,29 @@ class EagerRuntime:
 
     # ------------------------------------------------------------ enqueue
 
+    @staticmethod
+    def _qualify(name: str, process_set_id: int) -> str:
+        """Set-qualified wire name: name-keyed tables (tensor queue,
+        message tables, response cache, stall inspector) never collide
+        across sets — the reference reaches the same end with whole
+        per-set controller instances (process_set.h:89)."""
+        return name if process_set_id == 0 else f"ps{process_set_id}:{name}"
+
     def enqueue(self, name: str, tensor, op: int = OP_ALLREDUCE,
                 reduce_op: int = _REDUCE_SUM, root_rank: int = 0,
                 prescale: float = 1.0, postscale: float = 1.0,
                 splits: Optional[List[int]] = None,
-                group: Optional[str] = None, group_size: int = 0) -> int:
+                group: Optional[str] = None, group_size: int = 0,
+                process_set_id: int = 0) -> int:
         arr = np.asarray(tensor)
+        name = self._qualify(name, process_set_id)
         handle = self._native.enqueue(
             name, op, str(arr.dtype), list(arr.shape),
             reduce_op=reduce_op, root_rank=root_rank,
             prescale=prescale, postscale=postscale,
             splits=[int(s) for s in splits] if splits is not None else None,
             group=group, group_size=group_size,
+            process_set_id=process_set_id,
         )
         # span opens only after the native enqueue accepted the tensor — a
         # raise above would otherwise leave an unclosed 'B' corrupting the
@@ -222,29 +242,73 @@ class EagerRuntime:
             self._handle_op[handle] = op
         return handle
 
+    # --------------------------------------------------- process sets
+
+    def register_process_set(self, set_id: int, ranks,
+                             timeout_s: float = 60.0) -> None:
+        """Negotiated registration: every world rank must call with
+        identical membership before any rank's call returns (reference
+        process_sets.py:123 add_process_set — synchronized registration).
+        """
+        h = self._native.register_set(set_id, [int(r) for r in ranks])
+        state = self._native.wait(h, timeout_s)
+        while state in (0, BATCHED):
+            state = self._native.wait(h, timeout_s)
+        self._native.release(h)
+        if state != DONE:
+            raise HorovodInternalError(
+                f"process set {set_id} registration failed: "
+                f"{self._native.last_error()}"
+            )
+
+    def deregister_process_set(self, set_id: int,
+                               timeout_s: float = 60.0) -> None:
+        h = self._native.deregister_set(set_id)
+        state = self._native.wait(h, timeout_s)
+        while state in (0, BATCHED):
+            state = self._native.wait(h, timeout_s)
+        self._native.release(h)
+        if state != DONE:
+            raise HorovodInternalError(
+                f"process set {set_id} deregistration failed: "
+                f"{self._native.last_error()}"
+            )
+
+    def process_set_members(self, set_id: int) -> Optional[List[int]]:
+        """Sorted global ranks of a registered set; None if unknown."""
+        return self._native.set_members(set_id)
+
     def allreduce_async(self, name: str, tensor, average: bool = False,
-                        prescale: float = 1.0, postscale: float = 1.0) -> int:
+                        prescale: float = 1.0, postscale: float = 1.0,
+                        process_set_id: int = 0) -> int:
         return self.enqueue(
             name, tensor, OP_ALLREDUCE,
             reduce_op=_REDUCE_AVERAGE if average else _REDUCE_SUM,
             prescale=prescale, postscale=postscale,
+            process_set_id=process_set_id,
         )
 
-    def allgather_async(self, name: str, tensor) -> int:
+    def allgather_async(self, name: str, tensor,
+                        process_set_id: int = 0) -> int:
         """Ragged-capable: dim 0 may differ per rank; the controller
         negotiates per-rank sizes (reference controller.cc:497). Note the
         default LoopbackExecutor refuses truly ragged worlds (it cannot
         fabricate peers' data); the XLA executor handles them."""
-        return self.enqueue(name, tensor, OP_ALLGATHER)
+        return self.enqueue(name, tensor, OP_ALLGATHER,
+                            process_set_id=process_set_id)
 
-    def alltoall_async(self, name: str, tensor, splits=None) -> int:
-        """Uneven-capable: `splits[j]` rows go to rank j; synchronize
-        returns (output, received_splits) (reference
+    def alltoall_async(self, name: str, tensor, splits=None,
+                       process_set_id: int = 0) -> int:
+        """Uneven-capable: `splits[j]` rows go to set-member j;
+        synchronize returns (output, received_splits) (reference
         operations.cc:1858)."""
-        return self.enqueue(name, tensor, OP_ALLTOALL, splits=splits)
+        return self.enqueue(name, tensor, OP_ALLTOALL, splits=splits,
+                            process_set_id=process_set_id)
 
-    def broadcast_async(self, name: str, tensor, root_rank: int = 0) -> int:
-        return self.enqueue(name, tensor, OP_BROADCAST, root_rank=root_rank)
+    def broadcast_async(self, name: str, tensor, root_rank: int = 0,
+                        process_set_id: int = 0) -> int:
+        return self.enqueue(name, tensor, OP_BROADCAST, root_rank=root_rank,
+                            process_set_id=process_set_id)
 
     def join(self) -> int:
         return self._native.join()
@@ -269,8 +333,18 @@ class EagerRuntime:
             )
         return 0
 
-    def barrier(self, timeout_s: float = 60.0) -> None:
-        h = self._native.barrier()
+    def barrier(self, timeout_s: float = 60.0,
+                process_set_id: int = 0) -> None:
+        if process_set_id == 0:
+            h = self._native.barrier()
+        else:
+            # per-set barrier: completes when every MEMBER has arrived
+            # (reference process_set.h:89 — each set negotiates alone)
+            h = self._native.enqueue(
+                self._qualify("__barrier__", process_set_id),
+                OP_BARRIER, "uint8", [],
+                process_set_id=process_set_id,
+            )
         state = self._native.wait(h, timeout_s)
         while state == BATCHED:
             state = self._native.wait(h, timeout_s)
@@ -455,28 +529,59 @@ class XlaExecutor:
         self._rank = rank
         self._world = world
         self._local_device = by_proc[rank]
+        self._by_proc = by_proc
         self._mesh = Mesh(
             np.asarray([by_proc[p] for p in range(world)]), ("proc",)
         )
+        # process-set sub-meshes, keyed by the sorted member tuple: a
+        # subset batch executes over exactly the members' devices — the
+        # sub-mesh IS the communicator (only member processes receive the
+        # batch, and only they issue this program; reference gives each
+        # set its own controller+communicator, process_set.h:89)
+        self._set_meshes: Dict[tuple, object] = {}
         self._programs: Dict[tuple, Callable] = {}
 
     # -------------------------------------------------------- plumbing
 
-    def _global_stack(self, arr: np.ndarray):
-        """Place this process's tensor as slice [rank] of a [world, ...]
-        global array sharded one-slice-per-process along ``proc``."""
+    def _batch_ctx(self, batch):
+        """(mesh, world, my set-local rank, cache key tag) for a batch's
+        process set; the global mesh for unscoped batches."""
+        members = tuple(batch.set_ranks)
+        if not members or list(members) == list(range(self._world)):
+            return self._mesh, self._world, self._rank, ()
+        if self._rank not in members:
+            raise HorovodInternalError(
+                f"rank {self._rank} received a batch for process set "
+                f"{batch.process_set_id} (members {list(members)}) it "
+                "does not belong to"
+            )
+        mesh = self._set_meshes.get(members)
+        if mesh is None:
+            from jax.sharding import Mesh
+
+            mesh = Mesh(
+                np.asarray([self._by_proc[p] for p in members]), ("proc",)
+            )
+            self._set_meshes[members] = mesh
+        return mesh, len(members), members.index(self._rank), members
+
+    def _global_stack(self, arr: np.ndarray, mesh=None, world=None):
+        """Place this process's tensor as slice [local rank] of a
+        [world, ...] global array sharded one-slice-per-process along
+        ``proc``."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         a = jnp.asarray(arr)
         return jax.make_array_from_single_device_arrays(
-            (self._world,) + a.shape,
-            NamedSharding(self._mesh, P("proc")),
+            ((world or self._world),) + a.shape,
+            NamedSharding(mesh if mesh is not None else self._mesh,
+                          P("proc")),
             [jax.device_put(a[None], self._local_device)],
         )
 
-    def _program(self, key, leaf, out_spec_sharded: bool):
+    def _program(self, key, leaf, out_spec_sharded: bool, mesh=None):
         """jit(shard_map) over the proc mesh, cached by signature — the
         steady-state fast path (compilation plays the role the response
         cache plays for negotiation)."""
@@ -489,7 +594,7 @@ class XlaExecutor:
             prog = jax.jit(
                 shard_map(
                     lambda s: leaf(s[0]),
-                    mesh=self._mesh,
+                    mesh=mesh if mesh is not None else self._mesh,
                     in_specs=P("proc"),
                     out_specs=P("proc") if out_spec_sharded else P(),
                     check_vma=False,
@@ -506,11 +611,11 @@ class XlaExecutor:
     # ------------------------------------------------------ op leaves
 
     def _reduce_leaf(self, reduce_op: int, prescale: float,
-                     postscale: float):
+                     postscale: float, n: Optional[int] = None):
         import jax.numpy as jnp
         from jax import lax
 
-        n = self._world
+        n = n or self._world
 
         def leaf(x):
             if prescale != 1.0:
@@ -585,6 +690,7 @@ class XlaExecutor:
         )
 
     def _run_allreduce(self, batch, tensors):
+        mesh, n, _, tag = self._batch_ctx(batch)
         inputs = self._materialize(batch, tensors)
         # pack the fused batch into one flat buffer -> ONE collective HLO
         # (the reference memcpys into the fusion buffer and issues one
@@ -592,14 +698,14 @@ class XlaExecutor:
         flats = [x.reshape(-1) for x in inputs]
         packed = np.concatenate(flats) if len(flats) > 1 else flats[0]
         leaf = self._reduce_leaf(
-            batch.reduce_op, batch.prescale, batch.postscale
+            batch.reduce_op, batch.prescale, batch.postscale, n
         )
         prog = self._program(
-            ("allreduce", packed.shape, str(packed.dtype), batch.reduce_op,
-             batch.prescale, batch.postscale),
-            leaf, out_spec_sharded=False,
+            ("allreduce", tag, packed.shape, str(packed.dtype),
+             batch.reduce_op, batch.prescale, batch.postscale),
+            leaf, out_spec_sharded=False, mesh=mesh,
         )
-        res = np.asarray(prog(self._global_stack(packed)))
+        res = np.asarray(prog(self._global_stack(packed, mesh, n)))
         out, off = {}, 0
         for name, x in zip(batch.names, inputs):
             n = x.size
@@ -612,8 +718,8 @@ class XlaExecutor:
         from jax import lax
         import jax.numpy as jnp
 
+        mesh, n, _, tag = self._batch_ctx(batch)
         inputs = self._materialize(batch, tensors)
-        n = self._world
         out = {}
         for name, x in zip(batch.names, inputs):
             reduce_op = batch.reduce_op
@@ -632,11 +738,11 @@ class XlaExecutor:
                 return y
 
             prog = self._program(
-                ("reducescatter", x.shape, str(x.dtype), reduce_op,
+                ("reducescatter", tag, x.shape, str(x.dtype), reduce_op,
                  prescale, postscale),
-                leaf, out_spec_sharded=True,
+                leaf, out_spec_sharded=True, mesh=mesh,
             )
-            res = self._local_shard(prog(self._global_stack(x)))
+            res = self._local_shard(prog(self._global_stack(x, mesh, n)))
             if name in tensors:
                 out[name] = res
         return out
@@ -644,7 +750,8 @@ class XlaExecutor:
     def _run_allgather(self, batch, tensors):
         from jax import lax
 
-        dims = [int(d) for d in batch.rank_dim0]
+        mesh, n, _, tag = self._batch_ctx(batch)
+        dims = [int(d) for d in batch.rank_dim0]  # set-local member order
         out = {}
         for i, name in enumerate(batch.names):
             x = (
@@ -672,10 +779,10 @@ class XlaExecutor:
                 return lax.all_gather(v, "proc", tiled=True)
 
             prog = self._program(
-                ("allgather", padded.shape, str(padded.dtype)),
-                leaf, out_spec_sharded=False,
+                ("allgather", tag, padded.shape, str(padded.dtype)),
+                leaf, out_spec_sharded=False, mesh=mesh,
             )
-            g = np.asarray(prog(self._global_stack(padded)))
+            g = np.asarray(prog(self._global_stack(padded, mesh, n)))
             if name not in tensors:
                 continue
             if dims and len(set(dims)) > 1:
@@ -691,8 +798,18 @@ class XlaExecutor:
         from jax import lax
         import jax.numpy as jnp
 
+        mesh, n, _, tag = self._batch_ctx(batch)
         inputs = self._materialize(batch, tensors)
+        # root_rank is a GLOBAL rank (reference semantics, also for
+        # process sets) — translate to the set-local mesh position
         root = batch.root_rank
+        if tag:
+            if root not in tag:
+                raise HorovodInternalError(
+                    f"broadcast root {root} is not a member of process "
+                    f"set {batch.process_set_id} ({list(tag)})"
+                )
+            root = tag.index(root)
         out = {}
         for name, x in zip(batch.names, inputs):
             def leaf(v):
@@ -707,10 +824,10 @@ class XlaExecutor:
                 return lax.psum(v * mask.astype(v.dtype), "proc")
 
             prog = self._program(
-                ("broadcast", x.shape, str(x.dtype), root),
-                leaf, out_spec_sharded=False,
+                ("broadcast", tag, x.shape, str(x.dtype), root),
+                leaf, out_spec_sharded=False, mesh=mesh,
             )
-            res = np.asarray(prog(self._global_stack(x)))
+            res = np.asarray(prog(self._global_stack(x, mesh, n)))
             if name in tensors:
                 out[name] = res
         return out
@@ -718,7 +835,7 @@ class XlaExecutor:
     def _run_alltoall(self, batch, tensors):
         from jax import lax
 
-        world, rank = self._world, self._rank
+        mesh, world, rank, tag = self._batch_ctx(batch)
         m = np.asarray(batch.all_splits, dtype=np.int64).reshape(
             (world, world)
         )
@@ -751,10 +868,12 @@ class XlaExecutor:
                 )
 
             prog = self._program(
-                ("alltoall", packed.shape, str(packed.dtype)),
-                leaf, out_spec_sharded=True,
+                ("alltoall", tag, packed.shape, str(packed.dtype)),
+                leaf, out_spec_sharded=True, mesh=mesh,
             )
-            res = self._local_shard(prog(self._global_stack(packed)))
+            res = self._local_shard(
+                prog(self._global_stack(packed, mesh, world))
+            )
             if name not in tensors:
                 continue
             parts = [

@@ -144,6 +144,47 @@ def main():
         for i in range(3)
     )
 
+    # 6c. process-set collectives through the negotiated path: every
+    # rank registers the set (synchronized, reference process_sets.py:123),
+    # members run subset ops over the set's sub-mesh, non-members run a
+    # concurrent global op — per-set controllers in action
+    # (reference process_set.h:89)
+    if size >= 3:
+        ps = hvd.add_process_set([0, size - 1])
+        ps_ok = True
+        if rank in (0, size - 1):
+            t = np.full((4,), float(rank + 1), dtype=np.float32)
+            red = np.asarray(
+                hvd.allreduce(t, op=hvd.Sum, process_set=ps, name="sub")
+            )
+            ps_ok = ps_ok and bool(np.allclose(red, 1.0 + size))
+            # subset broadcast from a GLOBAL root rank
+            b = np.asarray(hvd.broadcast(
+                np.full((3,), float(rank * 100), np.float32),
+                root_rank=size - 1, process_set=ps, name="sub_bc",
+            ))
+            ps_ok = ps_ok and bool(np.allclose(b, (size - 1) * 100))
+            # ragged subset allgather: member i contributes i+1 rows
+            local = ps.rank(rank)
+            rows = local + 1
+            g2 = np.asarray(hvd.allgather(
+                np.full((rows, 2), float(rank), np.float32),
+                process_set=ps, name="sub_rag",
+            ))
+            expect2 = np.concatenate([
+                np.full((i + 1, 2), float(r), np.float32)
+                for i, r in enumerate(ps.ranks)
+            ])
+            ps_ok = ps_ok and bool(np.array_equal(g2, expect2))
+        # all ranks (members included) meet in a global op afterwards so
+        # the world stays open and interleaving is exercised
+        t = np.full((2,), float(rank + 1), dtype=np.float32)
+        glob = np.asarray(hvd.allreduce(t, op=hvd.Sum, name="after_sub"))
+        ps_ok = ps_ok and bool(np.allclose(glob, s_world))
+        out["process_set_ok"] = ps_ok
+    else:
+        out["process_set_ok"] = True
+
     # 7. join: rank 0 runs out of data; the others keep reducing and the
     # joined rank contributes zeros through the XLA executor (reference
     # JoinOp, collective_operations.h:325)

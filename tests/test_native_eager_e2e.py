@@ -91,7 +91,7 @@ def test_native_eager_end_to_end(size):
         for key in (
             "allreduce_ok", "average_ok", "allgather_ok", "broadcast_ok",
             "reducescatter_ok", "alltoall_ok", "grouped_ok", "sparse_ok",
-            "join_ok",
+            "process_set_ok", "join_ok",
         ):
             assert out[r][key], f"rank {r}: {key} failed: {out[r]}"
         # the steady-state layer saw real traffic

@@ -598,3 +598,179 @@ def test_duplicate_name_rejected():
         assert "dup" in rt.last_error()
     finally:
         rt.shutdown()
+
+
+# ------------------------------------------------- per-set controllers
+# (reference process_set.h:89: each set negotiates independently; here
+# one transport carries every set's traffic, keyed by set id)
+
+
+def scenario_overlapping_sets(native, rt, rank, size):
+    # world 3; A=1:{0,1}, B=2:{1,2} — registration is world-wide
+    ra = rt.register_set(1, [0, 1])
+    rb = rt.register_set(2, [1, 2])
+    reg_states = [rt.wait(ra, 30.0), rt.wait(rb, 30.0)]
+    members = {1: rt.set_members(1), 2: rt.set_members(2)}
+    handles = []
+    # members submit only their sets' ops (qualified names, like the
+    # Python EagerRuntime does); rank 1 overlaps both
+    if rank in (0, 1):
+        handles.append(rt.enqueue("ps1:x", native.OP_ALLREDUCE, "float32",
+                                  [4], process_set_id=1))
+    if rank in (1, 2):
+        handles.append(rt.enqueue("ps2:y", native.OP_ALLREDUCE, "float32",
+                                  [8], process_set_id=2))
+    log = []
+    import time as _t
+    deadline = _t.time() + 30.0
+    pending = set(handles)
+    while pending and _t.time() < deadline:
+        batch = rt.next_batch(timeout_s=0.2)
+        if batch is not None:
+            log.append((batch.op, tuple(batch.names),
+                        batch.process_set_id, tuple(batch.set_ranks)))
+            rt.batch_done(batch, ok=True)
+        pending -= {h for h in pending
+                    if rt.poll(h) in (rt_mod_DONE, rt_mod_FAILED)}
+    states = [rt.poll(h) for h in handles]
+    # hold the world open until every rank is done: shutdown is a
+    # negotiated world-wide event, so an early-returning rank would kill
+    # peers' in-flight subset ops
+    _drain_until(rt, [rt.enqueue("fin", native.OP_ALLREDUCE, "float32",
+                                 [2])], timeout_s=20.0)
+    return {"reg": reg_states, "members": members, "log": log,
+            "states": states}
+
+
+def test_overlapping_sets_negotiate_independently():
+    """Two overlapping sets: each negotiates among its own members, a
+    rank sees only its sets' batches, and batches carry the set's
+    sub-mesh membership (reference process_set.h:89)."""
+    out = _run_world(3, scenario_overlapping_sets)
+    for r in range(3):
+        assert out[r]["reg"] == [rt_mod_DONE, rt_mod_DONE]
+        assert out[r]["members"] == {1: [0, 1], 2: [1, 2]}
+        assert all(s == rt_mod_DONE for s in out[r]["states"])
+    sets_seen = lambda r: {e[2] for e in out[r]["log"]}
+    assert sets_seen(0) == {1}      # never sees set 2's batches
+    assert sets_seen(2) == {2}      # never sees set 1's batches
+    assert sets_seen(1) == {1, 2}   # overlap executes both
+    for e in out[1]["log"]:
+        assert e[3] == ((0, 1) if e[2] == 1 else (1, 2))
+
+
+def scenario_set_mismatch(native, rt, rank, size):
+    ranks = [0, 1] if rank == 0 else [0]
+    h = rt.register_set(1, ranks)
+    state = rt.wait(h, 20.0)
+    return {"state": state, "err": rt.last_error()}
+
+
+def test_set_registration_mismatch_fails_consistently():
+    """Mismatched membership across ranks fails registration on every
+    rank through the ordinary metadata-validation channel."""
+    out = _run_world(2, scenario_set_mismatch)
+    for r in range(2):
+        assert out[r]["state"] == rt_mod_FAILED
+
+
+def scenario_nonmember_enqueue(native, rt, rank, size):
+    h = rt.register_set(1, [0])
+    assert rt.wait(h, 30.0) == rt_mod_DONE
+    # BOTH ranks enqueue the same qualified name into set 1: the member's
+    # op must complete even though the non-member's errors — per-rank
+    # error targeting (Response.error_rank)
+    hh = rt.enqueue("ps1:z", native.OP_ALLREDUCE, "float32", [4],
+                    process_set_id=1)
+    _drain_until(rt, [hh], timeout_s=20.0)
+    state, err = rt.poll(hh), rt.last_error()
+    # hold the world open (negotiated shutdown; see overlapping_sets)
+    _drain_until(rt, [rt.enqueue("fin", native.OP_ALLREDUCE, "float32",
+                                 [2])], timeout_s=20.0)
+    return {"state": state, "err": err}
+
+
+def test_nonmember_enqueue_fails_only_offender():
+    out = _run_world(2, scenario_nonmember_enqueue)
+    assert out[0]["state"] == rt_mod_DONE
+    assert out[1]["state"] == rt_mod_FAILED
+    assert "not a member" in out[1]["err"]
+
+
+def scenario_set_cache(native, rt, rank, size):
+    h = rt.register_set(1, [0, 1])
+    assert rt.wait(h, 30.0) == rt_mod_DONE
+    for _ in range(4):
+        hs = []
+        if rank in (0, 1):
+            hs.append(rt.enqueue("ps1:g", native.OP_ALLREDUCE, "float32",
+                                 [16], process_set_id=1))
+        hs.append(rt.enqueue("glob", native.OP_ALLREDUCE, "float32", [16]))
+        _drain_until(rt, hs, timeout_s=20.0)
+    return {"cache_hits": rt.cache_hits()}
+
+
+def test_subset_ops_ride_the_cache_fast_path():
+    """Member-scoped cache agreement: subset tensors cache-hit for the
+    members even though non-members never claim the position (a
+    world-wide AND would disable the fast path for every subset op)."""
+    out = _run_world(3, scenario_set_cache)
+    assert out[0]["cache_hits"] >= 2   # member: ps1:g + glob hits
+    assert out[1]["cache_hits"] >= 2
+    assert out[2]["cache_hits"] >= 1   # non-member still hits on glob
+
+
+def scenario_set_barrier(native, rt, rank, size):
+    h = rt.register_set(1, [0, 2])
+    assert rt.wait(h, 30.0) == rt_mod_DONE
+    state = None
+    if rank in (0, 2):
+        hb = rt.enqueue("ps1:__barrier__", native.OP_BARRIER, "uint8", [],
+                        process_set_id=1)
+        _drain_until(rt, [hb], timeout_s=20.0)
+        state = rt.poll(hb)
+    # hold the world open (negotiated shutdown; see overlapping_sets):
+    # the non-member completes this only after the members passed their
+    # barrier and submitted theirs
+    _drain_until(rt, [rt.enqueue("fin", native.OP_ALLREDUCE, "float32",
+                                 [2])], timeout_s=20.0)
+    return {"state": state}
+
+
+def test_subset_barrier_completes_for_members_only():
+    out = _run_world(3, scenario_set_barrier)
+    assert out[0]["state"] == rt_mod_DONE
+    assert out[2]["state"] == rt_mod_DONE
+    assert out[1]["state"] is None
+
+
+def scenario_deregister(native, rt, rank, size):
+    h = rt.register_set(1, [0, 1])
+    assert rt.wait(h, 30.0) == rt_mod_DONE
+    stranded_state = None
+    if rank == 0:
+        # submitted on one rank only: the deregistration must fail it
+        # instead of leaving it pending forever
+        hs = rt.enqueue("ps1:stranded", native.OP_ALLREDUCE, "float32",
+                        [4], process_set_id=1)
+    hd = rt.deregister_set(1)
+    state = rt.wait(hd, 30.0)
+    if rank == 0:
+        s = rt.wait(hs, 20.0)
+        while s in (0, 1):
+            batch = rt.next_batch(timeout_s=0.2)
+            if batch is not None:
+                rt.batch_done(batch, ok=True)
+            s = rt.wait(hs, 5.0)
+        stranded_state = s
+    members = rt.set_members(1)
+    return {"state": state, "stranded": stranded_state,
+            "members": members, "err": rt.last_error()}
+
+
+def test_deregistered_set_fails_stranded_tensors():
+    out = _run_world(2, scenario_deregister)
+    for r in range(2):
+        assert out[r]["state"] == rt_mod_DONE
+        assert out[r]["members"] is None
+    assert out[0]["stranded"] == rt_mod_FAILED
