@@ -774,3 +774,85 @@ def test_deregistered_set_fails_stranded_tensors():
         assert out[r]["state"] == rt_mod_DONE
         assert out[r]["members"] is None
     assert out[0]["stranded"] == rt_mod_FAILED
+
+
+# ------------------------------------------------ crash-mid-cycle
+# (reference controller.cc:252-270 lost-connection path: a dead rank
+# must surface as a consistent error on every survivor, never a hang)
+
+
+def _worker_crash(rank, size, port, victim, q):
+    import os
+    import signal
+
+    native = _load_native()
+    rt = native.NativeRuntime()
+    rt.init(rank, size, "127.0.0.1", port, cycle_ms=1.0, cache_capacity=64)
+    # one completed collective proves the world was fully connected
+    h = rt.enqueue("warm", native.OP_ALLREDUCE, "float32", [4])
+    _drain_until(rt, [h], timeout_s=30.0)
+    if rt.poll(h) != rt_mod_DONE:
+        q.put((rank, "warm-failed", rt.last_error()))
+        rt.shutdown()
+        return
+    if rank == victim:
+        os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, mid-world
+    h2 = rt.enqueue("after", native.OP_ALLREDUCE, "float32", [4])
+    deadline = time.time() + 45.0
+    state = rt.poll(h2)
+    while state in (0, 1) and time.time() < deadline:
+        batch = rt.next_batch(timeout_s=0.2)
+        if batch is not None:
+            rt.batch_done(batch, ok=True)
+        state = rt.poll(h2)
+    q.put((rank, state, rt.last_error()))
+    # do NOT rt.shutdown(): the broken world's negotiated shutdown can't
+    # complete; the background loop already exited via the error path
+
+
+def _run_crash_world(size, victim, timeout_s=90.0):
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker_crash, args=(r, size, port, victim, q))
+        for r in range(size)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = time.time() + timeout_s
+    while len(results) < size - 1 and time.time() < deadline:
+        try:
+            rank, state, err = q.get(timeout=1.0)
+            results[rank] = (state, err)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(timeout=10)
+        if p.is_alive():
+            p.terminate()
+    return results
+
+
+def test_worker_crash_mid_cycle_errors_survivors():
+    """kill -9 a worker rank between collectives: every survivor's next
+    op must FAIL with the lost-connection error, not hang (reference
+    controller.cc:252-270)."""
+    out = _run_crash_world(3, victim=2)
+    assert sorted(out) == [0, 1], f"survivors missing: {out}"
+    for r in (0, 1):
+        state, err = out[r]
+        assert state == rt_mod_FAILED, f"rank {r} state={state} err={err}"
+        assert "lost connection" in err or "rank 2" in err, err
+
+
+def test_coordinator_crash_errors_workers():
+    """kill -9 the coordinator: workers' transport fails and their
+    pending ops raise instead of blocking forever."""
+    out = _run_crash_world(3, victim=0)
+    assert sorted(out) == [1, 2], f"survivors missing: {out}"
+    for r in (1, 2):
+        state, err = out[r]
+        assert state == rt_mod_FAILED, f"rank {r} state={state} err={err}"
+        assert "lost connection" in err, err
