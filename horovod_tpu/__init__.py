@@ -103,6 +103,7 @@ from .optim import (  # noqa: F401
 # Elastic + timeline live under their own namespaces, mirroring
 # hvd.elastic.* and hvd.start_timeline in the reference.
 from . import callbacks  # noqa: F401
+from .checkpoint import LoadedModel, load_model, save_model  # noqa: F401
 from . import data  # noqa: F401
 from . import elastic  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401
