@@ -46,11 +46,16 @@ class ElasticDriver:
         command: List[str],
         env: Dict[str, str],
         exec_fn: Optional[Callable] = None,
+        nics: Optional[List[str]] = None,
     ):
         self._host_manager = host_manager
         self._settings = settings
         self._command = list(command)
         self._env = dict(env)
+        # explicit --network-interface pins control-plane binding for
+        # every elastic round (auto ring-probing per round would add a
+        # discovery round-trip to each respawn; explicit only)
+        self._nics = list(nics) if nics else None
         if ENV_SECRET not in self._env:
             self._env[ENV_SECRET] = make_secret_key().decode()
         self._exec_fn = exec_fn
@@ -148,6 +153,7 @@ class ElasticDriver:
                     self._env,
                     rendezvous=self._rendezvous,
                     exec_fn=self._wrap_exec(),
+                    nics=self._nics,
                 )
             finally:
                 spawn_done.set()

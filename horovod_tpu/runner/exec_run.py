@@ -89,19 +89,26 @@ def _exec_local(command: List[str], env, slot: SlotInfo, events) -> int:
     )
 
 
-def _exec_ssh(command: List[str], env, slot: SlotInfo, events) -> int:
+def _remote_command(command: List[str], env) -> str:
+    """The `cd && env ... cmd` line a remote shell runs: exports the
+    control-plane env plus PATH/PYTHON* so venv/PYTHONPATH setups that
+    work locally keep working over ssh."""
     exported = " ".join(
         f"{k}={shlex.quote(v)}"
         for k, v in env.items()
         if k.startswith(("HOROVOD_", "HVD_TPU_", "PYTHON")) or k == "PATH"
     )
-    remote = f"cd {shlex.quote(os.getcwd())} && env {exported} " + " ".join(
+    return f"cd {shlex.quote(os.getcwd())} && env {exported} " + " ".join(
         shlex.quote(c) for c in command
     )
+
+
+def _exec_ssh(command: List[str], env, slot: SlotInfo, events) -> int:
     # -tt allocates a tty so the remote worker gets SIGHUP when the local
     # ssh client is killed — no orphan trainers holding TPU chips
     ssh_cmd = [
-        "ssh", "-tt", "-o", "StrictHostKeyChecking=no", slot.hostname, remote,
+        "ssh", "-tt", "-o", "StrictHostKeyChecking=no", slot.hostname,
+        _remote_command(command, env),
     ]
     return safe_shell_exec.execute(
         ssh_cmd, env=dict(os.environ), prefix=f"{slot.rank}", events=events
@@ -115,6 +122,8 @@ def launch_slots(
     rendezvous: Optional[RendezvousServer] = None,
     exec_fn: Optional[Callable] = None,
     local_hosts: Optional[List[str]] = None,
+    nics: Optional[List[str]] = None,
+    nics_explicit: bool = True,
 ) -> List[int]:
     """Spawn one worker per slot; any failure terminates all others.
 
@@ -131,6 +140,24 @@ def launch_slots(
         port = rendezvous.port
     local = set(local_hosts) if local_hosts else None
     rendezvous_addr = routable_host_address()
+    if nics:
+        env = dict(env)
+        env["HOROVOD_NICS"] = ",".join(nics)
+        # Rebind the launcher's rendezvous address only for an EXPLICIT
+        # --network-interface: the user names a launcher NIC and gets it
+        # verbatim. Auto-probed NICs were validated for WORKER-to-worker
+        # routability — the launcher never probed itself, and a launcher
+        # NIC that merely shares the name could carry an address workers
+        # cannot route (reference ships probed NICs to NCCL/Gloo but
+        # keeps its own service on all addresses, driver_service.py:260).
+        if nics_explicit:
+            from .driver.probe import interface_addresses
+
+            by_iface = interface_addresses(nics)
+            for nic in nics:
+                if nic in by_iface:
+                    rendezvous_addr = by_iface[nic]
+                    break
     # The JAX coordination service runs inside the rank-0 *worker*, so the
     # coordinator address must name rank 0's host, not the launcher. For a
     # local rank-0 we can probe a free port; for a remote one use a
@@ -183,15 +210,80 @@ def launch_slots(
     return [c if c is not None else 1 for c in codes]
 
 
+def probe_task_launcher(env: Dict[str, str]) -> Callable:
+    """launch_task_fn for driver.probe.get_common_interfaces: start one
+    probe task per host (local exec or ssh), detached — the task
+    registers with the driver service and exits on its shutdown request
+    (reference _launch_task_servers, driver_service.py:90)."""
+    import base64
+    import json
+
+    secret = env.get(ENV_SECRET, os.environ.get(ENV_SECRET, ""))
+
+    def launch(idx: int, host: str, driver_addresses) -> None:
+        b64 = base64.b64encode(
+            json.dumps([list(a) for a in driver_addresses]).encode()
+        ).decode()
+        # "python" resolves via the exported PATH on the remote host —
+        # the launcher's sys.executable path may not exist there
+        cmd = [
+            "python", "-m", "horovod_tpu.runner.driver.probe_task",
+            str(idx), b64,
+        ]
+        task_env = dict(os.environ)
+        task_env[ENV_SECRET] = secret
+
+        def run():
+            if is_local_host(host):
+                local_cmd = [sys.executable] + cmd[1:]
+                safe_shell_exec.execute(local_cmd, env=task_env,
+                                        prefix=f"probe-{idx}")
+            else:
+                # same env-export contract as worker ssh (_exec_ssh):
+                # PATH/PYTHON* travel so venv setups keep working
+                safe_shell_exec.execute(
+                    ["ssh", "-tt", "-o", "StrictHostKeyChecking=no",
+                     host, _remote_command(cmd, task_env)],
+                    env=dict(os.environ), prefix=f"probe-{idx}",
+                )
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"probe-task-{idx}").start()
+
+    return launch
+
+
 def run_static(
     command: List[str],
     hosts: List[HostInfo],
     np: int,
     env: Optional[Dict[str, str]] = None,
     exec_fn: Optional[Callable] = None,
+    nics: Optional[List[str]] = None,
 ) -> List[int]:
-    """Static (non-elastic) launch: assignments once, run to completion."""
+    """Static (non-elastic) launch: assignments once, run to completion.
+
+    With remote hosts and no explicit `nics`, the task-to-task NIC probe
+    runs first and the control plane binds only interfaces every host
+    can actually route (reference driver_service.py:260)."""
     assignments = get_host_assignments(hosts, np, np)
+    env = dict(env or os.environ)
+    if ENV_SECRET not in env:
+        from .util.secret import make_secret_key
+
+        env[ENV_SECRET] = make_secret_key().decode()
+    host_names = [h.hostname for h in hosts]
+    explicit = bool(nics)
+    if exec_fn is None and (
+        nics or any(not is_local_host(h) for h in host_names)
+    ):
+        from .driver.probe import get_common_interfaces
+
+        nics = get_common_interfaces(
+            host_names, env[ENV_SECRET].encode(), nics=nics,
+            launch_task_fn=probe_task_launcher(env),
+        )
     return launch_slots(
-        command, assignments, dict(env or os.environ), exec_fn=exec_fn
+        command, assignments, env, exec_fn=exec_fn, nics=nics,
+        nics_explicit=explicit,
     )

@@ -154,7 +154,10 @@ def _run_static(args) -> int:
     if args.np is None:
         args.np = sum(h.slots for h in hosts)
     env = config_parser.env_from_args(args, dict(os.environ))
-    codes = run_static(args.command, hosts, args.np, env=env)
+    codes = run_static(
+        args.command, hosts, args.np, env=env,
+        nics=args.nics.split(",") if args.nics else None,
+    )
     # signal-killed workers report negative codes; any nonzero is failure
     failed = [c for c in codes if c != 0]
     return abs(failed[0]) if failed else (0 if codes else 1)
@@ -187,6 +190,7 @@ def _run_elastic(args) -> int:
         settings,
         command=args.command,
         env=env,
+        nics=args.nics.split(",") if args.nics else None,
     )
     return driver.run()
 

@@ -18,6 +18,7 @@ from horovod_tpu.runner.util.network import (
     BasicClient,
     BasicService,
     Wire,
+    find_free_port,
 )
 from horovod_tpu.runner.util.secret import make_secret_key
 from horovod_tpu.runner.http import http_client
@@ -314,3 +315,134 @@ def test_run_static_failure_kills_all():
     )
     assert codes[0] == 1
     assert codes[1] == 143  # terminated by the failure event
+
+
+# ------------------------------------------------- NIC routability probe
+# (reference driver_service.py:260 get_common_interfaces: tasks ring-
+# probe each other's advertised interface addresses, the driver
+# intersects the routable sets)
+
+
+def test_ring_probe_filters_dark_interfaces():
+    """Each task advertises a reachable NIC and a dark one (an address
+    nothing routes); the ring intersection must keep only the NIC every
+    hop could actually reach."""
+    from horovod_tpu.runner.driver.probe import (
+        TaskProbeService,
+        find_common_nics,
+    )
+    from horovod_tpu.runner.util.secret import make_secret_key
+
+    key = make_secret_key()
+    tasks = [
+        TaskProbeService(
+            i, key,
+            advertised={
+                "eth0": "127.0.0.1",
+                # dark NIC: an endpoint nothing listens on (the sandbox
+                # NATs TEST-NET ips, so a dead local port is the
+                # reliable unreachable address here)
+                "ib0": ("127.0.0.1", find_free_port()),
+            },
+        )
+        for i in range(3)
+    ]
+    try:
+        addrs = [t.addresses() for t in tasks]
+        nics = find_common_nics(addrs, key)
+        assert nics == ["eth0"]
+    finally:
+        for t in tasks:
+            t.shutdown()
+
+
+def test_ring_probe_raises_without_common_interface():
+    from horovod_tpu.runner.driver.probe import (
+        TaskProbeService,
+        find_common_nics,
+    )
+    from horovod_tpu.runner.util.secret import make_secret_key
+
+    key = make_secret_key()
+    tasks = [
+        TaskProbeService(
+            i, key, advertised={"ib0": ("127.0.0.1", find_free_port())}
+        )
+        for i in range(2)
+    ]
+    try:
+        addrs = [t.addresses() for t in tasks]
+        with pytest.raises(RuntimeError, match="no common routable"):
+            find_common_nics(addrs, key)
+    finally:
+        for t in tasks:
+            t.shutdown()
+
+
+def test_probe_task_registration_flow():
+    """Full driver flow with REAL probe-task subprocesses: driver
+    launches them, they register, ring probe intersects, shutdown
+    request ends them (reference _driver_fn, driver_service.py:163)."""
+    import subprocess
+    import sys
+
+    from horovod_tpu.runner.driver.probe import get_common_interfaces
+    from horovod_tpu.runner.util.secret import ENV_SECRET, make_secret_key
+
+    key = make_secret_key()
+    procs = []
+
+    def launch(idx, host, driver_addresses):
+        import base64
+        import json
+
+        b64 = base64.b64encode(
+            json.dumps([list(a) for a in driver_addresses]).encode()
+        ).decode()
+        env = dict(os.environ)
+        env[ENV_SECRET] = key.decode()
+        env["PYTHONPATH"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m",
+             "horovod_tpu.runner.driver.probe_task", str(idx), b64,
+             "--linger-s", "30"],
+            env=env,
+        ))
+
+    # a fake remote hostname forces the probe path; the injected
+    # launcher runs the tasks locally
+    nics = get_common_interfaces(
+        ["fake-remote-a", "fake-remote-b"], key,
+        launch_task_fn=launch, timeout_s=30.0,
+    )
+    assert nics  # at least one common interface on one machine
+    for p in procs:
+        assert p.wait(timeout=15) == 0  # shutdown request ended them
+
+
+def test_run_static_binds_probed_nic(monkeypatch):
+    """launch_slots with explicit nics exports HOROVOD_NICS and binds
+    the rendezvous address to the named NIC's ip."""
+    import horovod_tpu.runner.driver.probe as probe_mod
+    from horovod_tpu.runner.exec_run import launch_slots
+    from horovod_tpu.runner.util.hosts import get_host_assignments
+
+    monkeypatch.setattr(
+        probe_mod, "interface_addresses",
+        lambda nics=None: {"ethX": "127.0.0.1"},
+    )
+    seen = {}
+
+    def fake_exec(command, env, slot, events):
+        seen[slot.rank] = (env.get("HOROVOD_NICS"),
+                           env.get("HVD_TPU_RENDEZVOUS_ADDR"))
+        return 0
+
+    assignments = get_host_assignments(parse_hosts("localhost:2"), 2, 2)
+    codes = launch_slots(["x"], assignments, {}, exec_fn=fake_exec,
+                         nics=["ethX"])
+    assert codes == [0, 0]
+    assert seen[0] == ("ethX", "127.0.0.1")
+    assert seen[1] == ("ethX", "127.0.0.1")
