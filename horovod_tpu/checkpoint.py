@@ -116,6 +116,23 @@ def save_model(
     ckptr.wait_until_finished()
 
 
+def load_params(path: str):
+    """Params-only restore: (params, metadata) as host arrays, no
+    optimizer rebuild. The inference-side counterpart of load_model —
+    transformers (spark estimator models) need weights, not momenta."""
+    import jax
+
+    path = os.path.abspath(path)
+    with open(os.path.join(path, _SPEC_FILE)) as f:
+        spec = json.load(f)
+    ckptr = _checkpointer()
+    raw = ckptr.restore(os.path.join(path, _TREE_DIR))
+    import numpy as np
+
+    params = jax.tree_util.tree_map(lambda x: np.asarray(x), raw["params"])
+    return params, dict(spec.get("metadata", {}))
+
+
 def load_model(
     path: str,
     custom_optimizers: Optional[Dict[str, Callable]] = None,

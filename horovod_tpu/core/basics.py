@@ -161,6 +161,15 @@ def init(
         coord = os.environ.get("HVD_TPU_COORDINATOR_ADDRESS")
         from jax._src import distributed as _jax_distributed
 
+        # an EXPLICITLY 1-process world needs no coordination service —
+        # connecting would only add a hang risk when the advertised
+        # coordinator is unreachable (e.g. Spark local mode publishing a
+        # cluster addr). A coordinator with NUM_PROCESSES unset stays a
+        # loud KeyError below: silently training N independent worlds
+        # would be far worse than crashing.
+        nproc = os.environ.get("HVD_TPU_NUM_PROCESSES")
+        if nproc is not None and int(nproc) <= 1:
+            coord = None
         if coord and _jax_distributed.global_state.client is None:
             try:
                 # CPU test worlds need cross-process collectives; the TPU

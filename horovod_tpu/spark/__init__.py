@@ -5,9 +5,10 @@ Reference: /root/reference/horovod/spark/runner.py:200 (`horovod.spark.run`)
 info, assigns ranks, and results return through Spark. This adapter keeps
 that shape: one Spark barrier task per slot, slot env injected via the
 same launcher protocol (exec_run.slot_env), results collected from the
-tasks. Estimator APIs (KerasEstimator/TorchEstimator over Petastorm
-stores, reference spark/keras/estimator.py) are out of scope for the TPU
-build: on TPU, data feeding is jax-native (data/ShardedDataLoader).
+tasks. Estimator APIs live in .estimator (JaxEstimator/TorchEstimator —
+the reference's KerasEstimator/TorchEstimator re-designed without the
+Petastorm store: on TPU, data feeding is jax-native numpy shards;
+data/ShardedDataLoader covers bigger-than-driver datasets outside Spark).
 
 Import is gated: pyspark is an optional dependency.
 """
@@ -200,3 +201,14 @@ def run_elastic(
                     flush=True,
                 )
             _time.sleep(1.0)  # backoff before resubmitting the round
+
+
+# Estimator API (reference spark/keras/estimator.py, spark/torch/
+# estimator.py): imported at the bottom — estimator.py's fit() calls
+# back into this module's run().
+from .estimator import (  # noqa: E402,F401
+    JaxEstimator,
+    JaxModel,
+    TorchEstimator,
+    TorchModel,
+)
