@@ -30,14 +30,16 @@ def main():
     bert = load_example("bert_pretraining")
 
     # 5 timed windows; median rides out the axon tunnel's occasional
-    # spurious-fast first window
+    # spurious-fast first window. Batch sizes are the measured-best
+    # per-chip configs on v5e (r3 sweep: ResNet 256 > 128/512; BERT 24
+    # is the largest that fits without remat and beats 8/16/32+remat).
     img_per_chip, resnet_mfu = resnet.main(
         ["--num-iters", "5", "--num-batches-per-iter", "10",
-         "--num-warmup-batches", "3"]
+         "--num-warmup-batches", "3", "--batch-size", "256"]
     )
     tok_per_chip, bert_mfu = bert.main(
         ["--num-iters", "3", "--num-batches-per-iter", "5",
-         "--num-warmup-batches", "2"]
+         "--num-warmup-batches", "2", "--batch-size", "24"]
     )
 
     print(

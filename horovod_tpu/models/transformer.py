@@ -7,7 +7,7 @@ keras / user models) — these are TPU-first implementations built for this
 framework's benchmarks and examples.
 
 TPU-first choices:
-  * bfloat16 activations/weights with float32 layernorm + logits
+  * bfloat16 activations/weights (LM head included) with float32 layernorm; logits upcast to float32 inside the loss
   * shapes padded to MXU tiles (head_dim multiples of 128 recommended)
   * pluggable attention: `attention_fn` lets the parallel layer swap in
     ring attention (parallel/ring_attention.py) or Ulysses all-to-all
@@ -275,11 +275,15 @@ class Transformer(nn.Module):
             x = block(cfg, attention_fn=self.attention_fn,
                       name=f"block_{i}")(x, positions, mask)
         x = _norm(cfg, "ln_final")(x)
+        # LM head matmul stays in the model compute dtype (bf16 on the
+        # MXU fast path — an f32 [B,T,H]x[H,V] here is the single
+        # largest matmul in the model at a fraction of peak); the loss
+        # fns upcast the logits to f32 for logsumexp stability.
         if cfg.tie_embeddings:
-            logits = emb.attend(x.astype(jnp.float32))
+            logits = emb.attend(x)
         else:
             logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                 param_dtype=jnp.float32, name="lm_head",
                 kernel_init=nn.initializers.normal(0.02),
             )(x)
@@ -288,15 +292,26 @@ class Transformer(nn.Module):
 
 # -- task heads / losses ----------------------------------------------------
 
+def _gather_nll(lg, targets):
+    """Per-position cross-entropy via gather: logsumexp(lg) - lg[target].
+    One pass over the [B, T, V] logits instead of materializing a
+    [B, T, V] float32 one-hot AND a log_softmax copy — at BERT/GPT vocab
+    sizes those intermediates are hundreds of MB of pure HBM traffic."""
+    lse = jax.scipy.special.logsumexp(lg, axis=-1)
+    tgt = jnp.take_along_axis(lg, targets[..., None], axis=-1)[..., 0]
+    return lse - tgt
+
+
 def causal_lm_loss(logits, tokens, ignore_index: int = -1):
     """Next-token cross-entropy; returns (loss, n_tokens). float32."""
     targets = tokens[:, 1:]
     lg = logits[:, :-1].astype(jnp.float32)
     valid = targets != ignore_index
-    onehot = jax.nn.one_hot(targets, lg.shape[-1], dtype=jnp.float32)
-    logp = jax.nn.log_softmax(lg, axis=-1)
-    nll = -jnp.sum(onehot * logp, axis=-1)
-    nll = jnp.where(valid, nll, 0.0)
+    # out-of-range ids (sentinels, padding artifacts) must not index the
+    # gather — one_hot gave them a zero row, i.e. zero contribution
+    in_range = (targets >= 0) & (targets < lg.shape[-1])
+    nll = _gather_nll(lg, jnp.where(in_range, targets, 0))
+    nll = jnp.where(valid & in_range, nll, 0.0)
     n = jnp.maximum(jnp.sum(valid), 1)
     return jnp.sum(nll) / n, n
 
@@ -304,10 +319,9 @@ def causal_lm_loss(logits, tokens, ignore_index: int = -1):
 def mlm_loss(logits, labels, mask_positions):
     """BERT masked-LM loss: `labels` at `mask_positions` (bool [B,T])."""
     lg = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(lg, axis=-1)
-    onehot = jax.nn.one_hot(labels, lg.shape[-1], dtype=jnp.float32)
-    nll = -jnp.sum(onehot * logp, axis=-1)
-    nll = jnp.where(mask_positions, nll, 0.0)
+    in_range = (labels >= 0) & (labels < lg.shape[-1])
+    nll = _gather_nll(lg, jnp.where(in_range, labels, 0))
+    nll = jnp.where(mask_positions & in_range, nll, 0.0)
     n = jnp.maximum(jnp.sum(mask_positions), 1)
     return jnp.sum(nll) / n, n
 
