@@ -42,3 +42,13 @@ def test_resnet_synthetic_tiny():
          "--num-classes", "10", "--bf16-allreduce"]
     )
     assert per_chip > 0
+
+
+def test_llama_adasum_converges():
+    """BASELINE config 4's architecture for real: RMSNorm/RoPE/SwiGLU
+    Llama with the Adasum optimizer path, at smoke scale."""
+    first, last = _load("llama_adasum").main(
+        ["--steps", "14", "--layers", "2", "--hidden", "256",
+         "--vocab", "256", "--seq-len", "64", "--batch-size", "1"]
+    )
+    assert last < first - 0.3, (first, last)
