@@ -14,3 +14,9 @@ from .ulysses import (  # noqa: F401
     padded_alltoall,
     ulysses_attention,
 )
+from .pipeline import (  # noqa: F401
+    gpipe,
+    pipeline_lm_apply,
+    stack_block_params,
+    unstack_block_params,
+)

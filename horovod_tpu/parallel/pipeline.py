@@ -1,0 +1,267 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a `pp` mesh axis.
+
+Beyond the reference: Horovod has no pipeline layer at all (SURVEY.md
+§2.5 — TP/PP absent; users hand-roll on process sets). TPU-native
+pipelining is a natural extension of the same design language as the
+rest of `parallel/`: a `shard_map` over the `pp` axis in which every
+stage runs the SAME traced program, activations hop stage→stage with
+`lax.ppermute`, and the whole schedule sits inside one jitted train
+step so XLA overlaps the point-to-point transfers with stage compute.
+
+Shape of the thing (the scaling-book recipe):
+
+  * layer weights are STACKED: each transformer block's params become
+    leading-dim `L` arrays, sharded `P("pp")` on that dim — stage `i`
+    holds layers `[i*L/S, (i+1)*L/S)`, and inside the shard_map applies
+    its local stack with `lax.scan` (one compiled block body, not L
+    unrolled copies);
+  * the batch is split into `M` microbatches; tick `t` of `M + S - 1`
+    feeds microbatch `t` into stage 0 while stages `1..S-1` consume the
+    activation ppermuted from their predecessor on tick `t-1` (the
+    GPipe bubble is the first/last `S-1` ticks);
+  * embedding and LM head stay OUTSIDE the pipelined region (they are
+    not per-layer weights); the last stage's outputs are returned to
+    every rank with a masked psum.
+
+Backward needs no separate schedule: `ppermute` and `scan` are
+differentiable, so `jax.grad` of a pipelined loss replays the schedule
+in reverse — the 1F1B-style overlap falls out of XLA's scheduling of
+the transposed program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.transformer import TransformerConfig
+
+
+def stack_block_params(params: dict, prefix: str = "block_"):
+    """Split a Transformer param dict into (stacked_blocks, rest):
+    `stacked_blocks` has every `block_i` subtree stacked on a new
+    leading layer dim (requires homogeneous blocks — true for this
+    model family); `rest` keeps embedding/head/final-norm params."""
+    blocks = {k: v for k, v in params.items() if k.startswith(prefix)}
+    rest = {k: v for k, v in params.items() if not k.startswith(prefix)}
+    orderd = [blocks[f"{prefix}{i}"] for i in range(len(blocks))]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *orderd
+    )
+    return stacked, rest
+
+
+def unstack_block_params(stacked, rest: dict, prefix: str = "block_"):
+    """Inverse of stack_block_params (checkpoint interchange)."""
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    out = dict(rest)
+    for i in range(n):
+        out[f"{prefix}{i}"] = jax.tree_util.tree_map(
+            lambda x: x[i], stacked
+        )
+    return out
+
+
+def gpipe(
+    block_apply: Callable,
+    stacked_params,
+    h,
+    *extra,
+    axis: str = "pp",
+    num_microbatches: int = 2,
+):
+    """GPipe schedule — call INSIDE shard_map over `axis`.
+
+    `block_apply(block_params, h, *extra) -> h` applies one layer;
+    `stacked_params` is this stage's local `[L_local, ...]` stack;
+    `h` is the full-batch input `[B, ...]` (replicated across stages);
+    returns the full-batch output, valid on every stage (masked psum
+    from the last stage).
+    """
+    S = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    M = num_microbatches
+    B = h.shape[0]
+    assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+    mb = B // M
+    xs = h.reshape((M, mb) + h.shape[1:])
+
+    def stage(p_stack, u, *e):
+        # this stage's layers, one compiled body via scan
+        def body(carry, p):
+            return block_apply(p, carry, *e), None
+
+        out, _ = lax.scan(body, u, p_stack)
+        return out
+
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        recv, outs = carry
+        # stage 0 ingests microbatch t (zeros once the batch is drained —
+        # bubble ticks); later stages consume their predecessor's send
+        feed = xs[jnp.minimum(t, M - 1)]
+        live = jnp.asarray(t < M, dtype=h.dtype)
+        u = jnp.where(idx == 0, feed * live, recv)
+        y = stage(stacked_params, u, *extra)
+        nxt = lax.ppermute(y, axis, fwd_perm)
+        # last stage completes microbatch t-(S-1) at tick t
+        done_slot = t - (S - 1)
+        outs = lax.cond(
+            done_slot >= 0,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(done_slot, 0), axis=0
+            ),
+            lambda o: o,
+            outs,
+        )
+        return (nxt, outs), None
+
+    outs0 = jnp.zeros((M, mb) + h.shape[1:], dtype=h.dtype)
+    (_, outs), _ = lax.scan(
+        tick, (jnp.zeros((mb,) + h.shape[1:], h.dtype), outs0),
+        jnp.arange(M + S - 1),
+    )
+    # only the LAST stage's collected outputs are the real ones
+    mask = (idx == (S - 1)).astype(h.dtype)
+    outs = lax.psum(outs * mask, axis)
+    return outs.reshape((B,) + h.shape[1:])
+
+
+def pipeline_lm_apply(
+    cfg: TransformerConfig,
+    params: dict,
+    tokens,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    attention_fn: Optional[Callable] = None,
+):
+    """Full LM forward with the block stack pipelined over `pp`.
+
+    `params` is the ordinary Transformer param dict (un-stacked);
+    embedding + positions + final norm + head run replicated outside
+    the pipelined region. Returns logits [B, T, V].
+    """
+    stacked, rest = stack_block_params(params)
+    n_layers = cfg.num_layers
+    assert "pp" in mesh.shape, (
+        f"pipeline_lm_apply needs a 'pp' mesh axis; got {mesh.axis_names}"
+    )
+    S = mesh.shape["pp"]
+    assert n_layers % S == 0, (
+        f"{n_layers} layers not divisible by {S} pipeline stages"
+    )
+
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+
+    embed_params = {
+        k: rest[k] for k in ("tok_emb", "pos_emb") if k in rest
+    }
+    head_params = {
+        k: rest[k] for k in ("ln_final", "tok_emb", "lm_head")
+        if k in rest
+    }
+
+    def block_apply(p_block, h, pos):
+        return _BlockOnly(cfg, attention_fn=attention_fn).apply(
+            {"params": {"block_0": p_block}}, h, pos
+        )
+
+    h = _EmbedOnly(cfg).apply({"params": embed_params}, tokens, positions)
+
+    pipelined = shard_map(
+        functools.partial(
+            gpipe, block_apply, num_microbatches=num_microbatches
+        ),
+        mesh=mesh,
+        in_specs=(P("pp"), P(), P()),
+        out_specs=P(),
+        axis_names=frozenset({"pp"}),
+        check_vma=False,
+    )
+    # positions per MICROBATCH: activations flow through the schedule in
+    # [B/M, T, H] slices, and every microbatch shares the same arange
+    # rows, so one slice serves all ticks
+    pos_mb = positions[: B // num_microbatches]
+    h = pipelined(stacked, h, pos_mb)
+    return _HeadOnly(cfg).apply({"params": head_params}, h)
+
+
+# -- param-aligned sub-modules --------------------------------------------
+#
+# The pipeline needs to run the model's three phases separately (embed,
+# one block, head). Flax allows a single compact method per Module, so
+# instead of method views these are standalone modules whose submodule
+# NAMES match the Transformer's param tree exactly — the same subtrees
+# bind unchanged.
+
+import flax.linen as nn
+
+
+class _EmbedOnly(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions):
+        cfg = self.cfg
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="tok_emb",
+            embedding_init=nn.initializers.normal(0.02),
+        )
+        x = emb(tokens)
+        if cfg.position == "learned":
+            pos_emb = self.param(
+                "pos_emb",
+                nn.initializers.normal(0.02),
+                (cfg.max_seq_len, cfg.hidden_size), jnp.float32,
+            )
+            x = x + pos_emb[positions].astype(cfg.dtype)
+        return x
+
+
+class _BlockOnly(nn.Module):
+    cfg: TransformerConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, h, positions):
+        from ..models.transformer import Block
+
+        block = Block
+        if self.cfg.remat:
+            # honor the config exactly like Transformer.__call__ — a
+            # pipelined big model without remat would OOM where the
+            # serial path fits
+            block = nn.remat(Block, static_argnums=())
+        return block(self.cfg, attention_fn=self.attention_fn,
+                     name="block_0")(h, positions, None)
+
+
+class _HeadOnly(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, h):
+        from ..models.transformer import _norm
+
+        cfg = self.cfg
+        x = _norm(cfg, "ln_final")(h)
+        if cfg.tie_embeddings:
+            emb = nn.Embed(
+                cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                param_dtype=jnp.float32, name="tok_emb",
+                embedding_init=nn.initializers.normal(0.02),
+            )
+            return emb.attend(x)
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="lm_head",
+            kernel_init=nn.initializers.normal(0.02),
+        )(x)

@@ -222,3 +222,141 @@ def test_data_axes_helper(hvd8):
     assert data_axes(make_mesh(dp=2, tp=4)) == ("dp",)
     assert data_axes(make_mesh(dp=2, fsdp=2, tp=2)) == ("dp", "fsdp")
     assert data_axes(make_mesh(dp=1, tp=8)) == ()
+
+
+# ------------------------------------------------- pipeline parallelism
+# (beyond the reference: SURVEY.md §2.5 lists PP as absent in Horovod)
+
+
+def test_pipeline_matches_serial_forward_and_grads():
+    """GPipe over pp=4 must be numerically the serial model: same
+    logits, same gradients through the ppermute schedule."""
+    import dataclasses
+
+    from horovod_tpu.models.transformer import (
+        GPT2_SMALL,
+        Transformer,
+        causal_lm_loss,
+    )
+    from horovod_tpu.parallel.mesh import make_mesh
+    from horovod_tpu.parallel.pipeline import pipeline_lm_apply
+
+    cfg = dataclasses.replace(
+        GPT2_SMALL, num_layers=4, hidden_size=64, num_heads=2,
+        vocab_size=96, max_seq_len=32, dtype=jnp.float32,
+    )
+    model = Transformer(cfg)
+    B, T = 8, 32
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 96, (B, T)), jnp.int32
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    mesh = make_mesh(pp=4, dp=2)
+
+    logits_serial = model.apply({"params": params}, toks)
+    logits_pipe = jax.jit(
+        lambda p, t: pipeline_lm_apply(cfg, p, t, mesh,
+                                       num_microbatches=2)
+    )(params, toks)
+    np.testing.assert_allclose(
+        np.asarray(logits_pipe), np.asarray(logits_serial),
+        rtol=2e-4, atol=2e-4,
+    )
+
+    def loss_serial(p):
+        return causal_lm_loss(model.apply({"params": p}, toks), toks)[0]
+
+    def loss_pipe(p):
+        return causal_lm_loss(
+            pipeline_lm_apply(cfg, p, toks, mesh, num_microbatches=2),
+            toks,
+        )[0]
+
+    g1 = jax.grad(loss_serial)(params)
+    g2 = jax.jit(jax.grad(loss_pipe))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=3e-3, atol=3e-4
+        ),
+        g1, g2,
+    )
+
+
+def test_pipeline_stack_round_trip():
+    from horovod_tpu.models.transformer import GPT2_SMALL, Transformer
+    import dataclasses
+
+    from horovod_tpu.parallel.pipeline import (
+        stack_block_params,
+        unstack_block_params,
+    )
+
+    cfg = dataclasses.replace(
+        GPT2_SMALL, num_layers=3, hidden_size=32, num_heads=1,
+        vocab_size=64, max_seq_len=16,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32)
+    )["params"]
+    stacked, rest = stack_block_params(params)
+    rebuilt = unstack_block_params(stacked, rest)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        params, rebuilt,
+    )
+
+
+def test_pipeline_training_converges():
+    """A pipelined train step actually learns (optimizer over the
+    stacked+rest params, pp=2 x dp=4)."""
+    import dataclasses
+
+    import optax
+
+    from horovod_tpu.models.transformer import GPT2_SMALL, Transformer, causal_lm_loss
+    from horovod_tpu.parallel.mesh import make_mesh
+    from horovod_tpu.parallel.pipeline import pipeline_lm_apply
+
+    cfg = dataclasses.replace(
+        GPT2_SMALL, num_layers=2, hidden_size=64, num_heads=2,
+        vocab_size=64, max_seq_len=16, dtype=jnp.float32,
+    )
+    mesh = make_mesh(pp=2, dp=4)
+    B, T = 8, 16
+    r = np.random.RandomState(0)
+    table = r.randint(0, 64, (64,))
+    toks = np.zeros((B, T), dtype=np.int32)
+    toks[:, 0] = r.randint(0, 64, B)
+    for t in range(1, T):
+        toks[:, t] = table[toks[:, t - 1]]
+    toks = jnp.asarray(toks)
+
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, T), jnp.int32)
+    )["params"]
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        def loss_fn(p):
+            return causal_lm_loss(
+                pipeline_lm_apply(cfg, p, toks, mesh,
+                                  num_microbatches=2),
+                toks,
+            )[0]
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, l
+
+    first = None
+    for _ in range(25):
+        params, opt_state, loss = step(params, opt_state)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first - 0.5, (first, float(loss))
