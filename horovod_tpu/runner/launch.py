@@ -30,6 +30,10 @@ def parse_args(argv: Optional[List[str]] = None):
         description="Launch a horovod_tpu training job.",
     )
     p.add_argument("-v", "--version", action="store_true")
+    p.add_argument("--check-build", dest="check_build",
+                   action="store_true",
+                   help="Print availability of frameworks, controllers "
+                        "and ops, then exit (reference launch.py:110).")
     p.add_argument(
         "-np", "--num-proc", dest="np", type=int,
         help="Total number of worker processes (slots).",
@@ -195,6 +199,43 @@ def _run_elastic(args) -> int:
     return driver.run()
 
 
+def _check_build() -> int:
+    """Availability table (reference launch.py:110 check_build). On TPU
+    the controller is the XLA coordination service and the tensor ops
+    are XLA collectives — the table reports what this install can use."""
+    import importlib.util
+
+    from .. import __version__
+
+    def have(mod: str) -> str:
+        return "X" if importlib.util.find_spec(mod) is not None else " "
+
+    def native() -> str:
+        try:
+            from .._native import build
+
+            build()
+            return "X"
+        except Exception:
+            return " "
+
+    print(f"horovod_tpu v{__version__}:\n")
+    print("Available Frameworks:")
+    print(f"    [{have('jax')}] JAX")
+    print(f"    [{have('flax')}] Flax")
+    print(f"    [{have('torch')}] PyTorch")
+    print("\nAvailable Controllers:")
+    print(f"    [{have('jax')}] XLA coordination service (jax.distributed)")
+    print(f"    [{native()}] Native eager control plane (libhvd_tpu_core)")
+    print("\nAvailable Tensor Operations:")
+    print(f"    [{have('jax')}] XLA collectives (ICI/DCN)")
+    print(f"    [{native()}] Negotiated eager (XlaExecutor)")
+    print("\nAvailable Integrations:")
+    print(f"    [{have('pyspark')}] Spark")
+    print(f"    [{have('ray')}] Ray")
+    return 0
+
+
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
     if args.version:
@@ -202,6 +243,8 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
 
         print(__version__)
         return 0
+    if args.check_build:
+        return _check_build()
     if not args.command:
         print("hvdrun: no command given", file=sys.stderr)
         return 2

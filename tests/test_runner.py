@@ -446,3 +446,13 @@ def test_run_static_binds_probed_nic(monkeypatch):
     assert codes == [0, 0]
     assert seen[0] == ("ethX", "127.0.0.1")
     assert seen[1] == ("ethX", "127.0.0.1")
+
+
+def test_check_build_reports_capabilities(capsys):
+    from horovod_tpu.runner.launch import run_commandline
+
+    assert run_commandline(["--check-build"]) == 0
+    out = capsys.readouterr().out
+    assert "[X] JAX" in out
+    assert "Native eager control plane" in out
+    assert "Spark" in out and "Ray" in out
