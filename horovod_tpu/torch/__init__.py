@@ -508,7 +508,8 @@ class _DistributedOptimizer:
     def __init__(self, optimizer, named_parameters=None,
                  compression=Compression.none,
                  backward_passes_per_step: int = 1, op=Average,
-                 gradient_predivide_factor: float = 1.0, process_set=None):
+                 gradient_predivide_factor: float = 1.0, process_set=None,
+                 groups=None):
         torch = _torch()
         self._opt = optimizer
         self._compression = compression
@@ -535,6 +536,64 @@ class _DistributedOptimizer:
         self._name_of = {p: n for n, p in named}
         self._counters = {p: 0 for _, p in named}
         self._pending: Dict[Any, Any] = {}
+
+        # `groups` (reference optimizer.py:88-103,212): fuse gradient
+        # allreduces by explicit parameter groups, or chunk all params
+        # into N groups. A group launches ONE grouped_allreduce once
+        # every member's hook has fired — all-or-nothing fusion instead
+        # of per-parameter ops.
+        self._p_to_group: Dict[Any, int] = {}
+        self._group_members: list = []
+        self._group_ready: list = []
+        if groups is not None:
+            if not (isinstance(groups, list) or
+                    (isinstance(groups, int) and
+                     not isinstance(groups, bool) and groups > 0)):
+                raise ValueError(
+                    "groups should be a positive integer or a list of "
+                    "lists of torch.Tensor (reference optimizer.py:89)"
+                )
+            grad_params = [p for _, p in named if p.requires_grad]
+            if isinstance(groups, int):
+                n = min(groups, len(grad_params)) or 1
+                size = (len(grad_params) + n - 1) // n
+                member_lists = [
+                    grad_params[i * size:(i + 1) * size] for i in range(n)
+                ]
+            else:
+                seen = set()
+                registered = {id(p) for _, p in named}
+                for sub in groups:
+                    for p in sub:
+                        if not isinstance(p, torch.Tensor):
+                            raise ValueError(
+                                "groups must consist of torch.Tensor"
+                            )
+                        if id(p) not in registered:
+                            # an unregistered member has no hook and
+                            # would deadlock its whole group silently
+                            raise ValueError(
+                                "groups may only contain parameters "
+                                "registered with this optimizer "
+                                "(named_parameters / param_groups)"
+                            )
+                        if id(p) in seen:
+                            raise ValueError(
+                                "a parameter can only appear once in "
+                                "groups"
+                            )
+                        seen.add(id(p))
+                member_lists = [list(sub) for sub in groups]
+            for gi, members in enumerate(member_lists):
+                members = [p for p in members if p.requires_grad]
+                if not members:
+                    continue
+                idx = len(self._group_members)
+                self._group_members.append(members)
+                self._group_ready.append(set())
+                for p in members:
+                    self._p_to_group[p] = idx
+
         self._hooks = []
         for _, p in named:
             if p.requires_grad:
@@ -545,11 +604,49 @@ class _DistributedOptimizer:
     def _make_hook(self):
         def hook(p):
             self._counters[p] += 1
-            if self._counters[p] >= self._bpps:
-                self._counters[p] = 0
+            if self._counters[p] < self._bpps:
+                return
+            self._counters[p] = 0
+            gi = self._p_to_group.get(p)
+            if gi is None:
                 self._pending[p] = self._allreduce_grad_async(p)
+                return
+            ready = self._group_ready[gi]
+            ready.add(p)
+            if len(ready) < len(self._group_members[gi]):
+                return  # group fuses all-or-nothing
+            ready.clear()
+            self._grouped_allreduce_grads(gi)
 
         return hook
+
+    def _grouped_allreduce_grads(self, gi: int) -> None:
+        members = self._group_members[gi]
+        sparse = [p for p in members if p.grad.is_sparse]
+        dense = [p for p in members if not p.grad.is_sparse]
+        # sparse members ride the gathered-slices path individually (the
+        # fusion buffer cannot carry ragged indices)
+        for p in sparse:
+            self._pending[p] = self._allreduce_grad_async(p)
+        if not dense:
+            return
+        grads = []
+        ctxs = []
+        for p in dense:
+            g = p.grad
+            if self._predivide != 1.0:
+                g = g / self._predivide
+            cg, ctx = self._compression.compress(g)
+            grads.append(cg)
+            ctxs.append(ctx)
+        outs = grouped_allreduce(
+            grads,
+            name=f"group.{gi}",
+            op=self._op,
+            process_set=self._process_set,
+        )
+        for p, out, ctx in zip(dense, outs, ctxs):
+            self._pending[p] = self._compression.decompress(out, ctx)
 
     def _allreduce_grad_async(self, p):
         name = self._name_of.get(p, "grad")
@@ -576,6 +673,26 @@ class _DistributedOptimizer:
         return self._compression.decompress(out, ctx)
 
     def synchronize(self) -> None:
+        # Flush partially-ready groups (reference synchronize launches
+        # missing reductions, optimizer.py:255): a member whose branch
+        # produced no gradient this step must not hold its groupmates'
+        # allreduces hostage — reduce the ready members now, so step()
+        # never applies raw local gradients, and no stale readiness
+        # leaks into the next iteration.
+        for gi, ready in enumerate(self._group_ready):
+            if not ready:
+                continue
+            # canonical member order, NOT set order: fused leaf names
+            # are positional and must align across ranks
+            members, self._group_members[gi] = (
+                self._group_members[gi],
+                [p for p in self._group_members[gi] if p in ready],
+            )
+            try:
+                self._grouped_allreduce_grads(gi)
+            finally:
+                self._group_members[gi] = members
+                ready.clear()
         for p, result in self._pending.items():
             if result.is_sparse:
                 # nnz differs from the local gradient's: rebind rather
@@ -611,11 +728,11 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1, op=Average,
                          gradient_predivide_factor: float = 1.0,
-                         process_set=None):
+                         process_set=None, groups=None):
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters,
         compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op,
         gradient_predivide_factor=gradient_predivide_factor,
-        process_set=process_set,
+        process_set=process_set, groups=groups,
     )
