@@ -160,3 +160,68 @@ class LearningRateScheduleCallback(Callback):
             return 1.0
         e = math.floor(epoch) if self.staircase else epoch
         return float(self.multiplier(e))
+
+
+class CommitStateCallback(Callback):
+    """Commit elastic state every N batches and at epoch end
+    (reference _keras/elastic.py:17 CommitStateCallbackImpl). More
+    frequent commits shrink the replay window after a failure; less
+    frequent commits cost less snapshot time."""
+
+    def __init__(self, state, batches_per_commit: int = 1):
+        self.state = state
+        self.batches_per_commit = max(1, int(batches_per_commit))
+        self.batches_remaining = self.batches_per_commit
+
+    def on_train_begin(self, state=None):
+        # reset on every sync event for cross-rank consistency
+        self.batches_remaining = self.batches_per_commit
+        return state
+
+    def on_batch_end(self, batch, state=None):
+        self.batches_remaining -= 1
+        if self.batches_remaining == 0:
+            self.state.commit()
+            self.batches_remaining = self.batches_per_commit
+        return state
+
+    def on_epoch_end(self, epoch, logs=None, state=None):
+        self.state.commit()
+        return state
+
+
+class UpdateBatchStateCallback(Callback):
+    """Track the in-epoch batch cursor in elastic state so a restarted
+    epoch resumes mid-epoch instead of replaying it (reference
+    _keras/elastic.py:42). Pairs with ElasticSampler, whose cursor
+    skips already-processed samples."""
+
+    def __init__(self, state):
+        self.state = state
+        if not hasattr(state, "batch"):
+            state.batch = 0
+            state.register("batch")
+
+    def on_batch_end(self, batch, state=None):
+        self.state.batch = batch
+        return state
+
+    def on_epoch_end(self, epoch, logs=None, state=None):
+        self.state.batch = 0
+        return state
+
+
+class UpdateEpochStateCallback(Callback):
+    """Track the GLOBAL epoch number across elastic resets (reference
+    _keras/elastic.py:66): framework epoch counters restart at 0 after
+    a reset; the state's does not."""
+
+    def __init__(self, state):
+        self.state = state
+        if not hasattr(state, "epoch"):
+            state.epoch = 0
+            state.register("epoch")
+
+    def on_epoch_end(self, epoch, logs=None, state=None):
+        self.state.epoch += 1
+        return state

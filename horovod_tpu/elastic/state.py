@@ -92,6 +92,14 @@ class ObjectState(State):
         self._known = list(kwargs)
         self.save()
 
+    def register(self, name: str) -> None:
+        """Track an attribute added after construction (used by the
+        elastic callbacks to attach batch/epoch cursors to an existing
+        state)."""
+        if name not in self._known:
+            self._known.append(name)
+            self._saved[name] = copy.deepcopy(getattr(self, name))
+
     def save(self) -> None:
         self._saved = {k: copy.deepcopy(getattr(self, k)) for k in self._known}
 
@@ -123,6 +131,16 @@ class TpuState(ObjectState):
             k for k, v in kwargs.items() if _is_pytree_of_arrays(v)
         ]
         super().__init__(**kwargs)
+
+    def register(self, name: str) -> None:
+        if name not in self._known:
+            v = getattr(self, name)
+            if _is_pytree_of_arrays(v):
+                self._tree_keys.append(name)
+                self._known.append(name)
+                self._saved[name] = jax.device_get(v)
+            else:
+                super().register(name)
 
     def save(self) -> None:
         self._saved = {}

@@ -267,3 +267,45 @@ def test_async_loader_abandoned_iteration_releases_thread():
             break
     time.sleep(0.5)
     assert not loader._async_thread.is_alive()
+
+
+def test_elastic_callbacks_commit_and_cursors(hvd8):
+    """CommitStateCallback / UpdateBatchStateCallback /
+    UpdateEpochStateCallback (reference _keras/elastic.py): commits
+    every N batches, batch cursor resumes mid-epoch, epoch counts
+    globally across resets."""
+    import horovod_tpu as hvd
+    from horovod_tpu.callbacks import (
+        CommitStateCallback,
+        UpdateBatchStateCallback,
+        UpdateEpochStateCallback,
+    )
+
+    state = hvd.elastic.TpuState(step=0)
+    commits = []
+    orig_commit = state.commit
+    state.commit = lambda: (commits.append(True), orig_commit())
+
+    cb_commit = CommitStateCallback(state, batches_per_commit=2)
+    cb_batch = UpdateBatchStateCallback(state)
+    cb_epoch = UpdateEpochStateCallback(state)
+
+    cb_commit.on_train_begin()
+    for b in range(5):
+        state.step += 1
+        cb_batch.on_batch_end(b)
+        cb_commit.on_batch_end(b)
+    # 5 batches at 2/commit -> commits after b=1 and b=3
+    assert len(commits) == 2
+    assert state.batch == 4
+    # restore rolls the batch cursor back to the last commit
+    state.step = 99
+    state.restore()
+    assert state.step == 4  # committed after batch 3 (steps 1..4)
+    assert state.batch == 3
+
+    cb_epoch.on_epoch_end(0)
+    cb_batch.on_epoch_end(0)
+    cb_commit.on_epoch_end(0)
+    assert state.epoch == 1 and state.batch == 0
+    assert len(commits) == 3
