@@ -39,7 +39,7 @@ def main():
     )
     tok_per_chip, bert_mfu = bert.main(
         ["--num-iters", "3", "--num-batches-per-iter", "5",
-         "--num-warmup-batches", "2", "--batch-size", "24"]
+         "--num-warmup-batches", "2", "--batch-size", "24", "--flash"]
     )
 
     print(

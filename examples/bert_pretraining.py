@@ -53,6 +53,9 @@ def main(argv=None):
                    help="override width (0 = BERT-Large's 1024)")
     p.add_argument("--remat", action="store_true",
                    help="per-block rematerialization (HBM-bound configs)")
+    p.add_argument("--flash", action="store_true",
+                   help="Pallas flash-attention kernels (fwd + bwd) in "
+                        "place of XLA dot-product attention")
     args = p.parse_args(argv)
 
     hvd.init()
@@ -70,7 +73,11 @@ def main(argv=None):
     cfg = dataclasses.replace(
         cfg, max_seq_len=args.seq_len, remat=args.remat
     )
-    model = Bert(cfg)
+    attention_fn = None
+    if args.flash:
+        from horovod_tpu.ops.pallas_attention import make_flash_attention_fn
+        attention_fn = make_flash_attention_fn(causal=False)
+    model = Bert(cfg, attention_fn=attention_fn)
 
     rng = np.random.RandomState(hvd.rank() if hvd.cross_size() > 1 else 0)
     B, T = args.batch_size * n, args.seq_len

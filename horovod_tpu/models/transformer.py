@@ -191,6 +191,12 @@ class Attention(nn.Module):
         if self.attention_fn is None:
             out = attn(q, k, v, mask=mask)
         else:
+            if mask is not None:
+                raise ValueError(
+                    "a custom attention_fn (flash/ring/Ulysses) takes only "
+                    "(q, k, v) and would silently drop the padding mask; "
+                    "pre-mask the inputs or use the default attention"
+                )
             out = attn(q, k, v)
         out = nn.DenseGeneral(
             features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,

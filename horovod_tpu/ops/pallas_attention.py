@@ -1,9 +1,9 @@
-"""Pallas TPU flash attention (forward kernel + recompute backward).
+"""Pallas TPU flash attention (forward + flash backward kernels).
 
 The reference has no attention kernels (it wraps framework models;
 its native compute is limited to fusion-buffer/scale CUDA kernels,
 /root/reference/horovod/common/ops/cuda/cuda_kernels.cu:48-260). This is a
-TPU-first addition: the transformer family's hot op as a Pallas kernel —
+TPU-first addition: the transformer family's hot op as Pallas kernels —
 blockwise online-softmax attention (Flash Attention) tiled for MXU/VMEM:
 
 * grid over (batch*heads, query blocks); K/V stream through VMEM in
@@ -12,8 +12,11 @@ blockwise online-softmax attention (Flash Attention) tiled for MXU/VMEM:
   (ring attention) pass `query_offset`/`key_offset` and reuse the same
   kernel for off-diagonal blocks;
 * f32 accumulators over bf16 inputs (MXU-native mixed precision);
-* backward = recompute via the reference math's VJP (`jax.custom_vjp`) —
-  FLOPs traded for HBM, the standard TPU remat strategy.
+* the forward emits per-row logsumexp; the backward is two more flash
+  kernels (dq over K/V tiles, dk/dv over Q tiles) that rebuild each
+  probability tile from (q, k, lse) — the attention matrix is never
+  materialized in HBM in either direction, so training-time HBM traffic
+  stays O(T·D) instead of O(T²).
 
 Falls back to `interpret=True` off-TPU so the CPU test mesh runs the same
 code path.
@@ -28,14 +31,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU backend)
 
 NEG_INF = -1e30
 
 
 def _reference_attention(q, k, v, causal, scale, query_offset, key_offset):
-    """Plain-jnp attention used for the backward pass and as the numerics
-    oracle in tests. [B, H, Tq, D] x [B, H, Tk, D]."""
+    """Plain-jnp attention used as the numerics oracle in tests.
+    [B, H, Tq, D] x [B, H, Tk, D]."""
     logits = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
@@ -52,43 +55,92 @@ def _reference_attention(q, k, v, causal, scale, query_offset, key_offset):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32))
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, q_offset: int, k_offset: int, kv_len: int):
+def _tile_mask(block_q, block_k, q_base, k_base, *, causal, q_offset,
+               k_offset, kv_len):
+    """Validity mask for one [block_q, block_k] logits tile.
+
+    `q_base`/`k_base` are the tile's local starting rows/cols; global
+    positions add the caller's sequence offsets (ring attention)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    k_local = k_base + cols
+    mask = k_local < kv_len  # K padding
+    if causal:
+        mask = jnp.logical_and(
+            mask, (q_offset + q_base + rows) >= (k_offset + k_local)
+        )
+    return mask
+
+
+
+def _dot_nt(a, b):
+    """a[m, d] · b[n, d]ᵀ → [m, n] without materializing the transpose
+    (contract the last dims; Mosaic feeds the MXU directly)."""
+    return lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dot_tn(a, b):
+    """a[m, n]ᵀ · b[m, d] → [n, d] without materializing the transpose."""
+    return lax.dot_general(
+        a, b, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _causal_kv_limit(q_base, block_q, block_k, q_offset, k_offset,
+                     num_kv_blocks):
+    """Number of leading kv blocks that can contribute under the causal
+    mask for the q block starting at local row `q_base`: the last kb with
+    min(gk) ≤ max(gq). Shared by the forward and dq kernels so their tile
+    coverage can never diverge."""
+    return jnp.clip(
+        (q_offset + q_base + block_q - 1 - k_offset) // block_k + 1,
+        0, num_kv_blocks,
+    )
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                      causal: bool, scale: float, q_offset: int,
+                      k_offset: int, kv_len: int):
     """One (batch*head, q-block) program: stream K/V tiles, online softmax.
 
-    q_ref: [block_q, D]; k_ref/v_ref: [Tk_padded, D]; o_ref: [block_q, D].
-    """
+    q_ref: [block_q, D]; k_ref/v_ref: [Tk_padded, D]; o_ref: [block_q, D];
+    lse_ref: [block_q] f32 per-row logsumexp of the scaled logits (the
+    backward kernels rebuild P tiles from it)."""
     block_q, d = q_ref.shape
     # keep matmul inputs in the model dtype (bf16 → bf16 MXU path) with
     # f32 accumulation via preferred_element_type; scale folds into q
     q = (q_ref[:].astype(jnp.float32) * scale).astype(q_ref.dtype)
-    qpos = (
-        q_offset + pl.program_id(1) * block_q
-        + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    )
+    q_base = pl.program_id(1) * block_q
 
     num_kv_blocks = k_ref.shape[0] // block_k
+    # static elision: the all-true mask (non-causal, no K padding — the
+    # BERT/encoder fast path) costs a full VPU iota+select per tile
+    masked = causal or kv_len < k_ref.shape[0]
 
     def body(kb, carry):
         acc, m_prev, l_prev = carry
         k_tile = k_ref[pl.ds(kb * block_k, block_k), :]
         v_tile = v_ref[pl.ds(kb * block_k, block_k), :]
-        s = jnp.dot(q, k_tile.T, preferred_element_type=jnp.float32)
-        kpos = (
-            k_offset + kb * block_k
-            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        )
-        mask = kpos < (k_offset + kv_len)  # padding mask
-        if causal:
-            mask = jnp.logical_and(mask, qpos >= kpos)
-        s = jnp.where(mask, s, NEG_INF)
+        s = _dot_nt(q, k_tile)
+        if masked:
+            mask = _tile_mask(
+                block_q, block_k, q_base, kb * block_k, causal=causal,
+                q_offset=q_offset, k_offset=k_offset, kv_len=kv_len,
+            )
+            s = jnp.where(mask, s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         # explicit mask on p: for a fully-masked row m_new == NEG_INF and
         # exp(s - m_new) would be exp(0) == 1, silently averaging V — the
         # masked entries must contribute exactly zero
-        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        p = jnp.exp(s - m_new[:, None])
+        if masked:
+            p = jnp.where(mask, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jnp.dot(
             p.astype(v_tile.dtype), v_tile,
@@ -99,10 +151,117 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, m, l = lax.fori_loop(0, num_kv_blocks, body, (acc0, m0, l0))
-    # fully-masked rows (causal + offsets) have l == 0: output zeros
+    if causal:
+        limit = _causal_kv_limit(q_base, block_q, block_k, q_offset,
+                                 k_offset, num_kv_blocks)
+    else:
+        limit = num_kv_blocks
+    acc, m, l = lax.fori_loop(0, limit, body, (acc0, m0, l0))
+    # fully-masked rows (causal + offsets) have l == 0: output zeros, and
+    # lse == NEG_INF so the backward rebuilds p == 0 for them too
     safe_l = jnp.where(l > 0, l, 1.0)
     o_ref[:] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :] = jnp.where(l > 0, m + jnp.log(safe_l), NEG_INF)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float,
+                         q_offset: int, k_offset: int, kv_len: int):
+    """dQ for one q block: stream K/V tiles, rebuild P from lse.
+
+    dS = P ∘ (dO·Vᵀ − Δ), dQ = scale · dS·K, with Δ = rowsum(dO ∘ O)
+    (zero on padded rows because dO is zero-padded)."""
+    block_q, d = q_ref.shape
+    q = (q_ref[:].astype(jnp.float32) * scale).astype(q_ref.dtype)
+    do = do_ref[:]
+    lse = lse_ref[0, :]
+    delta = delta_ref[0, :]
+    q_base = pl.program_id(1) * block_q
+    num_kv_blocks = k_ref.shape[0] // block_k
+    masked = causal or kv_len < k_ref.shape[0]
+
+    def body(kb, acc):
+        k_tile = k_ref[pl.ds(kb * block_k, block_k), :]
+        v_tile = v_ref[pl.ds(kb * block_k, block_k), :]
+        s = _dot_nt(q, k_tile)
+        p = jnp.exp(s - lse[:, None])
+        if masked:
+            mask = _tile_mask(
+                block_q, block_k, q_base, kb * block_k, causal=causal,
+                q_offset=q_offset, k_offset=k_offset, kv_len=kv_len,
+            )
+            # masked lanes: exp may overflow to +inf (lse == NEG_INF
+            # rows); the where() selects 0 before anything multiplies it
+            p = jnp.where(mask, p, 0.0)
+        dp = _dot_nt(do, v_tile)
+        ds = p * (dp - delta[:, None])
+        return acc + jnp.dot(
+            ds.astype(k_tile.dtype), k_tile,
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        limit = _causal_kv_limit(q_base, block_q, block_k, q_offset,
+                                 k_offset, num_kv_blocks)
+    else:
+        limit = num_kv_blocks
+    acc = lax.fori_loop(
+        0, limit, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[:] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, q_offset: int, k_offset: int,
+                          kv_len: int, total_kv: int):
+    """dK/dV for one kv block: stream Q/dO tiles.
+
+    dV = Pᵀ·dO, dK = scale · dSᵀ·Q. Padded q rows carry dO == 0 and
+    Δ == 0, so they contribute exactly nothing to either sum."""
+    block_k, d = k_ref.shape
+    k = k_ref[:]
+    v = v_ref[:]
+    k_base = pl.program_id(1) * block_k
+    num_q_blocks = q_ref.shape[0] // block_q
+    # the K-padding mask guards this kv block's own padded rows; padded
+    # q rows are harmless because their dO and Δ are zero — so the mask
+    # is only needed for causal or padded-K tiles
+    masked = causal or kv_len < total_kv
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_tile = q_ref[pl.ds(qb * block_q, block_q), :]
+        do_tile = do_ref[pl.ds(qb * block_q, block_q), :]
+        lse_tile = lse_ref[0, pl.ds(qb * block_q, block_q)]
+        delta_tile = delta_ref[0, pl.ds(qb * block_q, block_q)]
+        qs = (q_tile.astype(jnp.float32) * scale).astype(q_tile.dtype)
+        s = _dot_nt(qs, k)
+        p = jnp.exp(s - lse_tile[:, None])
+        if masked:
+            mask = _tile_mask(
+                block_q, block_k, qb * block_q, k_base, causal=causal,
+                q_offset=q_offset, k_offset=k_offset, kv_len=kv_len,
+            )
+            p = jnp.where(mask, p, 0.0)
+        dv_acc = dv_acc + _dot_tn(p.astype(do_tile.dtype), do_tile)
+        dp = _dot_nt(do_tile, v)
+        ds = p * (dp - delta_tile[:, None])
+        dk_acc = dk_acc + _dot_tn(ds.astype(q_tile.dtype), q_tile)
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    if causal:
+        # q tiles entirely above the diagonal (max(gq) < min(gk))
+        # contribute nothing to this kv block
+        start = jnp.clip(
+            (k_offset + k_base - q_offset) // block_q, 0, num_q_blocks
+        )
+    else:
+        start = 0
+    dk_acc, dv_acc = lax.fori_loop(start, num_q_blocks, body, (zeros, zeros))
+    dk_ref[:] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv_acc.astype(dv_ref.dtype)
 
 
 def _pad_to(x, axis, multiple):
@@ -115,74 +274,173 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, widths)
 
 
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _flash_core(qq, kk, vv, kv_len, causal, scale, query_offset,
+                key_offset, block_q, block_k):
+    """Padded [BH, Tq_p, D] x [BH, Tk_p, D] → (out, lse); kv_len is the
+    true (unpadded) key length."""
+    bh, tq_p, d = qq.shape
+    tk_p = kk.shape[1]
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_k=block_k, causal=causal, scale=scale,
+        q_offset=query_offset, k_offset=key_offset, kv_len=kv_len,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, tq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, tk_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, tk_p, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq_p, d), qq.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tq_p), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qq, kk, vv)
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
 def _flash(q, k, v, causal, scale, query_offset, key_offset,
            block_q, block_k):
     """[B, H, T, D] flash attention core (bhtd layout)."""
+    out, _ = _flash_fwd(q, k, v, causal, scale, query_offset, key_offset,
+                        block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, query_offset, key_offset,
+               block_q, block_k):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     qq = _pad_to(q.reshape(b * h, tq, d), 1, block_q)
     kk = _pad_to(k.reshape(b * h, tk, d), 1, block_k)
     vv = _pad_to(v.reshape(b * h, tk, d), 1, block_k)
-    tq_p, tk_p = qq.shape[1], kk.shape[1]
-
-    kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, scale=scale,
-        q_offset=query_offset, k_offset=key_offset, kv_len=tk,
+    out_p, lse_p = _flash_core(
+        qq, kk, vv, tk, causal=causal, scale=scale,
+        query_offset=query_offset, key_offset=key_offset,
+        block_q=block_q, block_k=block_k,
     )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, tq_p // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, tk_p, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, tk_p, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq_p, d), q.dtype),
-        interpret=jax.default_backend() != "tpu",
-    )(qq, kk, vv)
-    return out[:, :tq].reshape(b, h, tq, d)
-
-
-def _flash_fwd(q, k, v, causal, scale, query_offset, key_offset,
-               block_q, block_k):
-    out = _flash(q, k, v, causal, scale, query_offset, key_offset,
-                 block_q, block_k)
-    return out, (q, k, v)
+    out = out_p[:, :tq].reshape(b, h, tq, d)
+    return out, (q, k, v, out, lse_p[:, :, :tq])
 
 
 def _flash_bwd(causal, scale, query_offset, key_offset, block_q, block_k,
                residuals, g):
-    q, k, v = residuals
-    # recompute-based backward: VJP through the reference math (remat —
-    # trades FLOPs for not materializing the attention matrix in fwd)
-    def ref(q_, k_, v_):
-        return _reference_attention(
-            q_, k_, v_, causal, scale, query_offset, key_offset
-        ).astype(g.dtype)
+    q, k, v = residuals[:3]
+    out, lse = residuals[3:]
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    # Δ_i = Σ_d dO_i ∘ O_i — one cheap fused elementwise pass in XLA
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    qq = _pad_to(q.reshape(b * h, tq, d), 1, block_q)
+    do = _pad_to(g.reshape(b * h, tq, d).astype(q.dtype), 1, block_q)
+    lse_p = _pad_to(lse, 2, block_q)
+    delta_p = _pad_to(delta.reshape(b * h, 1, tq), 2, block_q)
+    kk = _pad_to(k.reshape(b * h, tk, d), 1, block_k)
+    vv = _pad_to(v.reshape(b * h, tk, d), 1, block_k)
+    bh, tq_p = qq.shape[0], qq.shape[1]
+    tk_p = kk.shape[1]
 
-    _, vjp = jax.vjp(ref, q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dq_kernel = functools.partial(
+        _flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale,
+        q_offset=query_offset, k_offset=key_offset, kv_len=tk,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, tq_p // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((None, tk_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, tk_p, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), q.dtype),
+        interpret=_interpret(),
+    )(qq, do, lse_p, delta_p, kk, vv)
+
+    dkv_kernel = functools.partial(
+        _flash_bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
+        q_offset=query_offset, k_offset=key_offset, kv_len=tk,
+        total_kv=tk_p,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, tk_p // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, tq_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, tq_p, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, tq_p), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, 1, tq_p), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk_p, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk_p, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(kk, vv, qq, do, lse_p, delta_p)
+
+    dq = dq[:, :tq].reshape(b, h, tq, d)
+    dk = dk[:, :tk].reshape(b, h, tk, d)
+    dv = dv[:, :tk].reshape(b, h, tk, d)
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _pick_block(requested, t):
+    """Block size for a sequence of length `t`: a single equal-to-array
+    block when it fits (Mosaic allows non-multiple-of-8 blocks only when
+    they equal the array dim), otherwise the tile-aligned candidate that
+    minimizes padding waste — T=520 runs 128-blocks (120 rows padding),
+    not 512-blocks (504 rows)."""
+    if t <= requested:
+        return max(t, 8)
+    candidates = [b for b in (128, 256, 512) if b <= requested]
+    if not candidates:
+        return max(requested, 8)  # caller asked for a small custom block
+    best = None
+    for b in candidates:
+        waste = (-t) % b
+        if best is None or (waste, -b) < best[0]:
+            best = ((waste, -b), b)
+    return best[1]
+
+
 def flash_attention(
     q, k, v, *, causal: bool = True, scale: Optional[float] = None,
     query_offset: int = 0, key_offset: int = 0,
-    block_q: int = 128, block_k: int = 256,
+    block_q: int = 512, block_k: int = 512,
 ):
     """Flash attention over [B, T, H, D] tensors (model layout).
 
-    kv heads may be fewer than q heads (GQA): they are repeated to match.
-    `query_offset`/`key_offset` shift the global positions used for the
-    causal mask — the hook ring attention uses for rotated KV blocks.
-    """
+    kv heads may be fewer than q heads (GQA): they are repeated to match
+    (the repeat's own VJP sums the per-copy dK/dV back onto the shared
+    heads). `query_offset`/`key_offset` shift the global positions used
+    for the causal mask — the hook ring attention uses for rotated KV
+    blocks."""
     bq, tq, hq, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -190,8 +448,8 @@ def flash_attention(
         rep = hq // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    block_q = min(block_q, max(tq, 8))
-    block_k = min(block_k, max(k.shape[1], 8))
+    block_q = _pick_block(block_q, tq)
+    block_k = _pick_block(block_k, k.shape[1])
     out = _flash(
         q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
         v.transpose(0, 2, 1, 3), causal, float(scale),
