@@ -85,9 +85,19 @@ def load() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
         ctypes.c_double, ctypes.c_longlong, ctypes.c_int,
         ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
-        ctypes.c_int,
+        ctypes.c_int, ctypes.c_int,
     ]
     lib.hvd_native_init.restype = ctypes.c_int
+    lib.hvd_bayes_test_create.argtypes = [ctypes.c_int]
+    lib.hvd_bayes_test_next.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+    ]
+    lib.hvd_bayes_test_observe.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int, ctypes.c_double,
+    ]
+    lib.hvd_bayes_test_best.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+    ]
     lib.hvd_native_tuned_cycle_ms.restype = ctypes.c_double
     lib.hvd_native_tuned_threshold.restype = ctypes.c_longlong
     lib.hvd_native_tuned_pinned.restype = ctypes.c_int
@@ -213,12 +223,13 @@ class NativeRuntime:
              stall_shutdown_s: float = 0.0,
              autotune: bool = False,
              autotune_warmup: int = -1,
-             autotune_cycles_per_sample: int = -1) -> None:
+             autotune_cycles_per_sample: int = -1,
+             autotune_bayes: bool = False) -> None:
         rc = self._lib.hvd_native_init(
             rank, size, coordinator_addr.encode(), coordinator_port,
             cycle_ms, fusion_threshold, cache_capacity, stall_warning_s,
             stall_shutdown_s, 1 if autotune else 0, autotune_warmup,
-            autotune_cycles_per_sample,
+            autotune_cycles_per_sample, 1 if autotune_bayes else 0,
         )
         if rc != 0:
             raise RuntimeError(

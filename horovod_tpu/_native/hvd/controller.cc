@@ -1,6 +1,7 @@
 #include "controller.h"
 
 #include <chrono>
+#include <cmath>
 #include <cstdio>
 
 #include "wire.h"
@@ -711,6 +712,24 @@ void TcpController::AutotuneObserve(const ResponseList& rl) {
   at_sample_bytes_ = 0;
   at_sample_busy_ = 0;
 
+  if (opts_.autotune_bayes) {
+    if (at_phase_ == 0) {
+      if (--at_warmup_left_ > 0) return;
+      at_phase_ = 1;
+      bayes_.reset(new BayesianTuner(2));
+      ApplyBayesPoint(bayes_->Next());
+      return;
+    }
+    bayes_->Observe(bayes_->Next(), score);
+    if (bayes_->n_samples() >= opts_.autotune_bayes_samples) {
+      ApplyBayesPoint(bayes_->Best());
+      autotune_pinned_ = true;
+      return;
+    }
+    ApplyBayesPoint(bayes_->Next());
+    return;
+  }
+
   const size_t n_thr = sizeof(kAtThresholds) / sizeof(kAtThresholds[0]);
   const size_t n_cyc = sizeof(kAtCycles) / sizeof(kAtCycles[0]);
   if (at_phase_ == 0) {
@@ -748,6 +767,16 @@ void TcpController::AutotuneObserve(const ResponseList& rl) {
   }
   tuned_cycle_ms_ = at_best_cycle_;
   autotune_pinned_ = true;
+}
+
+void TcpController::ApplyBayesPoint(const std::vector<double>& x) {
+  // unit cube → knobs: x0 = log2(threshold) in [20, 28] (1 MB..256 MB),
+  // x1 = ln(cycle_ms) in [ln 0.25, ln 5] — the same ranges the
+  // coordinate-descent grids span
+  double lg2 = 20.0 + 8.0 * x[0];
+  fusion_threshold_ = static_cast<int64_t>(std::pow(2.0, lg2));
+  double lo = std::log(0.25), hi = std::log(5.0);
+  tuned_cycle_ms_ = std::exp(lo + (hi - lo) * x[1]);
 }
 
 }  // namespace hvd

@@ -28,6 +28,7 @@
 #include <vector>
 
 #include "common.h"
+#include "bayes.h"
 #include "response_cache.h"
 #include "stall_inspector.h"
 #include "tcp.h"
@@ -49,6 +50,10 @@ struct ControllerOptions {
   double cycle_ms = 1.0;  // initial cycle time (autotune phase-2 base)
   int32_t autotune_warmup_samples = 3;
   int32_t autotune_cycles_per_sample = 32;
+  // Bayesian strategy (reference optim/bayesian_optimization.cc): GP+EI
+  // over {log2 threshold, log cycle} instead of coordinate descent
+  bool autotune_bayes = false;
+  int32_t autotune_bayes_samples = 12;
 };
 
 class TcpController {
@@ -142,6 +147,10 @@ class TcpController {
   double at_best_score_ = 0.0;
   int64_t at_best_threshold_ = 0;
   double at_best_cycle_ = 0.0;
+  // Bayesian path (HOROVOD_AUTOTUNE_BAYES): tuner lives on the
+  // coordinator only; winners still ship in every ResponseList
+  std::unique_ptr<BayesianTuner> bayes_;
+  void ApplyBayesPoint(const std::vector<double>& x);
 
  public:
   // The coordinator needs a cache replica to resolve cache-bit positions

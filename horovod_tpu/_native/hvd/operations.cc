@@ -473,11 +473,42 @@ using namespace hvd;
 
 extern "C" {
 
+// --- Bayesian tuner test surface -----------------------------------------
+// Lets Python unit-test the GP+EI searcher (bayes.cc) against a known
+// objective without spinning up a multi-process world.
+
+static BayesianTuner* bayes_test = nullptr;
+
+void hvd_bayes_test_create(int dims) {
+  delete bayes_test;
+  bayes_test = new BayesianTuner(dims);
+}
+
+void hvd_bayes_test_next(double* out, int dims) {
+  const std::vector<double>& x = bayes_test->Next();
+  for (int d = 0; d < dims; ++d) out[d] = x[d];
+}
+
+void hvd_bayes_test_observe(const double* x, int dims, double y) {
+  bayes_test->Observe(std::vector<double>(x, x + dims), y);
+}
+
+void hvd_bayes_test_best(double* out, int dims) {
+  std::vector<double> b = bayes_test->Best();
+  for (int d = 0; d < dims; ++d) out[d] = b[d];
+}
+
+void hvd_bayes_test_free() {
+  delete bayes_test;
+  bayes_test = nullptr;
+}
+
 int hvd_native_init(int rank, int size, const char* coord_addr,
                     int coord_port, double cycle_ms, long long fusion_bytes,
                     int cache_capacity, double stall_warning_s,
                     double stall_shutdown_s, int autotune,
-                    int autotune_warmup, int autotune_cycles_per_sample) {
+                    int autotune_warmup, int autotune_cycles_per_sample,
+                    int autotune_bayes) {
   if (g != nullptr && g->initialized.load()) return 0;
   delete g;
   g = new Global();
@@ -503,6 +534,7 @@ int hvd_native_init(int rank, int size, const char* coord_addr,
   if (autotune_cycles_per_sample >= 0) {
     opts.autotune_cycles_per_sample = autotune_cycles_per_sample;
   }
+  opts.autotune_bayes = autotune_bayes != 0;
   g->controller.reset(new TcpController(opts));
   g->controller->cache = g->cache.get();
   if (!g->controller->Initialize()) {

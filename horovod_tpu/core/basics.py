@@ -272,6 +272,7 @@ def _start_native_eager(st) -> None:
         autotune=st.knobs.autotune,
         autotune_warmup=st.knobs.autotune_warmup_samples,
         autotune_cycles_per_sample=st.knobs.autotune_steps_per_sample,
+        autotune_bayes=st.knobs.autotune_bayes,
     )
 
 

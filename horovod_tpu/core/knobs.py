@@ -88,6 +88,7 @@ class Knobs:
 
     # --- autotune (parameter_manager.h:42) ---
     autotune: bool = False
+    autotune_bayes: bool = False  # GP+EI search (optim/bayesian_optimization.cc)
     autotune_log: str = ""
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
@@ -149,6 +150,7 @@ class Knobs:
             timeline_filename=_env("TIMELINE", "") or "",
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             autotune=_env_bool("AUTOTUNE", False),
+            autotune_bayes=_env_bool("AUTOTUNE_BAYES", False),
             autotune_log=_env("AUTOTUNE_LOG", "") or "",
             autotune_warmup_samples=_env_int("AUTOTUNE_WARMUP_SAMPLES", 3),
             autotune_steps_per_sample=_env_int(

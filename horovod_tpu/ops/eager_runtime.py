@@ -179,6 +179,7 @@ class EagerRuntime:
         autotune: bool = False,
         autotune_warmup: int = -1,
         autotune_cycles_per_sample: int = -1,
+        autotune_bayes: bool = False,
     ):
         self._native = NativeRuntime()
         self._native.init(
@@ -188,6 +189,7 @@ class EagerRuntime:
             stall_shutdown_s=stall_shutdown_s, autotune=autotune,
             autotune_warmup=autotune_warmup,
             autotune_cycles_per_sample=autotune_cycles_per_sample,
+            autotune_bayes=autotune_bayes,
         )
         self._executor = executor or LoopbackExecutor(size, rank)
         self._lock = threading.Lock()

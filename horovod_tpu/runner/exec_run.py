@@ -140,6 +140,14 @@ def launch_slots(
         port = rendezvous.port
     local = set(local_hosts) if local_hosts else None
     rendezvous_addr = routable_host_address()
+    if all(
+        slot.hostname in local if local else is_local_host(slot.hostname)
+        for slot in assignments
+    ):
+        # single-host world: loopback always routes; the outbound-NIC
+        # address may not accept hairpin connections (sandboxes,
+        # firewalled hosts) and no remote worker needs to reach us
+        rendezvous_addr = "127.0.0.1"
     if nics:
         env = dict(env)
         env["HOROVOD_NICS"] = ",".join(nics)

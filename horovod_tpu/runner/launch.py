@@ -74,6 +74,10 @@ def parse_args(argv: Optional[List[str]] = None):
                    action="store_true", default=None)
     p.add_argument("--autotune", dest="autotune", action="store_true",
                    default=None)
+    p.add_argument("--autotune-bayes", dest="autotune_bayes",
+                   action="store_true",
+                   help="Bayesian (GP + expected-improvement) autotune "
+                        "search instead of coordinate descent")
     p.add_argument("--autotune-log", dest="autotune_log")
     p.add_argument("--compression-wire-dtype",
                    dest="compression_wire_dtype",

@@ -18,6 +18,7 @@ ARG_TO_ENV = {
     "timeline_filename": "HOROVOD_TIMELINE",
     "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
     "autotune": "HOROVOD_AUTOTUNE",
+    "autotune_bayes": "HOROVOD_AUTOTUNE_BAYES",
     "autotune_log": "HOROVOD_AUTOTUNE_LOG",
     "compression_wire_dtype": "HOROVOD_COMPRESSION_WIRE_DTYPE",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",

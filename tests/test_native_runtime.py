@@ -856,3 +856,91 @@ def test_coordinator_crash_errors_workers():
         state, err = out[r]
         assert state == rt_mod_FAILED, f"rank {r} state={state} err={err}"
         assert "lost connection" in err, err
+
+
+# ------------------------------------------------- Bayesian autotune
+
+
+def test_bayesian_tuner_finds_optimum():
+    """The GP+EI searcher (bayes.cc — role parity with the reference's
+    optim/bayesian_optimization.cc) localizes the maximum of a smooth
+    2-D objective within a kernel length scale in ~15 samples."""
+    import ctypes
+
+    native = _load_native()
+    lib = native.load()
+    dims = 2
+    lib.hvd_bayes_test_create(dims)
+    try:
+        buf = (ctypes.c_double * dims)()
+
+        def objective(x0, x1):
+            return -((x0 - 0.7) ** 2) - (x1 - 0.3) ** 2
+
+        for _ in range(15):
+            lib.hvd_bayes_test_next(buf, dims)
+            x = list(buf)
+            assert all(0.0 <= v <= 1.0 for v in x), x
+            lib.hvd_bayes_test_observe(buf, dims, objective(*x))
+        lib.hvd_bayes_test_best(buf, dims)
+        best = list(buf)
+        # optimum is (0.7, 0.3) with value 0; random search over 15
+        # points would miss this bar most of the time
+        assert objective(*best) > -0.02, best
+    finally:
+        lib.hvd_bayes_test_free()
+
+
+def _worker_autotune_bayes(rank, size, port, scenario, q):
+    """Same shape as _worker_autotune but with the GP+EI strategy."""
+    native = _load_native()
+    rt = native.NativeRuntime()
+    rt.init(rank, size, "127.0.0.1", port, cycle_ms=1.0,
+            cache_capacity=64, stall_warning_s=60.0,
+            autotune=True, autotune_warmup=1,
+            autotune_cycles_per_sample=2, autotune_bayes=True)
+    try:
+        q.put((rank, "ok", scenario(native, rt, rank, size)))
+    except Exception as e:
+        q.put((rank, "err", repr(e)))
+    finally:
+        rt.shutdown()
+
+
+def test_bayesian_autotune_all_ranks_pin_identical_parameters():
+    """HOROVOD_AUTOTUNE_BAYES: the coordinator's GP searches the joint
+    {threshold x cycle} space (12 samples) and every rank pins the same
+    continuous winner it distributed."""
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker_autotune_bayes,
+                    args=(r, 2, port, scenario_autotune, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = time.time() + 120
+    while len(results) < 2 and time.time() < deadline:
+        try:
+            rank, status, payload = q.get(timeout=1.0)
+            results[rank] = (status, payload)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.terminate()
+    assert len(results) == 2, f"only {len(results)}/2 reported"
+    payloads = {}
+    for rank, (status, payload) in results.items():
+        assert status == "ok", f"rank {rank}: {payload}"
+        assert payload["pinned"], payload
+        payloads[rank] = payload
+    assert payloads[0]["cycle_ms"] == payloads[1]["cycle_ms"], payloads
+    assert payloads[0]["threshold"] == payloads[1]["threshold"], payloads
+    # winners live in the continuous search ranges, not the descent grid
+    assert 0.25 <= payloads[0]["cycle_ms"] <= 5.0, payloads
+    assert (1 << 20) <= payloads[0]["threshold"] <= (256 << 20), payloads
