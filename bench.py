@@ -2,7 +2,8 @@
 """Headline benchmarks: ResNet-50 synthetic images/sec/chip (primary
 metric, matching the reference's only published absolute throughput) plus
 BERT-Large pretraining tokens/sec/chip — the two model families
-BASELINE.json names — with measured MFU for both.
+BASELINE.json names — with measured MFU for both, and the reference's
+scaling trio completed by Inception V3 and VGG-16 (BASELINE.md rows 1,3).
 
 Vehicles live in examples/ (resnet50_synthetic.py, bert_pretraining.py),
 mirroring the reference's examples/pytorch/pytorch_synthetic_benchmark.py
@@ -41,6 +42,18 @@ def main():
         ["--num-iters", "3", "--num-batches-per-iter", "5",
          "--num-warmup-batches", "2", "--batch-size", "24", "--flash"]
     )
+    # the scaling trio's other two models, shorter windows (their numbers
+    # are secondary evidence; inception 256 >> 192/320 on v5e)
+    inc_per_chip, inc_mfu = resnet.main(
+        ["--model", "inception3", "--num-iters", "3",
+         "--num-batches-per-iter", "8", "--num-warmup-batches", "3",
+         "--batch-size", "256"]
+    )
+    vgg_per_chip, vgg_mfu = resnet.main(
+        ["--model", "vgg16", "--num-iters", "3",
+         "--num-batches-per-iter", "8", "--num-warmup-batches", "3",
+         "--batch-size", "128"]
+    )
 
     print(
         json.dumps(
@@ -57,6 +70,14 @@ def main():
                         tok_per_chip, 1
                     ),
                     "bertlarge_mfu": round(bert_mfu, 4),
+                    "inception3_images_per_sec_per_chip": round(
+                        inc_per_chip, 1
+                    ),
+                    "inception3_mfu": round(inc_mfu, 4),
+                    "vgg16_images_per_sec_per_chip": round(
+                        vgg_per_chip, 1
+                    ),
+                    "vgg16_mfu": round(vgg_mfu, 4),
                 },
             }
         )

@@ -1,14 +1,17 @@
-"""ResNet-50 synthetic benchmark (images/sec + MFU).
+"""Synthetic CNN benchmark (images/sec + MFU) — ResNet-50 by default.
 
 Mirrors the reference vehicle
-(examples/pytorch/pytorch_synthetic_benchmark.py: ResNet-50, synthetic
-ImageNet batches, images/sec over timed windows, optional fp16 wire), in
-the TPU-first shape: bf16 model, one jitted shard_map train step, XLA
-collectives over the mesh, optional bf16 wire compression in the
-optimizer transform.
+(examples/pytorch/pytorch_synthetic_benchmark.py: torchvision model by
+--model, synthetic ImageNet batches, images/sec over timed windows,
+optional fp16 wire), in the TPU-first shape: bf16 model, one jitted
+shard_map train step, XLA collectives over the mesh, optional bf16 wire
+compression in the optimizer transform. --model covers the reference's
+headline scaling trio (docs/benchmarks.rst:8-13): resnet50/101/152,
+inception3 (299px) and vgg16.
 
 Run:
     python examples/resnet50_synthetic.py --num-iters 5
+    python examples/resnet50_synthetic.py --model vgg16
 """
 
 import argparse
@@ -25,17 +28,31 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50
-from horovod_tpu.utils.mfu import peak_flops_per_chip, resnet50_train_flops
+from horovod_tpu.models import (
+    InceptionV3, ResNet50, ResNet101, ResNet152, VGG16,
+)
+from horovod_tpu.utils.mfu import cnn_train_flops, peak_flops_per_chip
+
+_MODELS = {
+    "resnet50": (ResNet50, 224),
+    "resnet101": (ResNet101, 224),
+    "resnet152": (ResNet152, 224),
+    "inception3": (InceptionV3, 299),
+    "vgg16": (VGG16, 224),
+}
 
 
 def main(argv=None):
     p = argparse.ArgumentParser(
-        description="horovod_tpu synthetic ResNet-50 benchmark"
+        description="horovod_tpu synthetic CNN benchmark "
+                    "(--model resnet50/101/152, inception3, vgg16)"
     )
+    p.add_argument("--model", choices=sorted(_MODELS), default="resnet50",
+                   help="reference tf_cnn_benchmarks model name")
     p.add_argument("--batch-size", type=int, default=128,
                    help="per-rank batch size")
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--image-size", type=int, default=0,
+                   help="0 = the model's native resolution")
     p.add_argument("--num-classes", type=int, default=1000)
     p.add_argument("--num-warmup-batches", type=int, default=3)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
@@ -49,7 +66,10 @@ def main(argv=None):
     n = hvd.size()
     mesh = hvd.mesh()
 
-    model = ResNet50(num_classes=args.num_classes, dtype=jnp.bfloat16)
+    model_cls, native_size = _MODELS[args.model]
+    if not args.image_size:
+        args.image_size = native_size
+    model = model_cls(num_classes=args.num_classes, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
     local = np.random.RandomState(hvd.rank() if hvd.cross_size() > 1 else 0)
     xb = local.rand(
@@ -61,7 +81,11 @@ def main(argv=None):
         rng, jnp.zeros((1, args.image_size, args.image_size, 3),
                        dtype=jnp.bfloat16)
     )
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    # VGG has no BatchNorm: keep the step signature uniform with an
+    # empty stats pytree
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    has_bn = "batch_stats" in variables
     compression = (
         hvd.Compression.bf16 if args.bf16_allreduce else hvd.Compression.none
     )
@@ -72,13 +96,19 @@ def main(argv=None):
     params = hvd.broadcast_parameters(params, root_rank=0)
 
     def loss_fn(p, bs, x, y):
-        logits, new_state = model.apply(
-            {"params": p, "batch_stats": bs}, x.astype(jnp.bfloat16),
-            train=True, mutable=["batch_stats"],
-        )
+        if has_bn:
+            logits, new_state = model.apply(
+                {"params": p, "batch_stats": bs}, x.astype(jnp.bfloat16),
+                train=True, mutable=["batch_stats"],
+            )
+            bs = new_state["batch_stats"]
+        else:
+            logits = model.apply(
+                {"params": p}, x.astype(jnp.bfloat16), train=True
+            )
         onehot = jax.nn.one_hot(y, args.num_classes)
         loss = -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), -1))
-        return loss, new_state["batch_stats"]
+        return loss, bs
 
     def step_fn(p, bs, s, x, y):
         (loss, bs), g = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -106,8 +136,8 @@ def main(argv=None):
     ys = jax.device_put(yb, shard)
 
     if hvd.rank() == 0:
-        print(f"model: ResNet-50, batch {args.batch_size} x {n} ranks",
-              flush=True)
+        print(f"model: {args.model}, batch {args.batch_size} x {n} ranks, "
+              f"image {args.image_size}px", flush=True)
     for _ in range(args.num_warmup_batches):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, xs, ys
@@ -133,7 +163,7 @@ def main(argv=None):
     total = float(np.median(rates))
     per_chip = total / max(n, 1)  # n = total chips in the world
     mfu = (
-        resnet50_train_flops(per_chip, args.image_size)
+        cnn_train_flops(args.model, per_chip, args.image_size)
         / peak_flops_per_chip()
     )
     if hvd.rank() == 0:

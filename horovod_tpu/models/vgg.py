@@ -1,0 +1,48 @@
+"""VGG-16 — the reference's bandwidth-bound scaling model.
+
+BASELINE.md row 3: 68% scaling efficiency for VGG-16 at 512 GPUs
+(reference docs/benchmarks.rst:8-13) — VGG's 138M parameters (124M in
+the fc layers alone) make it the gradient-allreduce stress test of the
+benchmark trio; the reproduction vehicle is tf_cnn_benchmarks
+`--model vgg16`. TPU-first flax implementation: NHWC, bfloat16 compute,
+f32 params; the classifier keeps the original 4096-wide fc stack because
+those dense gradients ARE the benchmark (they dominate wire traffic).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# channels per conv, "M" = 2x2 max-pool (the 13-conv "D" configuration)
+_VGG16_CFG: Sequence = (
+    64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+    512, 512, 512, "M", 512, 512, 512, "M",
+)
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0  # synthetic benchmarks train without dropout
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for spec in _VGG16_CFG:
+            if spec == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(spec, (3, 3), padding="SAME",
+                            dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)  # 7x7x512 = 25088
+        for width in (4096, 4096):
+            x = nn.Dense(width, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            if self.dropout and train:
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32)(x)

@@ -56,11 +56,23 @@ def transformer_train_flops(n_params: int, tokens: int) -> float:
     return 6.0 * float(n_params) * float(tokens)
 
 
-def resnet50_train_flops(images: int, image_size: int = 224) -> float:
-    """ResNet-50 training FLOPs: ~4.1 GFLOPs forward per 224² image
-    (He et al. 2015 Table 1 ×2 for multiply+add), ×3 for fwd+bwd."""
-    fwd = 4.1e9 * (image_size / 224.0) ** 2
-    return 3.0 * fwd * float(images)
+# per-image forward FLOPs at each model's native resolution (published
+# multiply-accumulate counts ×2 — e.g. ResNet-50's 4.1 GFLOPs from
+# He et al. 2015 Table 1); used by the --model benchmark sweep
+_CNN_FWD_FLOPS = {
+    "resnet50": (4.1e9, 224),
+    "resnet101": (7.8e9, 224),
+    "resnet152": (11.5e9, 224),
+    "inception3": (5.7e9, 299),
+    "vgg16": (15.5e9, 224),
+}
+
+
+def cnn_train_flops(model: str, images: int, image_size: int) -> float:
+    """Training FLOPs (fwd ×3) for the synthetic-benchmark CNN family,
+    scaled from each model's native resolution."""
+    fwd, native = _CNN_FWD_FLOPS[model]
+    return 3.0 * fwd * (image_size / native) ** 2 * float(images)
 
 
 def count_params(tree) -> int:
