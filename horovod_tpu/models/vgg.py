@@ -24,9 +24,13 @@ _VGG16_CFG: Sequence = (
 
 
 class VGG16(nn.Module):
+    """With nonzero `dropout`, training calls must supply the stream:
+    `model.apply(vars, x, train=True, rngs={"dropout": key})` — flax
+    raises otherwise. The synthetic benchmark trains with dropout=0."""
+
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
-    dropout: float = 0.0  # synthetic benchmarks train without dropout
+    dropout: float = 0.0
 
     @nn.compact
     def __call__(self, x, train: bool = True):

@@ -212,3 +212,8 @@ from .estimator import (  # noqa: E402,F401
     TorchEstimator,
     TorchModel,
 )
+from .store import (  # noqa: E402,F401
+    FsspecStore,
+    LocalStore,
+    Store,
+)

@@ -28,6 +28,7 @@ input path is host numpy → device shards, so this estimator
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -167,7 +168,11 @@ class JaxEstimator:
         output_col: str = "prediction",
         seed: int = 0,
         verbose: int = 0,
+        store=None,
+        run_id: Optional[str] = None,
     ):
+        from .store import store_or_none
+
         self.model = model
         self.feature_cols = list(feature_cols)
         self.label_cols = list(label_cols)
@@ -179,6 +184,10 @@ class JaxEstimator:
         self.output_col = output_col
         self.seed = seed
         self.verbose = verbose
+        # reference estimators persist run artifacts through a Store
+        # (spark/common/store.py); a string prefix is accepted directly
+        self.store = store_or_none(store)
+        self.run_id = run_id or "run"
 
     def fit(self, df) -> JaxModel:
         from . import run as spark_run
@@ -244,10 +253,27 @@ class JaxEstimator:
         results = spark_run(train, num_proc=self.num_proc,
                             verbose=self.verbose)
         trained = next(r for r in results if r is not None)
-        return JaxModel(trained, apply_fn, self.feature_cols,
-                        self.output_col,
-                        metadata={"epochs": self.epochs},
-                        optimizer_spec=self.optimizer_spec)
+        jm = JaxModel(trained, apply_fn, self.feature_cols,
+                      self.output_col,
+                      metadata={"epochs": self.epochs},
+                      optimizer_spec=self.optimizer_spec)
+        if self.store is not None:
+            import tempfile
+
+            # save_model writes a directory tree; mirror it file-by-file
+            # under <prefix>/<run_id>/checkpoint/model
+            ckpt = self.store.get_checkpoint_path(self.run_id)
+            with tempfile.TemporaryDirectory() as tmp:
+                local = os.path.join(tmp, "model")
+                jm.save(local)
+                for root, _, files in os.walk(local):
+                    for fname in files:
+                        full = os.path.join(root, fname)
+                        rel = os.path.relpath(full, local)
+                        with open(full, "rb") as f:
+                            self.store.write(f"{ckpt}/model/{rel}",
+                                             f.read())
+        return jm
 
 
 class TorchEstimator:
@@ -268,7 +294,11 @@ class TorchEstimator:
         num_proc: Optional[int] = None,
         output_col: str = "prediction",
         verbose: int = 0,
+        store=None,
+        run_id: Optional[str] = None,
     ):
+        from .store import store_or_none
+
         self.model = model
         self.feature_cols = list(feature_cols)
         self.label_cols = list(label_cols)
@@ -279,6 +309,8 @@ class TorchEstimator:
         self.num_proc = num_proc
         self.output_col = output_col
         self.verbose = verbose
+        self.store = store_or_none(store)
+        self.run_id = run_id or "run"
 
     def fit(self, df) -> "TorchModel":
         import torch
@@ -333,8 +365,16 @@ class TorchEstimator:
         results = spark_run(train, num_proc=self.num_proc,
                             verbose=self.verbose)
         trained = next(r for r in results if r is not None)
-        return TorchModel(model, trained, self.feature_cols,
-                          self.output_col)
+        tm = TorchModel(model, trained, self.feature_cols,
+                        self.output_col)
+        if self.store is not None:
+            import io
+
+            buf = io.BytesIO()
+            np.savez(buf, **trained)
+            ckpt = self.store.get_checkpoint_path(self.run_id)
+            self.store.write(f"{ckpt}/model.npz", buf.getvalue())
+        return tm
 
 
 class TorchModel:

@@ -1,0 +1,173 @@
+"""Storage abstraction for Spark estimators — run artifacts by scheme.
+
+Role parity with the reference's Store family
+(/root/reference/horovod/spark/common/store.py: Store.create dispatching
+to LocalStore/HDFSStore/S3Store/GCSStore/DBFSLocalStore), trimmed to
+what this framework's estimators actually persist: checkpoints, logs and
+run metadata. The reference additionally materializes Petastorm training
+data through its store; here training data reaches workers through the
+estimator's own collect/shard path (spark/estimator.py), so the data
+half of the API is intentionally absent rather than stubbed.
+
+Cloud backends (S3/GCS/HDFS/DBFS) dispatch through `fsspec` when it is
+installed; the image this framework ships in has no cloud filesystem
+libraries, so those schemes raise a clear ImportError at construction
+instead of failing deep inside a write.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import List, Optional
+
+
+class Store:
+    """Filesystem-like surface the estimators persist through.
+
+    Path layout mirrors the reference (store.py get_checkpoint_path /
+    get_logs_path): `<prefix>/<run_id>/{checkpoint,logs}`.
+    """
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path.rstrip("/")
+
+    # --- path layout ---
+
+    def get_run_path(self, run_id: str) -> str:
+        return f"{self.prefix_path}/{run_id}"
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return f"{self.get_run_path(run_id)}/checkpoint"
+
+    def get_logs_path(self, run_id: str) -> str:
+        return f"{self.get_run_path(run_id)}/logs"
+
+    # --- filesystem surface (overridden per backend) ---
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def remove(self, path: str) -> None:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> List[str]:
+        raise NotImplementedError
+
+    # --- factory ---
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        """Dispatch on the URL scheme (reference store.py Store.create)."""
+        for scheme, cls in (
+            ("hdfs://", FsspecStore), ("s3://", FsspecStore),
+            ("s3a://", FsspecStore), ("gs://", FsspecStore),
+            ("dbfs:/", FsspecStore), ("abfs://", FsspecStore),
+        ):
+            if prefix_path.startswith(scheme):
+                return cls(prefix_path)
+        if "://" in prefix_path and not prefix_path.startswith("file://"):
+            raise ValueError(
+                f"unrecognized store scheme in '{prefix_path}'"
+            )
+        return LocalStore(prefix_path)
+
+
+class LocalStore(Store):
+    """Plain local/NFS filesystem (reference LocalStore)."""
+
+    def __init__(self, prefix_path: str):
+        if prefix_path.startswith("file://"):
+            prefix_path = prefix_path[len("file://"):]
+        super().__init__(prefix_path)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: readers never see partial writes
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def listdir(self, path: str) -> List[str]:
+        return sorted(os.listdir(path))
+
+
+class FsspecStore(Store):
+    """Cloud-object-store backend over fsspec (covers the reference's
+    HDFSStore/S3Store/GCSStore/DBFS rows with one implementation —
+    fsspec is the protocol multiplexer those ecosystems standardized on
+    after the reference hand-rolled per-scheme clients)."""
+
+    def __init__(self, prefix_path: str):
+        try:
+            import fsspec
+        except ImportError as e:
+            scheme = prefix_path.split(":", 1)[0]
+            raise ImportError(
+                f"store scheme '{scheme}://' needs the fsspec package "
+                f"(plus its {scheme} filesystem implementation); install "
+                "it or use a LocalStore prefix"
+            ) from e
+        super().__init__(prefix_path)
+        self._fs, _ = fsspec.core.url_to_fs(prefix_path)
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        with self._fs.open(path, "wb") as f:
+            f.write(data)
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        # mirror LocalStore: removing an absent path is a no-op
+        if self._fs.exists(path):
+            self._fs.rm(path, recursive=True)
+
+    def listdir(self, path: str) -> List[str]:
+        # fs.ls returns full paths; LocalStore's contract is basenames
+        import posixpath
+
+        return sorted(
+            posixpath.basename(p.rstrip("/"))
+            for p in self._fs.ls(path, detail=False)
+        )
+
+
+def store_or_none(store) -> Optional[Store]:
+    """Estimator-ctor convenience: accept a Store, a prefix string, or
+    None."""
+    if store is None:
+        return None
+    return store if isinstance(store, Store) else Store.create(str(store))

@@ -56,10 +56,10 @@ def transformer_train_flops(n_params: int, tokens: int) -> float:
     return 6.0 * float(n_params) * float(tokens)
 
 
-# per-image forward FLOPs at each model's native resolution (published
-# multiply-accumulate counts ×2 — e.g. ResNet-50's 4.1 GFLOPs from
-# He et al. 2015 Table 1); used by the --model benchmark sweep
-_CNN_FWD_FLOPS = {
+# per-image forward multiply-accumulates at each model's native
+# resolution (published GMAC counts: torchvision/ptflops tables); one
+# MAC = 2 FLOPs on the MXU, matching the transformer 6·N·D convention
+_CNN_FWD_MACS = {
     "resnet50": (4.1e9, 224),
     "resnet101": (7.8e9, 224),
     "resnet152": (11.5e9, 224),
@@ -69,10 +69,11 @@ _CNN_FWD_FLOPS = {
 
 
 def cnn_train_flops(model: str, images: int, image_size: int) -> float:
-    """Training FLOPs (fwd ×3) for the synthetic-benchmark CNN family,
-    scaled from each model's native resolution."""
-    fwd, native = _CNN_FWD_FLOPS[model]
-    return 3.0 * fwd * (image_size / native) ** 2 * float(images)
+    """Training FLOPs (fwd MACs ×2 FLOPs/MAC ×3 for fwd+bwd) for the
+    synthetic-benchmark CNN family, scaled from each model's native
+    resolution."""
+    macs, native = _CNN_FWD_MACS[model]
+    return 3.0 * 2.0 * macs * (image_size / native) ** 2 * float(images)
 
 
 def count_params(tree) -> int:
