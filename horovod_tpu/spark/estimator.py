@@ -260,19 +260,13 @@ class JaxEstimator:
         if self.store is not None:
             import tempfile
 
-            # save_model writes a directory tree; mirror it file-by-file
+            # save_model writes a directory tree; mirror it in bulk
             # under <prefix>/<run_id>/checkpoint/model
             ckpt = self.store.get_checkpoint_path(self.run_id)
             with tempfile.TemporaryDirectory() as tmp:
                 local = os.path.join(tmp, "model")
                 jm.save(local)
-                for root, _, files in os.walk(local):
-                    for fname in files:
-                        full = os.path.join(root, fname)
-                        rel = os.path.relpath(full, local)
-                        with open(full, "rb") as f:
-                            self.store.write(f"{ckpt}/model/{rel}",
-                                             f.read())
+                self.store.upload(local, f"{ckpt}/model")
         return jm
 
 

@@ -63,22 +63,27 @@ class Store:
     def listdir(self, path: str) -> List[str]:
         raise NotImplementedError
 
+    def upload(self, local_dir: str, dest: str) -> None:
+        """Mirror a local directory tree into the store at `dest` — the
+        one bulk operation estimators need after writing a checkpoint."""
+        raise NotImplementedError
+
     # --- factory ---
 
     @staticmethod
     def create(prefix_path: str) -> "Store":
-        """Dispatch on the URL scheme (reference store.py Store.create)."""
-        for scheme, cls in (
-            ("hdfs://", FsspecStore), ("s3://", FsspecStore),
-            ("s3a://", FsspecStore), ("gs://", FsspecStore),
-            ("dbfs:/", FsspecStore), ("abfs://", FsspecStore),
-        ):
-            if prefix_path.startswith(scheme):
-                return cls(prefix_path)
+        """Dispatch on the URL scheme (reference store.py Store.create).
+
+        file:// and plain paths → LocalStore; dbfs:/ → LocalStore on the
+        /dbfs fuse mount (the reference's DBFSLocalStore does the same
+        mapping — fsspec would silently treat the single-slash form as a
+        relative local path); any other ``scheme://`` → fsspec, which
+        raises a clear ImportError when the scheme's filesystem package
+        (s3fs, gcsfs, adlfs, pyarrow for hdfs, ...) is missing."""
+        if prefix_path.startswith("dbfs:/"):
+            return LocalStore("/dbfs/" + prefix_path[len("dbfs:/"):].lstrip("/"))
         if "://" in prefix_path and not prefix_path.startswith("file://"):
-            raise ValueError(
-                f"unrecognized store scheme in '{prefix_path}'"
-            )
+            return FsspecStore(prefix_path)
         return LocalStore(prefix_path)
 
 
@@ -115,6 +120,9 @@ class LocalStore(Store):
 
     def listdir(self, path: str) -> List[str]:
         return sorted(os.listdir(path))
+
+    def upload(self, local_dir: str, dest: str) -> None:
+        shutil.copytree(local_dir, dest, dirs_exist_ok=True)
 
 
 class FsspecStore(Store):
@@ -163,6 +171,9 @@ class FsspecStore(Store):
             posixpath.basename(p.rstrip("/"))
             for p in self._fs.ls(path, detail=False)
         )
+
+    def upload(self, local_dir: str, dest: str) -> None:
+        self._fs.put(local_dir, dest, recursive=True)
 
 
 def store_or_none(store) -> Optional[Store]:

@@ -542,13 +542,18 @@ def test_store_local_roundtrip(tmp_path):
 
 
 def test_store_scheme_dispatch(tmp_path):
-    """Cloud schemes dispatch to the fsspec backend (clear ImportError
-    without fsspec in the image); unknown schemes are rejected loudly."""
+    """file:// and plain paths go local; dbfs:/ maps onto the /dbfs fuse
+    mount (the reference's DBFSLocalStore mapping); cloud schemes
+    dispatch through fsspec, which errors clearly when the scheme's
+    filesystem package is missing or the scheme is unknown."""
     import pytest
 
     from horovod_tpu.spark.store import LocalStore, Store
 
     assert isinstance(Store.create(f"file://{tmp_path}"), LocalStore)
+    dbfs = Store.create("dbfs:/runs/exp")
+    assert isinstance(dbfs, LocalStore)
+    assert dbfs.prefix_path == "/dbfs/runs/exp"
     try:
         import fsspec  # noqa: F401
         has_fsspec = True
@@ -557,7 +562,12 @@ def test_store_scheme_dispatch(tmp_path):
     if not has_fsspec:
         with pytest.raises(ImportError, match="fsspec"):
             Store.create("s3://bucket/prefix")
-    with pytest.raises(ValueError, match="scheme"):
+    else:
+        # s3 filesystem package (s3fs) is not in this image: the error
+        # still names the missing piece instead of silently going local
+        with pytest.raises(ImportError):
+            Store.create("s3://bucket/prefix")
+    with pytest.raises((ValueError, ImportError)):
         Store.create("carrier-pigeon://roost/prefix")
 
 
