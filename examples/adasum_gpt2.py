@@ -44,6 +44,9 @@ def main(argv=None):
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--flash", action="store_true",
+                   help="Pallas flash-attention kernels (fwd + bwd; "
+                        "causal tile-skipping, ~2x attention at T>=1k)")
     args = p.parse_args(argv)
 
     hvd.init()
@@ -58,7 +61,11 @@ def main(argv=None):
         vocab_size=args.vocab,
         max_seq_len=args.seq_len,
     )
-    model = Transformer(cfg)
+    attention_fn = None
+    if args.flash:
+        from horovod_tpu.ops.pallas_attention import make_flash_attention_fn
+        attention_fn = make_flash_attention_fn(causal=True)
+    model = Transformer(cfg, attention_fn=attention_fn)
 
     B, T = args.batch_size * n, args.seq_len
     # a learnable synthetic language: tokens follow a fixed random bigram

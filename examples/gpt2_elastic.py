@@ -70,6 +70,9 @@ def main(argv=None):
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--flash", action="store_true",
+                   help="Pallas flash-attention kernels (fwd + bwd; "
+                        "causal tile-skipping, ~2x attention at T>=1k)")
     args = p.parse_args(argv)
 
     hvd.init()
@@ -82,7 +85,11 @@ def main(argv=None):
         vocab_size=args.vocab,
         max_seq_len=args.seq_len,
     )
-    model = Transformer(cfg)
+    attention_fn = None
+    if args.flash:
+        from horovod_tpu.ops.pallas_attention import make_flash_attention_fn
+        attention_fn = make_flash_attention_fn(causal=True)
+    model = Transformer(cfg, attention_fn=attention_fn)
     params = jax.jit(model.init)(
         jax.random.PRNGKey(0), jnp.zeros((1, args.seq_len), dtype=jnp.int32)
     )["params"]

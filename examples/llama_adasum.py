@@ -55,6 +55,8 @@ def main(argv=None):
     p.add_argument("--vocab", type=int, default=2048,
                    help="vocab (LLAMA2_7B has 32000)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--flash", action="store_true",
+                   help="Pallas flash-attention kernels (fwd + bwd)")
     p.add_argument("--bf16-allreduce", action="store_true",
                    help="bfloat16 wire compression for the adasum path")
     args = p.parse_args(argv)
@@ -74,7 +76,11 @@ def main(argv=None):
         max_seq_len=args.seq_len,
         remat=args.remat,
     )
-    model = Transformer(cfg)
+    attention_fn = None
+    if args.flash:
+        from horovod_tpu.ops.pallas_attention import make_flash_attention_fn
+        attention_fn = make_flash_attention_fn(causal=True)
+    model = Transformer(cfg, attention_fn=attention_fn)
 
     B, T = args.batch_size * n, args.seq_len
     # learnable synthetic language (fixed random bigram table)

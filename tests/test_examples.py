@@ -20,6 +20,15 @@ def test_adasum_gpt2_converges():
     assert last < first - 0.5, (first, last)
 
 
+def test_adasum_gpt2_flash_converges():
+    """--flash swaps in the Pallas kernels (interpret mode on CPU) and
+    the Adasum training curve must still descend the same way."""
+    first, last = _load("adasum_gpt2").main(
+        ["--steps", "12", "--seq-len", "64", "--layers", "2", "--flash"]
+    )
+    assert last < first - 0.3, (first, last)
+
+
 def test_elastic_gpt2_runs_to_completion():
     final = _load("gpt2_elastic").main(["--steps", "12", "--commit-every", "4"])
     assert np.isfinite(final)
@@ -50,5 +59,18 @@ def test_llama_adasum_converges():
     first, last = _load("llama_adasum").main(
         ["--steps", "14", "--layers", "2", "--hidden", "256",
          "--vocab", "256", "--seq-len", "64", "--batch-size", "1"]
+    )
+    assert last < first - 0.3, (first, last)
+
+
+def test_llama_adasum_flash_remat_converges():
+    """--flash under the Llama path covers the hairy combinations: RoPE'd
+    q/k into the kernels, RMSNorm residuals, and nn.remat wrapping the
+    flash custom_vjp (rematerialization over custom-VJP blocks is a
+    classic breakage point)."""
+    first, last = _load("llama_adasum").main(
+        ["--steps", "12", "--layers", "2", "--hidden", "256",
+         "--vocab", "256", "--seq-len", "64", "--batch-size", "1",
+         "--flash", "--remat"]
     )
     assert last < first - 0.3, (first, last)
