@@ -57,6 +57,10 @@ def main(argv=None):
     p.add_argument("--num-warmup-batches", type=int, default=3)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
     p.add_argument("--num-iters", type=int, default=4)
+    p.add_argument("--s2d-stem", action="store_true",
+                   help="space-to-depth stem (2x2 unshuffle + 4x4/s1 "
+                        "conv; the TPU MLPerf transform of the 7x7/s2 "
+                        "3-channel stem). resnet family only")
     p.add_argument("--bf16-allreduce", action="store_true",
                    help="bfloat16 wire compression for gradients "
                         "(the reference's --fp16-allreduce)")
@@ -69,7 +73,13 @@ def main(argv=None):
     model_cls, native_size = _MODELS[args.model]
     if not args.image_size:
         args.image_size = native_size
-    model = model_cls(num_classes=args.num_classes, dtype=jnp.bfloat16)
+    model_kw = {}
+    if args.s2d_stem:
+        if not args.model.startswith("resnet"):
+            raise SystemExit("--s2d-stem applies to the resnet family")
+        model_kw["stem"] = "space_to_depth"
+    model = model_cls(num_classes=args.num_classes, dtype=jnp.bfloat16,
+                      **model_kw)
     rng = jax.random.PRNGKey(0)
     local = np.random.RandomState(hvd.rank() if hvd.cross_size() > 1 else 0)
     xb = local.rand(

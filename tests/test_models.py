@@ -193,3 +193,29 @@ def test_synthetic_benchmark_model_flag():
          "--num-classes", "10"]
     )
     assert per_chip > 0 and mfu > 0
+
+
+def test_resnet_space_to_depth_stem():
+    """stem="space_to_depth" (the MLPerf TPU transform: 2x2 unshuffle +
+    4x4/s1 conv) keeps the stem's output geometry and trains finitely."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models import ResNet
+    from horovod_tpu.models.resnet import space_to_depth
+
+    x = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 4, 4, 12)
+    # block contents: output pixel (0,0) holds input (0,0),(0,1),(1,0),(1,1)
+    assert jnp.array_equal(y[0, 0, 0, :3], x[0, 0, 0])
+    assert jnp.array_equal(y[0, 0, 0, 3:6], x[0, 0, 1])
+    assert jnp.array_equal(y[0, 0, 0, 6:9], x[0, 1, 0])
+
+    m = ResNet(stage_sizes=[1, 1], num_classes=10, num_filters=8,
+               dtype=jnp.float32, stem="space_to_depth")
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)))
+    out, _ = m.apply(v, jnp.ones((2, 64, 64, 3)), train=True,
+                     mutable=["batch_stats"])
+    assert out.shape == (2, 10)
+    assert bool(jnp.isfinite(out).all())
