@@ -56,6 +56,9 @@ def main(argv=None):
     p.add_argument("--flash", action="store_true",
                    help="Pallas flash-attention kernels (fwd + bwd) in "
                         "place of XLA dot-product attention")
+    p.add_argument("--fused-ce", action="store_true",
+                   help="vocab-blocked fused LM-head cross-entropy "
+                        "(logits never materialize in HBM)")
     args = p.parse_args(argv)
 
     hvd.init()
@@ -93,10 +96,22 @@ def main(argv=None):
     opt_state = opt.init(params)
     params = hvd.broadcast_parameters(params, root_rank=0)
 
-    def loss_fn(p, tok, lab, msk):
-        logits = model.apply({"params": p}, tok)
-        loss, _ = mlm_loss(logits, lab, msk)
-        return loss
+    if args.fused_ce:
+        from horovod_tpu.ops.fused_cross_entropy import (
+            fused_linear_cross_entropy,
+        )
+
+        def loss_fn(p, tok, lab, msk):
+            hidden = model.apply({"params": p}, tok, return_hidden=True)
+            w = p["tok_emb"]["embedding"].T  # tied head
+            loss, _ = fused_linear_cross_entropy(hidden, w, lab,
+                                                 valid=msk)
+            return loss
+    else:
+        def loss_fn(p, tok, lab, msk):
+            logits = model.apply({"params": p}, tok)
+            loss, _ = mlm_loss(logits, lab, msk)
+            return loss
 
     def step_fn(p, s, tok, lab, msk):
         loss, g = jax.value_and_grad(loss_fn)(p, tok, lab, msk)

@@ -254,7 +254,8 @@ class Transformer(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, positions=None, mask=None):
+    def __call__(self, tokens, positions=None, mask=None,
+                 return_hidden=False):
         cfg = self.cfg
         B, T = tokens.shape
         if positions is None:
@@ -281,6 +282,12 @@ class Transformer(nn.Module):
             x = block(cfg, attention_fn=self.attention_fn,
                       name=f"block_{i}")(x, positions, mask)
         x = _norm(cfg, "ln_final")(x)
+        if return_hidden:
+            # pre-head activations for the fused LM-head cross-entropy
+            # (ops/fused_cross_entropy.py) — the [B, T, V] logits are
+            # never materialized on that path. Initialize with the
+            # default return_hidden=False so head params exist.
+            return x
         # LM head matmul stays in the model compute dtype (bf16 on the
         # MXU fast path — an f32 [B,T,H]x[H,V] here is the single
         # largest matmul in the model at a fraction of peak); the loss
