@@ -47,6 +47,8 @@ def main(argv=None):
     p.add_argument("--flash", action="store_true",
                    help="Pallas flash-attention kernels (fwd + bwd; "
                         "causal tile-skipping, ~2x attention at T>=1k)")
+    p.add_argument("--fused-ce", action="store_true",
+                   help="vocab-blocked fused LM-head cross-entropy")
     args = p.parse_args(argv)
 
     hvd.init()
@@ -86,10 +88,23 @@ def main(argv=None):
     opt_state = opt.init(params)
     params = hvd.broadcast_parameters(params, root_rank=0)
 
-    def loss_fn(p, tok):
-        logits = model.apply({"params": p}, tok)
-        loss, _ = causal_lm_loss(logits, tok)
-        return loss
+    if args.fused_ce:
+        from horovod_tpu.ops.fused_cross_entropy import (
+            fused_causal_lm_loss,
+        )
+
+        def loss_fn(p, tok):
+            hidden = model.apply({"params": p}, tok, return_hidden=True)
+            loss, _ = fused_causal_lm_loss(
+                hidden, p["tok_emb"]["embedding"].T, tok,
+                block_vocab=512,
+            )
+            return loss
+    else:
+        def loss_fn(p, tok):
+            logits = model.apply({"params": p}, tok)
+            loss, _ = causal_lm_loss(logits, tok)
+            return loss
 
     def step_fn(p, s, tok):
         loss, g = jax.value_and_grad(loss_fn)(p, tok)

@@ -83,12 +83,13 @@ def _fused_ce_fwd(x, w, targets, valid, gscale, block_vocab):
     )
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
     nll = jnp.where(valid, lse - tgt, 0.0)
-    loss = jnp.sum(nll) * gscale
-    return loss, (x, w, targets, valid, lse, gscale)
+    nll_sum = jnp.sum(nll)
+    loss = nll_sum * gscale
+    return loss, (x, w, targets, valid, lse, gscale, nll_sum)
 
 
 def _fused_ce_bwd(block_vocab, residuals, g):
-    x, w, targets, valid, lse, gscale = residuals
+    x, w, targets, valid, lse, gscale, nll_sum = residuals
     n, h = x.shape
     wp, v = _pad_w(w, block_vocab)
     nb = wp.shape[1] // block_vocab
@@ -131,10 +132,9 @@ def _fused_ce_bwd(block_vocab, residuals, g):
     dw = dwp[:, :v]
     return (
         dx.astype(x.dtype), dw.astype(w.dtype), None, None,
-        # d loss / d gscale = loss / gscale; recompute cheaply is not
-        # worth it — gscale is a static normalization in practice, but
-        # cotangents must exist for a differentiable scalar input
-        jnp.zeros((), jnp.float32),
+        # gscale is differentiable (a caller may thread dynamic loss
+        # scaling through it): d loss / d gscale = Σ nll, saved forward
+        g * nll_sum,
     )
 
 
@@ -169,11 +169,34 @@ def fused_linear_cross_entropy(
     va = (
         jnp.ones(t.shape, bool) if valid is None else valid.reshape(-1)
     )
+    # normalization parity with the model losses (causal_lm_loss /
+    # mlm_loss): out-of-range non-sentinel ids contribute zero NLL but
+    # still count in the denominator and the returned n
     in_range = (t >= 0) & (t < w.shape[1])
-    va = va & in_range
+    contrib = va & in_range
     t = jnp.where(in_range, t, 0)
     n_valid = jnp.sum(va)
     denom = jnp.maximum(n_valid, 1).astype(jnp.float32)
     gscale = (1.0 / denom) if mean else jnp.float32(1.0)
-    loss = _fused_ce(x, w, t, va, gscale, int(block_vocab))
+    loss = _fused_ce(x, w, t, contrib, gscale, int(block_vocab))
     return loss, n_valid
+
+
+def fused_causal_lm_loss(
+    hidden, w, tokens, *, ignore_index: int = -1,
+    block_vocab: int = 8192,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Next-token LM loss from pre-head activations — the fused
+    counterpart of models.transformer.causal_lm_loss(logits, tokens):
+    positions predict tokens[:, 1:], `ignore_index` targets drop out,
+    and the result is averaged over valid positions.
+
+    `hidden`: [B, T, h] (model __call__ with return_hidden=True);
+    `w`: [h, V] head kernel (tied: params["tok_emb"]["embedding"].T).
+    Returns (loss, n_tokens)."""
+    targets = tokens[:, 1:]
+    valid = targets != ignore_index
+    return fused_linear_cross_entropy(
+        hidden[:, :-1], w, targets, valid=valid,
+        block_vocab=block_vocab,
+    )

@@ -11,15 +11,18 @@ from horovod_tpu.ops.fused_cross_entropy import fused_linear_cross_entropy
 
 
 def _naive(hidden, w, targets, valid=None, mean=True):
+    """Materializing oracle with the MODEL losses' normalization: the
+    user `valid` mask defines the denominator; out-of-range ids inside
+    it contribute zero NLL but still count (causal_lm_loss semantics)."""
     x = hidden.reshape(-1, hidden.shape[-1]).astype(jnp.float32)
     logits = x @ w.astype(jnp.float32)
     t = targets.reshape(-1)
     va = jnp.ones(t.shape, bool) if valid is None else valid.reshape(-1)
-    va = va & (t >= 0) & (t < w.shape[1])
-    t = jnp.where(va, t, 0)
+    in_range = (t >= 0) & (t < w.shape[1])
+    tc = jnp.where(in_range, t, 0)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    tgt = jnp.take_along_axis(logits, t[:, None], axis=-1)[:, 0]
-    nll = jnp.where(va, lse - tgt, 0.0)
+    tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+    nll = jnp.where(va & in_range, lse - tgt, 0.0)
     denom = jnp.maximum(jnp.sum(va), 1)
     return jnp.sum(nll) / (denom if mean else 1)
 
@@ -78,6 +81,21 @@ def test_masked_and_out_of_range_targets():
     np.testing.assert_allclose(dx[dead], 0.0, atol=1e-7)
 
 
+def test_out_of_range_counts_in_denominator():
+    """Normalization parity with causal_lm_loss: a non-sentinel id >= V
+    (valid=True) contributes zero NLL but still counts in n and the
+    mean's denominator."""
+    rng = np.random.RandomState(5)
+    N, H, V = 8, 8, 10
+    x = jnp.asarray(rng.normal(size=(N, H)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(H, V)) * 0.1, jnp.float32)
+    t = jnp.asarray(rng.randint(0, V, N)).at[0].set(V + 3)
+    loss, n = fused_linear_cross_entropy(x, w, t, block_vocab=4)
+    assert int(n) == N  # the corrupt id still counted
+    ref = _naive(x, w, t)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+
 def test_bf16_hidden_path():
     """Model-dtype activations: the matmuls run bf16→f32 like the head
     they replace; values agree with the f32 naive loss at bf16
@@ -103,3 +121,32 @@ def test_sum_mode_and_count():
     assert int(n) == 24
     np.testing.assert_allclose(float(s_loss) / 24, float(m_loss),
                                rtol=1e-6)
+
+
+def test_fused_causal_lm_loss_matches_model_loss():
+    """fused_causal_lm_loss(hidden, w, tokens) equals
+    causal_lm_loss(logits, tokens) for a real tied-embedding
+    transformer at f32."""
+    import dataclasses
+
+    from horovod_tpu.models import GPT2_SMALL, Transformer
+    from horovod_tpu.models.transformer import causal_lm_loss
+    from horovod_tpu.ops.fused_cross_entropy import fused_causal_lm_loss
+
+    cfg = dataclasses.replace(
+        GPT2_SMALL, num_layers=2, hidden_size=64, num_heads=4,
+        max_seq_len=32, vocab_size=96, dtype=jnp.float32,
+    )
+    model = Transformer(cfg)
+    rng = np.random.RandomState(7)
+    toks = jnp.asarray(rng.randint(0, 96, (3, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+
+    logits = model.apply({"params": params}, toks)
+    ref, n_ref = causal_lm_loss(logits, toks)
+
+    hidden = model.apply({"params": params}, toks, return_hidden=True)
+    w = params["tok_emb"]["embedding"].T
+    fused, n_fused = fused_causal_lm_loss(hidden, w, toks, block_vocab=32)
+    assert int(n_ref) == int(n_fused)
+    np.testing.assert_allclose(float(fused), float(ref), rtol=1e-5)
