@@ -219,3 +219,98 @@ def test_resnet_space_to_depth_stem():
                      mutable=["batch_stats"])
     assert out.shape == (2, 10)
     assert bool(jnp.isfinite(out).all())
+
+
+def test_resnet_fused_bn_matches_flax_bn():
+    """fused_bn=True (pallas BN+relu+residual epilogues) computes the
+    same function as the flax.linen.BatchNorm path — same math, different
+    kernels — so logits and gradients must agree in f32."""
+    from horovod_tpu.models import ResNet
+
+    x = jnp.asarray(
+        np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    y = jnp.array([1, 3])
+    ref = ResNet(stage_sizes=[1, 1], num_classes=10, num_filters=8,
+                 dtype=jnp.float32)
+    fused = ResNet(stage_sizes=[1, 1], num_classes=10, num_filters=8,
+                   dtype=jnp.float32, fused_bn=True)
+    v_ref = ref.init(jax.random.PRNGKey(0), x)
+    v_fused = fused.init(jax.random.PRNGKey(0), x)
+    # param trees are identical modulo module class names
+    def rename(tree):
+        if isinstance(tree, dict):
+            return {k.replace("BatchNorm", "FusedBatchNorm")
+                    if k.startswith("BatchNorm") else k: rename(v)
+                    for k, v in tree.items()}
+        return tree
+
+    def run(model, variables):
+        def loss(p):
+            out, _ = model.apply(
+                {"params": p, "batch_stats": variables["batch_stats"]},
+                x, train=True, mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(y, 10)
+            return -jnp.mean(
+                jnp.sum(onehot * jax.nn.log_softmax(out), -1))
+        return jax.value_and_grad(loss)(variables["params"])
+
+    v_fused_params = rename(
+        jax.tree_util.tree_map(lambda a: a, v_ref["params"]))
+    assert jax.tree_util.tree_structure(
+        v_fused_params) == jax.tree_util.tree_structure(v_fused["params"])
+    l_ref, g_ref = run(ref, v_ref)
+    l_fused, g_fused = run(
+        fused, {"params": v_fused_params,
+                "batch_stats": v_fused["batch_stats"]})
+    np.testing.assert_allclose(
+        float(l_fused), float(l_ref), rtol=1e-4, atol=1e-4)
+    g_ref_renamed = rename(g_ref)
+    for path, a_f in jax.tree_util.tree_leaves_with_path(g_fused):
+        a_r = g_ref_renamed
+        for k in path:
+            a_r = a_r[k.key]
+        scale = float(jnp.abs(a_r).max()) + 1e-6
+        np.testing.assert_allclose(
+            np.asarray(a_f), np.asarray(a_r),
+            atol=5e-4 * scale, rtol=5e-3,
+            err_msg=str(path))
+
+
+def test_resnet_one_by_one_dot_matches_conv():
+    """one_by_one="dot" (1x1 convs as channel matmuls) is numerically
+    the same model as the conv lowering."""
+    from horovod_tpu.models import ResNet
+
+    x = jnp.asarray(
+        np.random.RandomState(1).rand(2, 32, 32, 3), jnp.float32)
+    conv = ResNet(stage_sizes=[1, 1], num_classes=10, num_filters=8,
+                  dtype=jnp.float32)
+    dot = ResNet(stage_sizes=[1, 1], num_classes=10, num_filters=8,
+                 dtype=jnp.float32, one_by_one="dot")
+    v_conv = conv.init(jax.random.PRNGKey(0), x)
+    v_dot = dot.init(jax.random.PRNGKey(0), x)
+
+    # block-level module names shift: Conv_0/1/2 (1x1,3x3,1x1) becomes
+    # ChannelDot_0, Conv_0 (3x3), ChannelDot_1
+    def rename_block(tree, in_block=False):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for k, v in tree.items():
+            k2 = k
+            if in_block:
+                k2 = {"Conv_0": "ChannelDot_0", "Conv_1": "Conv_0",
+                      "Conv_2": "ChannelDot_1"}.get(k, k)
+            out[k2] = rename_block(v, k.startswith("BottleneckBlock"))
+        return out
+
+    v_dot_params = rename_block(
+        jax.tree_util.tree_map(lambda a: a, v_conv["params"]))
+    assert jax.tree_util.tree_structure(
+        v_dot_params) == jax.tree_util.tree_structure(v_dot["params"])
+    out_c, _ = conv.apply(v_conv, x, train=True, mutable=["batch_stats"])
+    out_d, _ = dot.apply(
+        {"params": v_dot_params, "batch_stats": v_dot["batch_stats"]},
+        x, train=True, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_c),
+                               rtol=1e-4, atol=1e-4)

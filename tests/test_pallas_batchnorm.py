@@ -1,0 +1,141 @@
+"""Fused pallas BatchNorm vs flax.linen.BatchNorm numerics.
+
+Covers the shapes the CNN family hits: C=64 (row→lane fold), C=192
+(non-multiple-of-128 lanes), C=256 (native width), row counts that
+don't divide the kernel row block (masking), relu and residual
+epilogues, forward values, running statistics, and all input gradients.
+Runs in pallas interpret mode on the CPU test mesh — the same code path
+the TPU build executes (interpret flag is the only difference).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.pallas_batchnorm import FusedBatchNorm, fused_batch_norm
+
+
+def _ref(x, g, b, residual=None, act=None, eps=1e-5):
+    m = x.mean(axis=tuple(range(x.ndim - 1)))
+    v = ((x - m) ** 2).mean(axis=tuple(range(x.ndim - 1)))
+    y = (x - m) * jax.lax.rsqrt(v + eps) * g + b
+    if residual is not None:
+        y = y + residual
+    if act == "relu":
+        y = jnp.maximum(y, 0)
+    return y, m, v
+
+
+@pytest.mark.parametrize(
+    "shape,res,act",
+    [
+        ((4, 9, 9, 64), False, None),       # fold path, odd rows
+        ((4, 7, 7, 192), False, "relu"),    # padded lanes
+        ((2, 5, 5, 256), True, "relu"),     # native width + residual
+        ((2, 3, 3, 32), True, None),        # deep fold
+        ((64, 256), False, "relu"),         # 2-D input
+    ],
+)
+def test_forward_and_stats_match_flax(shape, res, act):
+    rng = np.random.RandomState(0)
+    C = shape[-1]
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    r = jnp.asarray(rng.randn(*shape), jnp.float32) if res else None
+    g = jnp.asarray(rng.rand(C) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(C), jnp.float32)
+    y, m, v = jax.jit(
+        lambda x, g, b, r: fused_batch_norm(
+            x, g, b, activation=act, residual=r),
+        static_argnames=(),
+    )(x, g, b, r)
+    y0, m0, v0 = _ref(x, g, b, r, act)
+    np.testing.assert_allclose(y, y0, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(m, m0, atol=1e-6)
+    np.testing.assert_allclose(v, v0, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "shape,res,act",
+    [
+        ((4, 9, 9, 64), False, None),
+        ((4, 7, 7, 192), False, "relu"),
+        ((2, 5, 5, 256), True, "relu"),
+    ],
+)
+def test_gradients_match_reference(shape, res, act):
+    rng = np.random.RandomState(1)
+    C = shape[-1]
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    r = jnp.asarray(rng.randn(*shape), jnp.float32) if res else None
+    g = jnp.asarray(rng.rand(C) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(C), jnp.float32)
+
+    def loss(fn):
+        def inner(args):
+            y = fn(*args)
+            return jnp.sum(y * jnp.cos(y))
+        return inner
+
+    ours = loss(lambda x, g, b, *r_: fused_batch_norm(
+        x, g, b, activation=act, residual=r_[0] if r_ else None)[0])
+    ref = loss(lambda x, g, b, *r_: _ref(
+        x, g, b, r_[0] if r_ else None, act)[0])
+    args = (x, g, b, r) if res else (x, g, b)
+    g1 = jax.grad(ref)(args)
+    g2 = jax.jit(jax.grad(ours))(args)
+    for a1, a2 in zip(g1, g2):
+        scale = float(jnp.abs(a1).max()) + 1e-9
+        np.testing.assert_allclose(a2, a1, atol=5e-5 * scale, rtol=5e-4)
+
+
+def test_module_matches_flax_batchnorm_train_and_eval():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(8, 5, 5, 64), jnp.float32)
+    fbn = FusedBatchNorm(momentum=0.9, epsilon=1e-5)
+    ref = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                       epsilon=1e-5)
+    v1 = fbn.init(jax.random.PRNGKey(0), x)
+    v2 = ref.init(jax.random.PRNGKey(0), x)
+    y1, m1 = fbn.apply(v1, x, mutable=["batch_stats"])
+    y2, m2 = ref.apply(v2, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(y1, y2, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(
+        m1["batch_stats"]["mean"],
+        m2["batch_stats"]["BatchNorm_0"]["mean"]
+        if "BatchNorm_0" in m2["batch_stats"] else m2["batch_stats"]["mean"],
+        atol=1e-6)
+    # eval path: running averages, plain affine
+    y1e = fbn.apply(
+        {"params": v1.get("params", {}), "batch_stats":
+         m1["batch_stats"]}, x, use_running_average=True)
+    ref_eval = nn.BatchNorm(use_running_average=True, momentum=0.9,
+                            epsilon=1e-5)
+    y2e = ref_eval.apply(
+        {"params": v2.get("params", {}), "batch_stats":
+         m2["batch_stats"]}, x)
+    np.testing.assert_allclose(y1e, y2e, atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_input_keeps_f32_statistics():
+    rng = np.random.RandomState(3)
+    x32 = rng.randn(16, 3, 3, 128).astype(np.float32)
+    x = jnp.asarray(x32, jnp.bfloat16)
+    g = jnp.ones((128,), jnp.float32)
+    b = jnp.zeros((128,), jnp.float32)
+    y, m, v = fused_batch_norm(x, g, b, activation="relu")
+    assert y.dtype == jnp.bfloat16
+    assert m.dtype == jnp.float32 and v.dtype == jnp.float32
+    m0 = jnp.asarray(x32, jnp.bfloat16).astype(jnp.float32).mean((0, 1, 2))
+    np.testing.assert_allclose(m, m0, atol=1e-3)
+
+
+def test_rejects_bad_activation_and_shape():
+    x = jnp.zeros((4, 4, 4, 64))
+    g = jnp.ones((64,))
+    b = jnp.zeros((64,))
+    with pytest.raises(ValueError):
+        fused_batch_norm(x, g, b, activation="gelu")
+    with pytest.raises(ValueError):
+        fused_batch_norm(x, g, b, residual=jnp.zeros((4, 4, 4, 32)))
