@@ -35,7 +35,7 @@ from horovod_tpu.utils.mfu import (
 )
 
 
-def main(argv=None):
+def main(argv=None, stats=None):
     p = argparse.ArgumentParser(
         description="horovod_tpu BERT-Large pretraining benchmark"
     )
@@ -59,6 +59,9 @@ def main(argv=None):
     p.add_argument("--fused-ce", action="store_true",
                    help="vocab-blocked fused LM-head cross-entropy "
                         "(logits never materialize in HBM)")
+    p.add_argument("--fused-ln", action="store_true",
+                   help="pallas single-pass LayerNorm kernels "
+                        "(ops/pallas_layernorm.py)")
     args = p.parse_args(argv)
 
     hvd.init()
@@ -74,7 +77,8 @@ def main(argv=None):
             cfg, hidden_size=args.hidden, num_heads=heads
         )
     cfg = dataclasses.replace(
-        cfg, max_seq_len=args.seq_len, remat=args.remat
+        cfg, max_seq_len=args.seq_len, remat=args.remat,
+        fused_norm=args.fused_ln,
     )
     attention_fn = None
     if args.flash:
@@ -171,6 +175,8 @@ def main(argv=None):
             f"({per_chip:.0f}/chip, MFU {mfu:.1%})",
             flush=True,
         )
+    if stats is not None:  # per-iter spread for bench.py's JSON
+        stats["rates_per_chip"] = [r / max(n, 1) for r in rates]
     return per_chip, mfu
 
 

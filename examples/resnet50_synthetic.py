@@ -42,7 +42,7 @@ _MODELS = {
 }
 
 
-def main(argv=None):
+def main(argv=None, stats=None):
     p = argparse.ArgumentParser(
         description="horovod_tpu synthetic CNN benchmark "
                     "(--model resnet50/101/152, inception3, vgg16)"
@@ -186,6 +186,8 @@ def main(argv=None):
 
     total = float(np.median(rates))
     per_chip = total / max(n, 1)  # n = total chips in the world
+    if stats is not None:  # per-iter spread for bench.py's JSON
+        stats["rates_per_chip"] = [r / max(n, 1) for r in rates]
     mfu = (
         cnn_train_flops(args.model, per_chip, args.image_size)
         / peak_flops_per_chip()

@@ -101,6 +101,9 @@ def load() -> ctypes.CDLL:
     lib.hvd_native_tuned_cycle_ms.restype = ctypes.c_double
     lib.hvd_native_tuned_threshold.restype = ctypes.c_longlong
     lib.hvd_native_tuned_pinned.restype = ctypes.c_int
+    lib.hvd_native_tuned_cache_enabled.restype = ctypes.c_int
+    lib.hvd_native_tuned_hierarchical.restype = ctypes.c_int
+    lib.hvd_native_tuned_hier_block.restype = ctypes.c_longlong
     lib.hvd_native_enqueue.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
@@ -376,3 +379,12 @@ class NativeRuntime:
 
     def tuned_pinned(self) -> bool:
         return bool(self._lib.hvd_native_tuned_pinned())
+
+    def tuned_cache_enabled(self) -> bool:
+        return bool(self._lib.hvd_native_tuned_cache_enabled())
+
+    def tuned_hierarchical(self) -> bool:
+        return bool(self._lib.hvd_native_tuned_hierarchical())
+
+    def tuned_hier_block(self) -> int:
+        return self._lib.hvd_native_tuned_hier_block()

@@ -192,6 +192,14 @@ struct ResponseList {
   double tuned_cycle_ms = 0.0;
   int64_t tuned_threshold = 0;
   bool tuned_pinned = false;
+  // Widened search space (reference parameter_manager.h:186 also flips
+  // response-cache and hierarchical-collective toggles): shipped every
+  // cycle like the scalar knobs above.
+  bool tuned_cache_enabled = true;
+  bool tuned_hierarchical = false;
+  // ranks per inner (ICI) domain for hierarchical collectives
+  // (ops/hierarchical.py resolve_block); 0 = launcher-topology default
+  int64_t tuned_hier_block = 0;
 };
 
 }  // namespace hvd

@@ -443,7 +443,7 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
   // must erase it in the same cycle.
   std::vector<uint32_t> agreed_positions;
   std::vector<uint64_t> agreed_invalid;
-  if (cache != nullptr && cache->capacity() > 0) {
+  if (cache != nullptr && cache->capacity() > 0 && at_cache_enabled_) {
     std::vector<std::vector<uint64_t>> bitsets;
     std::vector<uint64_t> any_bits;  // OR of all claims
     for (int32_t r = 0; r < opts_.size; ++r) {
@@ -667,6 +667,9 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
     rl.tuned_cycle_ms = tuned_cycle_ms_;
     rl.tuned_threshold = fusion_threshold_;
     rl.tuned_pinned = autotune_pinned_;
+    rl.tuned_cache_enabled = at_cache_enabled_;
+    rl.tuned_hierarchical = at_hierarchical_;
+    rl.tuned_hier_block = at_hier_block_;
   }
 
   // 7. broadcast the agreed list
@@ -716,7 +719,10 @@ void TcpController::AutotuneObserve(const ResponseList& rl) {
     if (at_phase_ == 0) {
       if (--at_warmup_left_ > 0) return;
       at_phase_ = 1;
-      bayes_.reset(new BayesianTuner(2));
+      // 5-D space: threshold, cycle, cache toggle, hierarchical toggle,
+      // hierarchical block size (reference parameter_manager.h:186's
+      // BayesianParameter set, continuous-relaxed)
+      bayes_.reset(new BayesianTuner(5));
       ApplyBayesPoint(bayes_->Next());
       return;
     }
@@ -772,11 +778,20 @@ void TcpController::AutotuneObserve(const ResponseList& rl) {
 void TcpController::ApplyBayesPoint(const std::vector<double>& x) {
   // unit cube → knobs: x0 = log2(threshold) in [20, 28] (1 MB..256 MB),
   // x1 = ln(cycle_ms) in [ln 0.25, ln 5] — the same ranges the
-  // coordinate-descent grids span
+  // coordinate-descent grids span; x2/x3 = response-cache and
+  // hierarchical toggles (>= 0.5 = on; the seeding design's corners
+  // guarantee both values are explored); x4 = log2(ranks per inner ICI
+  // domain) in [1, 4] (2..16 ranks, ops/hierarchical.py resolve_block)
   double lg2 = 20.0 + 8.0 * x[0];
   fusion_threshold_ = static_cast<int64_t>(std::pow(2.0, lg2));
   double lo = std::log(0.25), hi = std::log(5.0);
   tuned_cycle_ms_ = std::exp(lo + (hi - lo) * x[1]);
+  if (x.size() >= 5) {
+    at_cache_enabled_ = x[2] >= 0.5;
+    at_hierarchical_ = x[3] >= 0.5;
+    at_hier_block_ = static_cast<int64_t>(
+        std::pow(2.0, std::floor(1.0 + 3.0 * x[4] + 0.5)));
+  }
 }
 
 }  // namespace hvd

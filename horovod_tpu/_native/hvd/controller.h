@@ -147,6 +147,13 @@ class TcpController {
   double at_best_score_ = 0.0;
   int64_t at_best_threshold_ = 0;
   double at_best_cycle_ = 0.0;
+  // widened space (reference parameter_manager.h:186): response-cache
+  // toggle, hierarchical-collective toggle + block size. The cache
+  // toggle gates the coordinator's agreed-bits fast path directly; all
+  // three ship to workers in every ResponseList.
+  bool at_cache_enabled_ = true;
+  bool at_hierarchical_ = false;
+  int64_t at_hier_block_ = 0;
   // Bayesian path (HOROVOD_AUTOTUNE_BAYES): tuner lives on the
   // coordinator only; winners still ship in every ResponseList
   std::unique_ptr<BayesianTuner> bayes_;

@@ -111,6 +111,9 @@ struct Global {
   std::atomic<double> tuned_cycle_ms{0.0};
   std::atomic<long long> tuned_threshold{0};
   std::atomic<bool> tuned_pinned{false};
+  std::atomic<bool> tuned_cache_enabled{true};
+  std::atomic<bool> tuned_hierarchical{false};
+  std::atomic<long long> tuned_hier_block{0};
 
   std::mutex err_mu;
   std::string last_error;
@@ -166,7 +169,8 @@ bool RunLoopOnce() {
 
   // drain new requests, classify against the cache
   auto drained = g->tensor_queue.PopMessages(512);
-  bool cache_on = g->cache && g->cache->capacity() > 0;
+  bool cache_on = g->cache && g->cache->capacity() > 0 &&
+                  g->tuned_cache_enabled.load();
   for (auto& req : drained) {
     // grouped requests never ride the cache fast path: a partial set of
     // agreed cache hits could release some group members while others
@@ -244,6 +248,11 @@ bool RunLoopOnce() {
   }
   if (rl.tuned_threshold > 0) g->tuned_threshold.store(rl.tuned_threshold);
   if (rl.tuned_pinned) g->tuned_pinned.store(true);
+  g->tuned_cache_enabled.store(rl.tuned_cache_enabled);
+  g->tuned_hierarchical.store(rl.tuned_hierarchical);
+  if (rl.tuned_hier_block > 0) {
+    g->tuned_hier_block.store(rl.tuned_hier_block);
+  }
 
   // Apply the coordinated invalidations before any Put from this cycle's
   // responses: same order on every rank, identical cache state after.
@@ -484,16 +493,22 @@ void hvd_bayes_test_create(int dims) {
   bayes_test = new BayesianTuner(dims);
 }
 
+// Null guards: ctypes misuse (calling before _create / after _free)
+// degrades to a no-op instead of a segfault in the embedding process
+// (ADVICE r3).
 void hvd_bayes_test_next(double* out, int dims) {
+  if (bayes_test == nullptr) return;
   const std::vector<double>& x = bayes_test->Next();
   for (int d = 0; d < dims; ++d) out[d] = x[d];
 }
 
 void hvd_bayes_test_observe(const double* x, int dims, double y) {
+  if (bayes_test == nullptr) return;
   bayes_test->Observe(std::vector<double>(x, x + dims), y);
 }
 
 void hvd_bayes_test_best(double* out, int dims) {
+  if (bayes_test == nullptr) return;
   std::vector<double> b = bayes_test->Best();
   for (int d = 0; d < dims; ++d) out[d] = b[d];
 }
@@ -819,6 +834,18 @@ long long hvd_native_tuned_threshold() {
 
 int hvd_native_tuned_pinned() {
   return g && g->tuned_pinned.load() ? 1 : 0;
+}
+
+int hvd_native_tuned_cache_enabled() {
+  return (g == nullptr || g->tuned_cache_enabled.load()) ? 1 : 0;
+}
+
+int hvd_native_tuned_hierarchical() {
+  return g && g->tuned_hierarchical.load() ? 1 : 0;
+}
+
+long long hvd_native_tuned_hier_block() {
+  return g ? g->tuned_hier_block.load() : 0;
 }
 
 }  // extern "C"

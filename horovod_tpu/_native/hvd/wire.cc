@@ -118,6 +118,9 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.F64(rl.tuned_cycle_ms);
   w.I64(rl.tuned_threshold);
   w.U8(rl.tuned_pinned ? 1 : 0);
+  w.U8(rl.tuned_cache_enabled ? 1 : 0);
+  w.U8(rl.tuned_hierarchical ? 1 : 0);
+  w.I64(rl.tuned_hier_block);
   w.I32(static_cast<int32_t>(rl.responses.size()));
   for (const auto& r : rl.responses) WriteResponse(&w, r);
   return w.data();
@@ -132,6 +135,9 @@ bool DeserializeResponseList(const uint8_t* data, size_t len,
   rl->tuned_cycle_ms = r.F64();
   rl->tuned_threshold = r.I64();
   rl->tuned_pinned = r.U8() != 0;
+  rl->tuned_cache_enabled = r.U8() != 0;
+  rl->tuned_hierarchical = r.U8() != 0;
+  rl->tuned_hier_block = r.I64();
   int32_t n = r.I32();
   rl->responses.clear();
   for (int32_t i = 0; i < n && r.ok(); ++i) {

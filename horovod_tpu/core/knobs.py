@@ -71,6 +71,11 @@ class Knobs:
     # --- fusion / bucketing (controller.cc:830 FuseResponses analog) ---
     fusion_threshold_bytes: int = 128 * 1024 * 1024
     batch_d2d_memcopies: bool = True
+    # chain bucket k on bucket k-1's result (reference controller-order
+    # execution) so XLA's combiner can't merge buckets into one
+    # all-grads-gated all-reduce — the property that lets collectives
+    # overlap backward compute (optim/distributed.py, overlap tests)
+    ordered_buckets: bool = True
 
     # --- background/eager runtime (operations.cc:515) ---
     cycle_time_ms: float = 1.0
@@ -137,6 +142,7 @@ class Knobs:
                 "FUSION_THRESHOLD", 128 * 1024 * 1024
             ),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
+            ordered_buckets=_env_bool("ORDERED_BUCKETS", True),
             cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
             cache_capacity=_env_int("CACHE_CAPACITY", 1024),
             cache_enabled=_env_int("CACHE_CAPACITY", 1024) > 0,

@@ -49,6 +49,10 @@ class TransformerConfig:
     remat: bool = False
     rope_theta: float = 10000.0
     layernorm_epsilon: float = 1e-5
+    # pallas single-pass norm kernels (ops/pallas_layernorm.py); XLA's
+    # standalone layernorm fusions measured ~9x off the HBM floor on the
+    # BERT-L bench (docs/benchmarks.md)
+    fused_norm: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -114,6 +118,12 @@ class RMSNorm(nn.Module):
 
 
 def _norm(cfg: TransformerConfig, name: str):
+    if cfg.fused_norm:
+        from ..ops.pallas_layernorm import FusedLayerNorm
+
+        return FusedLayerNorm(
+            epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype,
+            param_dtype=jnp.float32, kind=cfg.norm, name=name)
     if cfg.norm == "rmsnorm":
         return RMSNorm(epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype,
                        name=name)

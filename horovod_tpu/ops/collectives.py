@@ -920,32 +920,47 @@ def alltoall(
             )
         rt = global_state().eager_runtime
         if rt is not None:
-            if ps is not None and ps.process_set_id != 0:
-                # never fall through to the single-controller fabrication:
-                # in a real multi-process world it would return wrong data
-                # silently (tile of our own chunk-0)
-                raise HorovodInternalError(
-                    "process-set collectives under the native eager "
-                    "runtime need per-set controllers; run subsets "
-                    "through the SPMD form (shard_map + process_set)"
-                )
             # true ragged exchange: the controller negotiates the full
-            # splits matrix, the executor pads/slices around one uniform
-            # all_to_all HLO (reference operations.cc:1858)
+            # splits matrix (in set-local coordinates for non-global
+            # sets, controller.cc BuildResponse), the executor
+            # pads/slices around one uniform all_to_all HLO over the
+            # set's sub-mesh (reference operations.cc:1858)
+            sid = 0
+            if ps is not None and ps.process_set_id != 0:
+                sid = ps.process_set_id
+                if rt.process_set_members(sid) is None:
+                    raise HorovodInternalError(
+                        f"process set {sid} is not registered with the "
+                        "native runtime; call hvd.add_process_set on "
+                        "every rank first (reference process_sets.py:123)"
+                    )
             out, recv = _native_eager(
                 rt, "alltoall", tensor, name=name,
                 splits=[int(s) for s in np.asarray(splits)],
+                process_set_id=sid,
             )
             return out, recv
-        # eager single-controller: all ranks hold identical tensors, so the
-        # rank-0 view receives each peer's chunk-0 = tensor[:splits[0]],
-        # i.e. that chunk tiled n times (consistent with the equal-split
-        # eager path, which produces the same via the real all_to_all).
+        # eager single-controller (no native runtime): run the batch
+        # through the LoopbackExecutor — the same implementation every
+        # single-process world uses (identical replicated buffers, the
+        # received layout is column `rank` of the splits matrix) — rather
+        # than a hand-built special case.
+        from .eager_runtime import ExecutionBatch, LoopbackExecutor
+        from .._native import OP_ALLTOALL
+
         n = _group_size(ps, axis_name)
-        chunk0 = jnp.asarray(tensor)[: int(splits[0])]
-        reps = (n,) + (1,) * (chunk0.ndim - 1)
-        received_splits = jnp.full((n,), splits[0])
-        return jnp.tile(chunk0, reps), received_splits
+        rank_local = 0 if ps is None else ps.rank(basics.rank())
+        x = np.asarray(tensor)
+        batch = ExecutionBatch(
+            batch_id=0, op=OP_ALLTOALL, reduce_op=0, root_rank=0,
+            prescale=1.0, postscale=1.0, dtype=str(x.dtype),
+            total_bytes=x.nbytes, names=["alltoall"], handles=[0],
+            first_shape=list(x.shape), error_reason="",
+            all_splits=[int(s) for s in np.asarray(splits)] * n,
+        )
+        out, received_splits = LoopbackExecutor(n, rank_local)(
+            batch, {"alltoall": x})["alltoall"]
+        return jnp.asarray(out), jnp.asarray(received_splits)
 
     namer = _leaf_namer(name)
 

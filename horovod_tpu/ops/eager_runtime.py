@@ -198,6 +198,7 @@ class EagerRuntime:
         self._handle_name: Dict[int, str] = {}
         self._handle_op: Dict[int, int] = {}
         self._last_cycle = -1
+        self._tuning_applied = False
         self._shutdown = threading.Event()
         self._worker = threading.Thread(
             target=self._run, daemon=True, name="hvd-eager-executor"
@@ -382,6 +383,7 @@ class EagerRuntime:
                 tl.activity_end(name, _OP_ACTIVITIES[op][0])
                 tl.instant(name, "ERROR")
             raise HorovodInternalError(self._native.last_error())
+        self._apply_pinned_tuning()
         with self._lock:
             if handle not in self._results:
                 raise HorovodInternalError(
@@ -389,6 +391,23 @@ class EagerRuntime:
                     f"{self._native.last_error()}"
                 )
             return self._results.pop(handle)
+
+    def _apply_pinned_tuning(self) -> None:
+        """Once the coordinator pins autotune winners, steer the
+        SPMD-side knobs so subsequently compiled steps pick up the tuned
+        hierarchical routing (ops/hierarchical.py gates on these). Runs
+        at most once, on the first synchronize() after the pin — the
+        same moment the reference applies ParameterManager winners."""
+        if self._tuning_applied or not self._native.tuned_pinned():
+            return
+        self._tuning_applied = True
+        from ..core.state import global_state
+
+        k = global_state().knobs
+        k.hierarchical_allreduce = bool(self._native.tuned_hierarchical())
+        local = int(self._native.tuned_hier_block())
+        if local > 0:
+            k.hierarchical_local_size = local
 
     # ------------------------------------------------------------- worker
 
@@ -473,6 +492,9 @@ class EagerRuntime:
             "cycle_ms": self._native.tuned_cycle_ms(),
             "fusion_threshold_bytes": self._native.tuned_threshold(),
             "pinned": self._native.tuned_pinned(),
+            "cache_enabled": self._native.tuned_cache_enabled(),
+            "hierarchical_allreduce": self._native.tuned_hierarchical(),
+            "hierarchical_local_size": self._native.tuned_hier_block(),
         }
 
     def shutdown(self) -> None:

@@ -55,12 +55,32 @@ def _reduce_grad_tree(
         return grads  # single rank: nothing to reduce
 
     n = collectives._group_size(process_set, axis_name)
+    if n <= 1:
+        # a live mesh axis of size 1 (single-chip bench world): the
+        # collective is an identity, so skip the fusion-bucket
+        # pack/unpack too — the traced BERT step spent ~4% of device
+        # time packing buckets nothing would ever ride (docs/benchmarks.md)
+        return grads
 
     buckets, unflatten = flatten_pytree_buckets(
         grads, threshold_bytes=fusion_threshold_bytes
     )
+    # Ordered buckets (reference semantics: fused responses execute in
+    # controller order, operations.cc PerformOperation): chain bucket k
+    # on bucket k-1's result through an optimization_barrier. Without
+    # this XLA's all-reduce combiner merges every bucket into ONE
+    # variadic all-reduce that can only run after ALL gradients exist —
+    # destroying comm/compute overlap. With it, bucket k's collective
+    # stays a separate op whose only inputs are its own gradients (plus
+    # the ordering edge), so the scheduler issues it while backward for
+    # earlier layers is still computing (tests/test_overlap_schedule.py
+    # asserts this on the compiled schedule).
+    ordered = global_state().knobs.ordered_buckets and len(buckets) > 1
     reduced = []
+    prev = None
     for b in buckets:
+        if ordered and prev is not None:
+            b, _ = jax.lax.optimization_barrier((b, prev))
         wire, ctx = compression.compress(b)
         if op == ReduceOp.ADASUM:
             if not live:
@@ -75,6 +95,7 @@ def _reduce_grad_tree(
                 axis_name=axis_name,
                 postscale_factor=(1.0 / n) if op == ReduceOp.AVERAGE else 1.0,
             )
+        prev = red
         reduced.append(compression.decompress(red, ctx))
     pm = global_state().parameter_manager
     if pm is not None:

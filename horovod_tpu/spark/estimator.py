@@ -11,11 +11,11 @@ writes the DataFrame to a Petastorm parquet store, and streams row
 groups into per-rank data loaders. JAX models are pytrees and the TPU
 input path is host numpy → device shards, so this estimator
 
-  * extracts (features, labels) from the DataFrame once on the driver
-    (numpy), and shards rows per rank inside the Spark barrier task —
-    the Store/Petastorm machinery is replaced by the framework's own
-    data layer (`data.ShardedDataLoader` feeds bigger-than-driver data
-    outside Spark);
+  * materializes the DataFrame into rank-shardable npz part files
+    through the Store ON THE EXECUTORS (prepare_data — the analog of
+    the reference's Petastorm parquet write, spark/common/util.py);
+    each rank reads only its own share of parts, so dataset size is
+    bounded by the Store, never driver RAM;
   * trains with the standard recipe: `hvd.init()` →
     `DistributedOptimizer(optax...)` → per-rank minibatch loop, exactly
     what `spark.run` slots provide;
@@ -42,13 +42,109 @@ def _rows_to_matrix(rows, cols: Sequence[str]) -> np.ndarray:
     )
 
 
-def _require_numpy_df(df, feature_cols: Sequence[str],
-                      label_cols: Sequence[str]):
-    """DataFrame → (X, Y) float32 numpy (driver-side materialization)."""
-    rows = df.collect()
-    return _rows_to_matrix(rows, feature_cols), _rows_to_matrix(
-        rows, label_cols
-    )
+def prepare_data(df, store, run_id: str, feature_cols: Sequence[str],
+                 label_cols: Sequence[str], validation: float = 0.0,
+                 seed: int = 0) -> Sequence[str]:
+    """Materialize the DataFrame into rank-shardable part files through
+    the Store — ON THE EXECUTORS, partition by partition. The driver
+    only ever sees (partition index, row count) pairs, so dataset size
+    is bounded by the Store, not driver RAM (reference
+    spark/common/util.py prepare_data + store.py:167's per-rank
+    row-group layout; npz parts instead of Petastorm parquet — the TPU
+    input path is host numpy → device shards).
+
+    Each part carries its own train/validation split (every
+    ceil(1/validation)-th row, deterministic in `seed`), mirroring the
+    reference's validation-column split. Returns the part file names
+    (relative to ``store.get_data_path(run_id)``), sorted.
+    """
+    import io
+
+    prefix = store.prefix_path
+    data_path = store.get_data_path(run_id)
+    fcols, lcols = list(feature_cols), list(label_cols)
+
+    def write_partition(idx, rows):
+        from .store import Store
+
+        rows = list(rows)
+        if not rows:
+            return iter([])
+        x = _rows_to_matrix(rows, fcols)
+        y = _rows_to_matrix(rows, lcols)
+        n = len(x)
+        if validation > 0.0:
+            stride = max(2, int(round(1.0 / validation)))
+            off = (seed + idx) % stride
+            val_mask = np.zeros(n, dtype=bool)
+            val_mask[off::stride] = True
+        else:
+            val_mask = np.zeros(n, dtype=bool)
+        buf = io.BytesIO()
+        np.savez(buf, x=x[~val_mask], y=y[~val_mask],
+                 vx=x[val_mask], vy=y[val_mask])
+        st = Store.create(prefix)
+        name = f"part-{idx:05d}.npz"
+        st.write(f"{data_path}/{name}", buf.getvalue())
+        return iter([(idx, n)])
+
+    rdd = df.rdd if hasattr(df, "rdd") else df
+    parts = rdd.mapPartitionsWithIndex(write_partition).collect()
+    return [f"part-{idx:05d}.npz" for idx, _ in sorted(parts)]
+
+
+def _read_shard(prefix: str, data_path: str, part_names: Sequence[str],
+                rank: int, size: int, n_features: int = 1,
+                n_labels: int = 1):
+    """Load THIS rank's share of the materialized parts (the reference
+    assigns per-rank row groups). With >= `size` parts, files are
+    round-robined by index; with fewer parts than ranks, each rank
+    reads exactly one file and takes a strided row slice of it — either
+    way every row belongs to exactly one rank and no rank reads the
+    whole dataset. Returns (x, y, vx, vy, n_rows_touched)."""
+    import io
+
+    from .store import Store
+
+    st = Store.create(prefix)
+    nparts = len(part_names)
+    if nparts >= size:
+        mine = [(n, 0, 1) for i, n in enumerate(part_names)
+                if i % size == rank]
+    else:
+        # ranks r, r+nparts, ... share part (r % nparts); the stride is
+        # how many ranks actually landed on THIS part (the last parts
+        # may carry one fewer when nparts does not divide size)
+        p = rank % nparts
+        sharing = len(range(p, size, nparts))
+        mine = [(part_names[p], rank // nparts, sharing)]
+    xs, ys, vxs, vys = [], [], [], []
+    touched = 0
+    for name, sub, stride in mine:
+        with np.load(io.BytesIO(st.read(f"{data_path}/{name}"))) as z:
+            x, y = z["x"][sub::stride], z["y"][sub::stride]
+            vx, vy = z["vx"][sub::stride], z["vy"][sub::stride]
+        xs.append(x); ys.append(y); vxs.append(vx); vys.append(vy)
+        touched += len(x) + len(vx)
+    if not xs or sum(len(a) for a in xs) == 0:
+        # keep the true column widths: empty-shard ranks still build
+        # zero-filled keep-collectives-alive batches from these shapes
+        return (np.zeros((0, n_features), np.float32),
+                np.zeros((0, n_labels), np.float32),
+                np.zeros((0, n_features), np.float32),
+                np.zeros((0, n_labels), np.float32), 0)
+    return (np.concatenate(xs), np.concatenate(ys),
+            np.concatenate(vxs), np.concatenate(vys), touched)
+
+
+def _ephemeral_store():
+    """store=None convenience: a LocalStore under a temp dir — fine for
+    local mode; real clusters pass a shared-filesystem/fsspec store."""
+    import tempfile
+
+    from .store import LocalStore
+
+    return LocalStore(tempfile.mkdtemp(prefix="hvd_tpu_estimator_"))
 
 
 def _transform_rdd(df, feature_cols: Sequence[str], out_col: str,
@@ -99,10 +195,14 @@ class JaxModel:
 
     def __init__(self, params, apply_fn, feature_cols: Sequence[str],
                  output_col: str = "prediction", metadata=None,
-                 optimizer_spec: Optional[tuple] = None):
+                 optimizer_spec: Optional[tuple] = None, history=None):
         import jax
 
         self.params = params
+        # per-epoch training curves from fit(): train_loss, val_loss and
+        # train_/val_<metric> lists (reference estimators surface these
+        # through the Keras History object)
+        self.history = dict(history or {})
         self._apply = apply_fn
         # jit ONCE: transform maps many partitions and each fresh
         # jax.jit wrapper would recompile from an empty cache
@@ -170,6 +270,8 @@ class JaxEstimator:
         verbose: int = 0,
         store=None,
         run_id: Optional[str] = None,
+        validation: float = 0.0,
+        metrics: Optional[Dict[str, Callable]] = None,
     ):
         from .store import store_or_none
 
@@ -188,23 +290,36 @@ class JaxEstimator:
         # (spark/common/store.py); a string prefix is accepted directly
         self.store = store_or_none(store)
         self.run_id = run_id or "run"
+        # reference KerasEstimator-style validation split + metric fns
+        # (spark/keras/estimator.py): fraction of rows held out per
+        # part; metrics = {name: fn(pred, y) -> scalar} evaluated per
+        # epoch on train batches and the validation shard
+        self.validation = float(validation)
+        self.metrics = dict(metrics or {})
 
     def fit(self, df) -> JaxModel:
         from . import run as spark_run
 
-        x, y = _require_numpy_df(df, self.feature_cols, self.label_cols)
+        store = self.store if self.store is not None else _ephemeral_store()
+        part_names = prepare_data(
+            df, store, self.run_id, self.feature_cols, self.label_cols,
+            validation=self.validation, seed=self.seed)
+        prefix = store.prefix_path
+        data_path = store.get_data_path(self.run_id)
         loss_fn = (
             _LOSSES[self.loss] if isinstance(self.loss, str) else self.loss
         )
         init_fn, apply_fn = _resolve_model(self.model)
         spec = self.optimizer_spec
         batch_size, epochs, seed = self.batch_size, self.epochs, self.seed
+        n_features = len(self.feature_cols)
+        n_labels = len(self.label_cols)
+        metric_fns = self.metrics
 
         def train():
             import os
 
             import jax
-            import jax.numpy as jnp
             import optax
 
             import horovod_tpu as hvd
@@ -216,10 +331,14 @@ class JaxEstimator:
             # exceeds the slot count
             rank = int(os.environ.get("HOROVOD_RANK", hvd.rank()))
             size = int(os.environ.get("HOROVOD_SIZE", hvd.size()))
-            # rank-sharded rows (the reference reads per-rank Petastorm
-            # row groups; here the shard is a strided row slice)
-            xs, ys = x[rank::size], y[rank::size]
-            params = init_fn(jax.random.PRNGKey(seed), xs[:1])
+            # THIS rank's share of the store-materialized parts; the
+            # whole dataset never converges on any single process
+            xs, ys, vx, vy, touched = _read_shard(
+                prefix, data_path, part_names, rank, size,
+                n_features=n_features, n_labels=n_labels)
+            params = init_fn(
+                jax.random.PRNGKey(seed),
+                np.zeros((1, n_features), np.float32))
             name, kwargs = spec
             opt = hvd.DistributedOptimizer(getattr(optax, name)(**kwargs))
             opt_state = opt.init(params)
@@ -235,28 +354,70 @@ class JaxEstimator:
                 return optax.apply_updates(p, u), s, l
 
             n = len(xs)
-            steps = max(1, n // batch_size)
+            # every rank must run the same number of steps (collectives
+            # per step); short shards wrap around their rows
+            steps = max(1, -(-n // batch_size)) if n else 1
+            steps = int(np.max(np.asarray(
+                hvd.allgather(np.asarray([steps], np.int64)))))
+            history = {"train_loss": []}
+            if len(vx):
+                history["val_loss"] = []
+            for mname in metric_fns:
+                history[f"train_{mname}"] = []
+                if len(vx):
+                    history[f"val_{mname}"] = []
             for epoch in range(epochs):
-                perm = np.random.RandomState(seed + epoch).permutation(n)
+                perm = (np.random.RandomState(seed + epoch).permutation(n)
+                        if n else np.zeros((0,), np.int64))
+                losses = []
                 for i in range(steps):
-                    idx = perm[i * batch_size:(i + 1) * batch_size]
-                    if len(idx) == 0:
-                        continue
-                    params, opt_state, l = step(
-                        params, opt_state, xs[idx], ys[idx]
-                    )
+                    if n == 0:
+                        bx = np.zeros((batch_size, n_features),
+                                      np.float32)
+                        by = np.zeros(
+                            (batch_size,) + ys.shape[1:], np.float32)
+                    else:
+                        idx = np.take(
+                            perm,
+                            np.arange(i * batch_size,
+                                      (i + 1) * batch_size) % n,
+                            mode="wrap")
+                        bx, by = xs[idx], ys[idx]
+                    params, opt_state, l = step(params, opt_state, bx, by)
+                    losses.append(float(l))
+                history["train_loss"].append(
+                    float(np.mean(losses)) if losses else 0.0)
+                pred = None
+                if metric_fns and n:
+                    pred = np.asarray(apply_fn(params, xs))
+                for mname, fn in metric_fns.items():
+                    history[f"train_{mname}"].append(
+                        float(fn(pred, ys)) if pred is not None else 0.0)
+                if len(vx):
+                    vpred = np.asarray(apply_fn(params, vx))
+                    history["val_loss"].append(
+                        float(loss_fn(vpred, vy)))
+                    for mname, fn in metric_fns.items():
+                        history[f"val_{mname}"].append(
+                            float(fn(vpred, vy)))
             hvd.shutdown()
+            out = {"rank": rank, "rows_touched": int(touched),
+                   "history": history}
             if rank == 0:
-                return jax.tree_util.tree_map(np.asarray, params)
-            return None
+                out["params"] = jax.tree_util.tree_map(np.asarray, params)
+            return out
 
         results = spark_run(train, num_proc=self.num_proc,
                             verbose=self.verbose)
-        trained = next(r for r in results if r is not None)
+        root = next(r for r in results if r and "params" in r)
+        trained = root["params"]
         jm = JaxModel(trained, apply_fn, self.feature_cols,
                       self.output_col,
                       metadata={"epochs": self.epochs},
-                      optimizer_spec=self.optimizer_spec)
+                      optimizer_spec=self.optimizer_spec,
+                      history=root["history"])
+        jm.rows_touched_per_rank = {
+            r["rank"]: r["rows_touched"] for r in results if r}
         if self.store is not None:
             import tempfile
 
@@ -290,6 +451,9 @@ class TorchEstimator:
         verbose: int = 0,
         store=None,
         run_id: Optional[str] = None,
+        seed: int = 0,
+        validation: float = 0.0,
+        metrics: Optional[Dict[str, Callable]] = None,
     ):
         from .store import store_or_none
 
@@ -305,23 +469,35 @@ class TorchEstimator:
         self.verbose = verbose
         self.store = store_or_none(store)
         self.run_id = run_id or "run"
+        self.seed = seed
+        self.validation = float(validation)
+        self.metrics = dict(metrics or {})
 
     def fit(self, df) -> "TorchModel":
         import torch
 
         from . import run as spark_run
 
-        x, y = _require_numpy_df(df, self.feature_cols, self.label_cols)
+        store = self.store if self.store is not None else _ephemeral_store()
+        part_names = prepare_data(
+            df, store, self.run_id, self.feature_cols, self.label_cols,
+            validation=self.validation, seed=self.seed)
+        prefix = store.prefix_path
+        data_path = store.get_data_path(self.run_id)
         model = self.model
         opt_factory = self.optimizer_factory or (
             lambda params: torch.optim.SGD(params, lr=0.01)
         )
         loss_fn = self.loss or torch.nn.functional.mse_loss
-        batch_size, epochs = self.batch_size, self.epochs
+        batch_size, epochs, seed = self.batch_size, self.epochs, self.seed
+        n_features = len(self.feature_cols)
+        n_labels = len(self.label_cols)
+        metric_fns = self.metrics
 
         def train():
             import os
 
+            import numpy as np
             import torch
 
             import horovod_tpu.torch as thvd
@@ -329,38 +505,80 @@ class TorchEstimator:
             thvd.init()
             rank = int(os.environ.get("HOROVOD_RANK", thvd.rank()))
             size = int(os.environ.get("HOROVOD_SIZE", thvd.size()))
-            xs = torch.from_numpy(x[rank::size])
-            ys = torch.from_numpy(y[rank::size])
+            x_, y_, vx_, vy_, touched = _read_shard(
+                prefix, data_path, part_names, rank, size,
+                n_features=n_features, n_labels=n_labels)
+            xs, ys = torch.from_numpy(x_), torch.from_numpy(y_)
+            vx, vy = torch.from_numpy(vx_), torch.from_numpy(vy_)
             opt = thvd.DistributedOptimizer(
                 opt_factory(model.parameters()),
                 named_parameters=list(model.named_parameters()),
             )
             thvd.broadcast_parameters(model.state_dict(), root_rank=0)
             n = len(xs)
-            steps = max(1, n // batch_size)
-            for _ in range(epochs):
-                perm = torch.randperm(n)
+            # every rank must run the same number of steps (each step's
+            # gradient allreduce is a collective); short shards wrap
+            steps = max(1, -(-n // batch_size)) if n else 1
+            steps = int(torch.max(thvd.allgather(
+                torch.tensor([steps], dtype=torch.int64))))
+            history = {"train_loss": []}
+            if len(vx):
+                history["val_loss"] = []
+            for mname in metric_fns:
+                history[f"train_{mname}"] = []
+                if len(vx):
+                    history[f"val_{mname}"] = []
+            for epoch in range(epochs):
+                perm = torch.from_numpy(
+                    np.random.RandomState(seed + epoch).permutation(
+                        max(n, 1)))
+                losses = []
                 for i in range(steps):
-                    idx = perm[i * batch_size:(i + 1) * batch_size]
-                    if len(idx) == 0:
-                        continue
+                    idx = perm[
+                        torch.arange(i * batch_size,
+                                     (i + 1) * batch_size) % max(n, 1)]
+                    bx = xs[idx] if n else torch.zeros(
+                        (batch_size, xs.shape[-1]))
+                    by = ys[idx] if n else torch.zeros(
+                        (batch_size, ys.shape[-1]))
                     opt.zero_grad()
-                    loss = loss_fn(model(xs[idx]), ys[idx])
+                    loss = loss_fn(model(bx), by)
                     loss.backward()
                     opt.step()
+                    losses.append(float(loss.detach()))
+                history["train_loss"].append(float(np.mean(losses)))
+                with torch.no_grad():
+                    if metric_fns and n:
+                        pred = model(xs)
+                        for mname, fn in metric_fns.items():
+                            history[f"train_{mname}"].append(
+                                float(fn(pred, ys)))
+                    if len(vx):
+                        vpred = model(vx)
+                        history["val_loss"].append(
+                            float(loss_fn(vpred, vy)))
+                        for mname, fn in metric_fns.items():
+                            history[f"val_{mname}"].append(
+                                float(fn(vpred, vy)))
             thvd.shutdown()
+            out = {"rank": rank, "rows_touched": int(touched),
+                   "history": history}
             if rank == 0:
-                return {
+                out["params"] = {
                     k: v.detach().cpu().numpy()
                     for k, v in model.state_dict().items()
                 }
-            return None
+            return out
 
         results = spark_run(train, num_proc=self.num_proc,
                             verbose=self.verbose)
-        trained = next(r for r in results if r is not None)
+        root = next(r for r in results if r and "params" in r)
+        trained = root["params"]
         tm = TorchModel(model, trained, self.feature_cols,
                         self.output_col)
+        tm.history = root["history"]
+        tm.rows_touched_per_rank = {
+            r["rank"]: r["rows_touched"] for r in results if r}
         if self.store is not None:
             import io
 

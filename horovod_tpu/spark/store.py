@@ -43,6 +43,11 @@ class Store:
     def get_logs_path(self, run_id: str) -> str:
         return f"{self.get_run_path(run_id)}/logs"
 
+    def get_data_path(self, run_id: str) -> str:
+        """Materialized training shards (reference AbstractFilesystemStore
+        row-group layout, spark/common/store.py:167 — npz parts here)."""
+        return f"{self.get_run_path(run_id)}/data"
+
     # --- filesystem surface (overridden per backend) ---
 
     def exists(self, path: str) -> bool:
@@ -152,6 +157,17 @@ class FsspecStore(Store):
             return f.read()
 
     def write(self, path: str, data: bytes) -> None:
+        # mirror LocalStore's contract: parents are created on write.
+        # Guarded — flat object stores may not implement makedirs, and
+        # there it is also unnecessary (ADVICE r3).
+        import posixpath
+
+        parent = posixpath.dirname(path)
+        if parent:
+            try:
+                self._fs.makedirs(parent, exist_ok=True)
+            except (NotImplementedError, OSError):
+                pass
         with self._fs.open(path, "wb") as f:
             f.write(data)
 

@@ -76,6 +76,14 @@ def cnn_train_flops(model: str, images: int, image_size: int) -> float:
     return 3.0 * 2.0 * macs * (image_size / native) ** 2 * float(images)
 
 
+def resnet50_train_flops(images: int, image_size: int = 224) -> float:
+    """Deprecated alias for ``cnn_train_flops("resnet50", ...)``; kept
+    for callers of the pre-r3 helper. Note the accounting change: since
+    r3 a MAC counts 2 FLOPs (earlier rounds counted 1), so values are 2x
+    the pre-r3 helper's."""
+    return cnn_train_flops("resnet50", images, image_size)
+
+
 def count_params(tree) -> int:
     import jax
 

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Native control-plane weak-scaling microbench → SCALING_r{N}.json.
+
+Measures the eager negotiation plane's per-step overhead as the world
+grows (1/2/4/8 processes on this host): each rank enqueues a fixed set
+of small gradients per step, the coordinator negotiates + fuses, the
+LoopbackExecutor applies them (so data-plane time is negligible and the
+number isolates the CONTROL plane — TCP round trips, controller cycle,
+response-cache path). Reports per-step negotiation latency
+(median/p95 over steps) and the response-cache hit rate per world size.
+
+This is the per-step cost the reference's background loop pays
+(operations.cc:722 RunLoopOnce); at 256 chips the control plane must
+stay off the critical path, so its growth rate with world size is the
+early-warning signal (SURVEY.md §6 scaling evidence).
+
+Usage: python scripts/control_plane_scaling.py [--out SCALING_r04.json]
+"""
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import socket
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 60
+TENSORS_PER_STEP = 8
+WARMUP = 10
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _worker(rank, size, port, q):
+    from horovod_tpu import _native
+
+    rt = _native.NativeRuntime()
+    rt.init(rank, size, "127.0.0.1", port, cycle_ms=1.0,
+            cache_capacity=1024, stall_warning_s=60.0)
+    try:
+        lat = []
+        for step in range(STEPS + WARMUP):
+            t0 = time.perf_counter()
+            hs = [
+                rt.enqueue(f"g{i}", _native.OP_ALLREDUCE, "float32",
+                           [64])
+                for i in range(TENSORS_PER_STEP)
+            ]
+            deadline = time.time() + 20
+            done = set()
+            while len(done) < len(hs) and time.time() < deadline:
+                b = rt.next_batch(timeout_s=0.2)
+                if b is not None:
+                    rt.batch_done(b, ok=True)
+                for h in hs:
+                    if h not in done and rt.poll(h) in (_native.DONE, _native.FAILED):
+                        done.add(h)
+            if step >= WARMUP:
+                lat.append(time.perf_counter() - t0)
+        q.put((rank, "ok", {
+            "latencies": lat,
+            "cache_hits": rt.cache_hits(),
+            "bytes_negotiated": rt.bytes_negotiated(),
+        }))
+    except Exception as e:
+        q.put((rank, "err", repr(e)))
+    finally:
+        rt.shutdown()
+
+
+def run_world(size):
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker, args=(r, size, port, q))
+             for r in range(size)]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = time.time() + 180
+    while len(results) < size and time.time() < deadline:
+        try:
+            rank, status, payload = q.get(timeout=1.0)
+            results[rank] = (status, payload)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.terminate()
+    assert len(results) == size, f"only {len(results)}/{size} reported"
+    for rank, (status, payload) in results.items():
+        assert status == "ok", f"rank {rank}: {payload}"
+    lat = [x for _, (_, p) in results.items() for x in p["latencies"]]
+    lat.sort()
+    total_requests = size * (STEPS + WARMUP) * TENSORS_PER_STEP
+    hits = sum(p["cache_hits"] for _, (_, p) in results.items())
+    return {
+        "world": size,
+        "steps": STEPS,
+        "tensors_per_step": TENSORS_PER_STEP,
+        "negotiation_ms_per_step": {
+            "median": round(1e3 * statistics.median(lat), 3),
+            "p95": round(1e3 * lat[int(0.95 * len(lat))], 3),
+            "mean": round(1e3 * statistics.mean(lat), 3),
+        },
+        "cache_hit_rate": round(hits / total_requests, 4),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="SCALING_r04.json")
+    ap.add_argument("--worlds", default="1,2,4,8")
+    args = ap.parse_args(argv)
+    rows = []
+    for size in [int(s) for s in args.worlds.split(",")]:
+        row = run_world(size)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    base = rows[0]["negotiation_ms_per_step"]["median"] or 1e-9
+    report = {
+        "what": "native eager control-plane weak scaling (LoopbackExecutor "
+                "isolates negotiation cost; single host, spawn procs)",
+        "rows": rows,
+        "median_growth_vs_1proc": [
+            round(r["negotiation_ms_per_step"]["median"] / base, 2)
+            for r in rows
+        ],
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({"written": args.out}))
+
+
+if __name__ == "__main__":
+    main()

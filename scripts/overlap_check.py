@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Comm/compute overlap analysis → OVERLAP_r{N}.json.
+
+AOT-compiles the DistributedOptimizer train step for a real 8-chip
+v5e topology (jax.experimental.topologies — needs a TPU client but not
+8 physical chips) and reports how the optimized schedule places the
+per-bucket gradient all-reduces relative to backward compute. See
+tests/test_overlap_schedule.py for the suite-side assertions and
+docs/benchmarks.md for the findings.
+
+Usage: python scripts/overlap_check.py [--out OVERLAP_r04.json]
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="OVERLAP_r04.json")
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--fusion-mb", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    from jax.experimental import topologies
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import Transformer
+    from horovod_tpu.models.transformer import TransformerConfig
+
+    topo = topologies.get_topology_desc(
+        topology_name="v5e:2x4", platform="tpu")
+    mesh = topologies.make_mesh(topo, (8,), ("hvd",))
+    hvd.init(mesh=mesh)
+
+    cfg = TransformerConfig(
+        vocab_size=512, num_layers=args.layers, num_heads=8,
+        hidden_size=args.hidden, max_seq_len=128, dtype=jnp.bfloat16)
+    m = Transformer(cfg)
+    toks_s = jax.ShapeDtypeStruct((16, cfg.max_seq_len), jnp.int32)
+    params = jax.eval_shape(
+        lambda: m.init(jax.random.PRNGKey(0),
+                       jnp.ones((2, cfg.max_seq_len), jnp.int32)))
+    opt = hvd.DistributedOptimizer(
+        optax.adamw(1e-4), fusion_threshold_bytes=args.fusion_mb << 20)
+    state = jax.eval_shape(lambda: opt.init(jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), params)))
+
+    def step(p, s, b):
+        def loss_fn(p):
+            logits = m.apply(p, b)
+            return jnp.mean((logits.astype(jnp.float32) - 1.0) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        upd, s = opt.update(g, s, p)
+        return optax.apply_updates(p, upd), s, jax.lax.psum(
+            l, "hvd").reshape(1)
+
+    js = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P(), P("hvd")),
+        out_specs=(P(), P(), P()), check_vma=False))
+    txt = js.lower(params, state, toks_s).compile().as_text()
+
+    lines = txt.splitlines()
+    ars = [i for i, l in enumerate(lines)
+           if re.search(r' all-reduce(-start)?\(', l)]
+    bwd = [i for i, l in enumerate(lines)
+           if "op_name=" in l and "transpose" in l
+           and re.search(r' (dot|fusion|convolution|custom-call)\(', l)]
+    bwd_after_first_ar = sum(1 for b in bwd if b > ars[0]) if ars else 0
+    report = {
+        "topology": "v5e:2x4 (AOT)",
+        "scheduled": "is_scheduled=true" in txt,
+        "bucket_all_reduces_in_optimized_hlo": len(ars),
+        "backward_compute_ops": len(bwd),
+        "backward_ops_scheduled_after_first_all_reduce":
+            bwd_after_first_ar,
+        "first_all_reduce_before_last_backward_op":
+            bool(ars) and bool(bwd) and ars[0] < bwd[-1],
+        "ordered_buckets_knob": True,
+        "note": "optimization_barrier chaining keeps one all-reduce per "
+                "fusion bucket (without it XLA merges all buckets into "
+                "one variadic all-reduce gated on ALL gradients); the "
+                "scheduled module issues bucket collectives while "
+                "backward for earlier layers still runs. This XLA build "
+                "emits TPU all-reduce synchronously in HLO (no "
+                "start/done pair surfaces even with "
+                "xla_enable_async_all_reduce) — schedule position is "
+                "the observable overlap property.",
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

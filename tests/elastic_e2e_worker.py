@@ -16,6 +16,7 @@ and lose no committed samples.
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -84,6 +85,20 @@ def main():
                     f"{','.join(str(i) for i in batch)}\n"
                 )
             commits += 1
+            # recovery-time metric (reference elastic_common.py:34
+            # measures the same spirit): hostC only exists in the
+            # post-death world, so its first committed batch closes the
+            # death → first-post-rendezvous-commit window
+            if host == "hostC" and os.path.exists(marker):
+                try:
+                    fd = os.open(
+                        os.path.join(workdir, "recovery_ts"),
+                        os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                    )
+                    os.write(fd, str(time.time()).encode())
+                    os.close(fd)
+                except FileExistsError:
+                    pass
             if (
                 rank == 1
                 and epoch == 0
@@ -92,6 +107,8 @@ def main():
             ):
                 with open(marker, "w") as f:
                     f.write("x")
+                with open(os.path.join(workdir, "death_ts"), "w") as f:
+                    f.write(str(time.time()))
                 os._exit(1)  # simulated host death, mid-epoch
         sampler.set_epoch(epoch + 1)
         if rank == 0:

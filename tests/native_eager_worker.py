@@ -176,6 +176,31 @@ def main():
                 for i, r in enumerate(ps.ranks)
             ])
             ps_ok = ps_ok and bool(np.array_equal(g2, expect2))
+            # ragged subset alltoall: set-local splits matrix negotiation
+            # + sub-mesh exchange (reference operations.cc:1858 works on
+            # any process set; round-4 fix removed the raise here).
+            # member local i sends (j+1+i) rows to member local j,
+            # stamped [global sender, local dest]
+            ssize = ps.size()
+            sp = [j + 1 + local for j in range(ssize)]
+            ta = np.zeros((sum(sp), 2), dtype=np.float32)
+            o = 0
+            for j, rws in enumerate(sp):
+                ta[o:o + rws] = [rank, j]
+                o += rws
+            ra, rsp = hvd.alltoall(
+                ta, splits=sp, process_set=ps, name="sub_a2a")
+            ra = np.asarray(ra)
+            expect3 = np.concatenate([
+                np.tile([[gr, local]], (local + 1 + i, 1)).astype(
+                    np.float32)
+                for i, gr in enumerate(ps.ranks)
+            ])
+            ps_ok = ps_ok and bool(
+                np.array_equal(ra, expect3)
+                and [int(v) for v in np.asarray(rsp)]
+                == [local + 1 + i for i in range(ssize)]
+            )
         # all ranks (members included) meet in a global op afterwards so
         # the world stays open and interleaving is exercised
         t = np.full((2,), float(rank + 1), dtype=np.float32)

@@ -327,3 +327,18 @@ def test_eager_alltoall_uneven_splits(hvd8):
     np.testing.assert_array_equal(np.asarray(received), np.full(8, 2))
     expect = np.tile(np.arange(2.0).reshape(2, 1), (8, 1))
     np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_eager_alltoall_uneven_splits_process_set(hvd8):
+    """Ragged alltoall on a process set without the native runtime now
+    routes through the LoopbackExecutor (round 4: the tile(chunk0)
+    fabrication is gone); replicated-buffer semantics: the received
+    data is column `local rank` of the splits matrix."""
+    ps = hvd.add_process_set([0, 2, 4])
+    x = jnp.arange(12.0).reshape(6, 2)
+    out, received = hvd.alltoall(x, splits=[1, 2, 3], process_set=ps)
+    # our set-local rank is 0: every (identical) peer sends its first
+    # 1 row; received splits = column 0 of the all-equal matrix
+    np.testing.assert_array_equal(np.asarray(received), np.full(3, 1))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.tile(np.asarray(x[:1]), (3, 1)))

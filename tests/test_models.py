@@ -314,3 +314,35 @@ def test_resnet_one_by_one_dot_matches_conv():
         x, train=True, mutable=["batch_stats"])
     np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_c),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_transformer_fused_norm_matches_unfused():
+    """cfg.fused_norm=True (pallas layernorm/rmsnorm kernels) computes
+    the same function as the flax norm path, for both norm kinds."""
+    for base in (TINY_GPT, TINY_LLAMA):
+        cfg = dataclasses.replace(base, fused_norm=True)
+        model_ref = GPT2(base) if base is TINY_GPT else Llama(base)
+        model_fused = GPT2(cfg) if base is TINY_GPT else Llama(cfg)
+        tok = jnp.asarray(
+            np.random.RandomState(0).randint(0, base.vocab_size, (2, 16)))
+        v = model_ref.init(jax.random.PRNGKey(0), tok)
+        out_ref = model_ref.apply(v, tok)
+        out_fused = model_fused.apply(v, tok)  # same param names
+        np.testing.assert_allclose(
+            np.asarray(out_fused), np.asarray(out_ref),
+            rtol=2e-4, atol=2e-4)
+
+        def loss(m):
+            return lambda p: jnp.sum(m.apply(p, tok).astype(jnp.float32) ** 2)
+
+        g_ref = jax.grad(loss(model_ref))(v)
+        g_fused = jax.grad(loss(model_fused))(v)
+        gmax = max(float(jnp.abs(a).max())
+                   for a in jax.tree_util.tree_leaves(g_ref))
+        for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                        jax.tree_util.tree_leaves(g_fused)):
+            # atol floors at 1e-6 of the global grad scale so leaves
+            # whose true gradient is ~0 don't compare fp noise
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-6 * gmax + 1e-9,
+                                       rtol=5e-3)

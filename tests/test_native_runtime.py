@@ -511,20 +511,28 @@ def _worker_autotune(rank, size, port, scenario, q):
 
 def scenario_autotune(native, rt, rank, size):
     """Steady traffic until the coordinator pins; every rank reads the
-    distributed parameters."""
+    distributed parameters. `hier_seen` records every hierarchical-mode
+    value observed during the search — the widened space (round 4,
+    reference parameter_manager.h:186) must actually flip it."""
     deadline = time.time() + 40
     step = 0
+    hier_seen = set()
     while not rt.tuned_pinned() and time.time() < deadline:
         hs = [
             rt.enqueue(f"at{i}", native.OP_ALLREDUCE, "float32", [256])
             for i in range(3)
         ]
         _drain_until(rt, hs, timeout_s=10.0)
+        hier_seen.add(bool(rt.tuned_hierarchical()))
         step += 1
     return {
         "pinned": rt.tuned_pinned(),
         "cycle_ms": rt.tuned_cycle_ms(),
         "threshold": rt.tuned_threshold(),
+        "cache_enabled": bool(rt.tuned_cache_enabled()),
+        "hierarchical": bool(rt.tuned_hierarchical()),
+        "hier_local": rt.tuned_hier_block(),
+        "hier_seen": sorted(hier_seen),
         "steps": step,
     }
 
@@ -944,3 +952,11 @@ def test_bayesian_autotune_all_ranks_pin_identical_parameters():
     # winners live in the continuous search ranges, not the descent grid
     assert 0.25 <= payloads[0]["cycle_ms"] <= 5.0, payloads
     assert (1 << 20) <= payloads[0]["threshold"] <= (256 << 20), payloads
+    # widened space (reference parameter_manager.h:186): all ranks pin
+    # the identical cache/hierarchical config, the search actually
+    # explored both hierarchical modes (the seeding corners guarantee
+    # it), and the inner-domain size stays in its 2..16 range
+    for key in ("cache_enabled", "hierarchical", "hier_local"):
+        assert payloads[0][key] == payloads[1][key], payloads
+    assert payloads[0]["hier_seen"] == [False, True], payloads
+    assert 2 <= payloads[0]["hier_local"] <= 16, payloads

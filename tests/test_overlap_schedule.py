@@ -1,0 +1,154 @@
+"""Comm/compute overlap evidence for the DistributedOptimizer step.
+
+The reference's whole fusion-cycle architecture exists so gradient
+all-reduces overlap backward compute (controller.cc:830 FuseResponses,
+docs/benchmarks.rst:8-13's 90%-scaling claim). The TPU-native equivalent
+property, asserted here at two levels:
+
+1. (any backend) The lowered step emits one all-reduce per fusion
+   bucket, chained by optimization_barrier in controller order
+   (knobs.ordered_buckets) — WITHOUT the chaining XLA's all-reduce
+   combiner merges every bucket into one variadic all-reduce that can
+   only run after ALL gradients exist, which kills overlap by
+   construction. (XLA CPU's barrier expander still merges post-opt;
+   the TPU pipeline keeps the buckets — level 2.)
+
+2. (TPU only — AOT-compiled for a real v5e:2x4 topology through
+   jax.experimental.topologies, skipped when no TPU client is
+   available) The *optimized, scheduled* module keeps >= 2 separate
+   all-reduces and schedules the first one strictly before the last
+   backward-pass compute op — i.e. bucket k's collective issues while
+   backward for earlier layers is still computing. scripts/
+   overlap_check.py writes the same analysis to OVERLAP_r04.json.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import Transformer
+from horovod_tpu.models.transformer import TransformerConfig
+
+CFG = TransformerConfig(
+    vocab_size=512, num_layers=4, num_heads=8, hidden_size=512,
+    max_seq_len=32, dtype=jnp.float32,
+)
+
+
+def _build_step(mesh, fusion_threshold):
+    m = Transformer(CFG)
+    toks = jnp.ones((16, CFG.max_seq_len), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks[:2])
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.1), fusion_threshold_bytes=fusion_threshold)
+    state = opt.init(params)
+
+    def step(p, s, b):
+        def loss_fn(p):
+            logits = m.apply(p, b)
+            return jnp.mean((logits.astype(jnp.float32) - 1.0) ** 2)
+
+        l, g = jax.value_and_grad(loss_fn)(p)
+        upd, s = opt.update(g, s, p)
+        return optax.apply_updates(p, upd), s, jax.lax.psum(
+            l, "hvd").reshape(1)
+
+    js = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P(), P("hvd")),
+        out_specs=(P(), P(), P()), check_vma=False))
+    return js, params, state, toks
+
+
+def test_buckets_lower_to_separate_ordered_all_reduces(hvd8):
+    """Level 1: >= 2 bucket all-reduces with ordering barriers in the
+    lowered module; numerics identical with the chaining off."""
+    js, params, state, toks = _build_step(hvd.mesh(), 4 << 20)
+    pre = js.lower(params, state, toks).as_text()
+    n_ar = len(re.findall(r'\ball_reduce\b|\ball-reduce\b', pre))
+    n_barrier = pre.count("optimization_barrier")
+    assert n_ar >= 3, f"expected per-bucket all-reduces, found {n_ar}"
+    assert n_barrier >= n_ar - 3, (n_ar, n_barrier)
+
+    out_ordered = js(params, state, toks)
+    from horovod_tpu.core.state import global_state
+
+    global_state().knobs.ordered_buckets = False
+    try:
+        js2, params2, state2, toks2 = _build_step(hvd.mesh(), 4 << 20)
+        pre2 = js2.lower(params2, state2, toks2).as_text()
+        assert pre2.count("optimization_barrier") == 0
+        out_plain = js2(params2, state2, toks2)
+    finally:
+        global_state().knobs.ordered_buckets = True
+    np.testing.assert_allclose(
+        np.asarray(out_ordered[2]), np.asarray(out_plain[2]),
+        rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(out_ordered[0]),
+                    jax.tree_util.tree_leaves(out_plain[0])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def _tpu_topology_mesh():
+    from jax.experimental import topologies
+
+    t = topologies.get_topology_desc(
+        topology_name="v5e:2x4", platform="tpu")
+    return topologies.make_mesh(t, (8,), ("hvd",))
+
+
+def test_tpu_schedule_interleaves_bucket_collectives_with_backward():
+    """Level 2 (TPU AOT): the optimized schedule (is_scheduled=true, so
+    instruction order == execution order) issues the first bucket's
+    all-reduce strictly before the last backward op."""
+    try:
+        mesh = _tpu_topology_mesh()
+    except Exception as e:  # no TPU client in this environment
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    hvd.shutdown()
+    hvd.init(mesh=mesh)
+    try:
+        m = Transformer(CFG)
+        toks_s = jax.ShapeDtypeStruct((16, CFG.max_seq_len), jnp.int32)
+        params = jax.eval_shape(
+            lambda: m.init(jax.random.PRNGKey(0),
+                           jnp.ones((2, CFG.max_seq_len), jnp.int32)))
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(0.1), fusion_threshold_bytes=4 << 20)
+        state = jax.eval_shape(lambda: opt.init(jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), params)))
+
+        def step(p, s, b):
+            def loss_fn(p):
+                logits = m.apply(p, b)
+                return jnp.mean((logits.astype(jnp.float32) - 1.0) ** 2)
+
+            l, g = jax.value_and_grad(loss_fn)(p)
+            upd, s = opt.update(g, s, p)
+            return optax.apply_updates(p, upd), s, jax.lax.psum(
+                l, "hvd").reshape(1)
+
+        js = jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P(), P("hvd")),
+            out_specs=(P(), P(), P()), check_vma=False))
+        txt = js.lower(params, state, toks_s).compile().as_text()
+    finally:
+        hvd.shutdown()
+    assert "is_scheduled=true" in txt
+    lines = txt.splitlines()
+    ars = [i for i, l in enumerate(lines)
+           if re.search(r' all-reduce(-start)?\(', l)]
+    bwd = [i for i, l in enumerate(lines)
+           if "op_name=" in l and "transpose" in l
+           and re.search(r' (dot|fusion|convolution|custom-call)\(', l)]
+    assert len(ars) >= 2, f"combiner merged the buckets: {len(ars)}"
+    assert bwd, "no backward ops identified"
+    assert ars[0] < bwd[-1], (
+        f"first all-reduce (line {ars[0]}) scheduled after the whole "
+        f"backward pass (last bwd line {bwd[-1]}) — no overlap possible")
