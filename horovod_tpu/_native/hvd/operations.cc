@@ -198,6 +198,16 @@ bool RunLoopOnce() {
     }
     own.requests.push_back(std::move(req));
   }
+  if (!cache_on && !g->pending_hits.empty()) {
+    // the autotune cache toggle flipped off between park and agreement
+    // (ApplyBayesPoint explores cache-off samples): hits parked while
+    // the cache was on would otherwise never be claimed NOR aged into
+    // retry — a permanent hang for those handles. Renegotiate them.
+    for (auto& kv : g->pending_hits) {
+      g->retry_requests.push_back(std::move(kv.second.request));
+    }
+    g->pending_hits.clear();
+  }
   if (cache_on) {
     if (!g->pending_hits.empty()) {
       // a parked hit whose entry was LRU-evicted since parking must

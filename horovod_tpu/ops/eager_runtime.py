@@ -397,7 +397,10 @@ class EagerRuntime:
         SPMD-side knobs so subsequently compiled steps pick up the tuned
         hierarchical routing (ops/hierarchical.py gates on these). Runs
         at most once, on the first synchronize() after the pin — the
-        same moment the reference applies ParameterManager winners."""
+        same moment the reference applies ParameterManager winners.
+        Enabling autotune delegates these knobs to the tuner (reference
+        semantics): a pinned winner overrides env-set values, including
+        turning hierarchical OFF if flat scored better."""
         if self._tuning_applied or not self._native.tuned_pinned():
             return
         self._tuning_applied = True
@@ -416,6 +419,13 @@ class EagerRuntime:
             batch = self._native.next_batch(timeout_s=0.1)
             if batch is None:
                 continue
+            # stamp the coordinator's CURRENT hierarchical sample point
+            # on the batch (one-cycle coherent with the ResponseList
+            # that delivered it) so the data plane executes — and the
+            # tuner therefore scores — the candidate routing during the
+            # search, not just after the pin
+            batch.tuned_hierarchical = self._native.tuned_hierarchical()
+            batch.tuned_hier_block = self._native.tuned_hier_block()
             tl = _timeline()
             if tl is not None and batch.cycle != self._last_cycle:
                 # one marker per negotiation cycle, however many fused
@@ -634,6 +644,26 @@ class XlaExecutor:
 
     # ------------------------------------------------------ op leaves
 
+    def _hier_reduce_leaf(self, reduce_op: int, prescale: float,
+                          postscale: float, n: int, block: int):
+        """SUM/AVERAGE via the two-level ICI×DCN form
+        (ops/hierarchical.hierarchical_psum) — value-equal to psum."""
+        import jax.numpy as jnp
+
+        def leaf(x):
+            from .hierarchical import hierarchical_psum
+
+            if prescale != 1.0:
+                x = x * jnp.asarray(prescale, dtype=x.dtype)
+            y = hierarchical_psum(x, ("proc",), {"proc": n}, block)
+            if reduce_op == _REDUCE_AVERAGE:
+                y = (y / n).astype(x.dtype)
+            if postscale != 1.0:
+                y = y * jnp.asarray(postscale, dtype=y.dtype)
+            return y
+
+        return leaf
+
     def _reduce_leaf(self, reduce_op: int, prescale: float,
                      postscale: float, n: Optional[int] = None):
         import jax.numpy as jnp
@@ -721,12 +751,33 @@ class XlaExecutor:
         # ncclAllReduce, nccl_operations.cc:175-246)
         flats = [x.reshape(-1) for x in inputs]
         packed = np.concatenate(flats) if len(flats) > 1 else flats[0]
-        leaf = self._reduce_leaf(
-            batch.reduce_op, batch.prescale, batch.postscale, n
-        )
+        # autotuned hierarchical routing, stamped per-batch by the
+        # runtime worker from the coordinator's current sample point —
+        # LIVE during the Bayes search so the x3/x4 dimensions score
+        # real schedules, not noise (ADVICE r4). Global-set SUM/AVERAGE
+        # only, mirroring ops/hierarchical.hierarchy_enabled_for.
+        hier_block = 0
+        if (getattr(batch, "tuned_hierarchical", False)
+                and not tag
+                and batch.reduce_op in (_REDUCE_SUM, _REDUCE_AVERAGE)):
+            from .hierarchical import resolve_block
+
+            hier_block = resolve_block(
+                n, int(getattr(batch, "tuned_hier_block", 0)))
+            if hier_block <= 1:
+                hier_block = 0
+        if hier_block:
+            leaf = self._hier_reduce_leaf(
+                batch.reduce_op, batch.prescale, batch.postscale, n,
+                hier_block)
+        else:
+            leaf = self._reduce_leaf(
+                batch.reduce_op, batch.prescale, batch.postscale, n
+            )
         prog = self._program(
             ("allreduce", tag, packed.shape, str(packed.dtype),
-             batch.reduce_op, batch.prescale, batch.postscale),
+             batch.reduce_op, batch.prescale, batch.postscale,
+             hier_block),
             leaf, out_spec_sharded=False, mesh=mesh,
         )
         res = np.asarray(prog(self._global_stack(packed, mesh, n)))

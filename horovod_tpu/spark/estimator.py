@@ -90,6 +90,10 @@ def prepare_data(df, store, run_id: str, feature_cols: Sequence[str],
 
     rdd = df.rdd if hasattr(df, "rdd") else df
     parts = rdd.mapPartitionsWithIndex(write_partition).collect()
+    if not parts:
+        raise ValueError(
+            "prepare_data: the DataFrame produced no rows — nothing to "
+            "train on")
     return [f"part-{idx:05d}.npz" for idx, _ in sorted(parts)]
 
 
@@ -135,6 +139,18 @@ def _read_shard(prefix: str, data_path: str, part_names: Sequence[str],
                 np.zeros((0, n_labels), np.float32), 0)
     return (np.concatenate(xs), np.concatenate(ys),
             np.concatenate(vxs), np.concatenate(vys), touched)
+
+
+def _predict_batched(apply_fn, params, x, batch_size=4096):
+    """Full-shard prediction in bounded chunks: metric evaluation must
+    not materialize activations for millions of rows in one device call
+    (that would defeat the store-backed memory bound)."""
+    if len(x) <= batch_size:
+        return np.asarray(apply_fn(params, x))
+    return np.concatenate([
+        np.asarray(apply_fn(params, x[i:i + batch_size]))
+        for i in range(0, len(x), batch_size)
+    ])
 
 
 def _ephemeral_store():
@@ -272,6 +288,7 @@ class JaxEstimator:
         run_id: Optional[str] = None,
         validation: float = 0.0,
         metrics: Optional[Dict[str, Callable]] = None,
+        callbacks: Optional[Sequence] = None,
     ):
         from .store import store_or_none
 
@@ -296,6 +313,12 @@ class JaxEstimator:
         # epoch on train batches and the validation shard
         self.validation = float(validation)
         self.metrics = dict(metrics or {})
+        # horovod_tpu.callbacks instances, invoked like the reference
+        # KerasEstimator's callbacks param: on_train_begin, per-epoch
+        # begin/end (epoch-end receives the epoch's logs, so
+        # MetricAverageCallback averages metrics across ranks), per-batch
+        # end. They run inside every training slot.
+        self.callbacks = list(callbacks or [])
 
     def fit(self, df) -> JaxModel:
         from . import run as spark_run
@@ -315,6 +338,7 @@ class JaxEstimator:
         n_features = len(self.feature_cols)
         n_labels = len(self.label_cols)
         metric_fns = self.metrics
+        cbs = self.callbacks
 
         def train():
             import os
@@ -366,7 +390,12 @@ class JaxEstimator:
                 history[f"train_{mname}"] = []
                 if len(vx):
                     history[f"val_{mname}"] = []
+            cb_state = None
+            for cb in cbs:
+                cb_state = cb.on_train_begin(cb_state)
             for epoch in range(epochs):
+                for cb in cbs:
+                    cb_state = cb.on_epoch_begin(epoch, cb_state)
                 perm = (np.random.RandomState(seed + epoch).permutation(n)
                         if n else np.zeros((0,), np.int64))
                 losses = []
@@ -385,21 +414,36 @@ class JaxEstimator:
                         bx, by = xs[idx], ys[idx]
                     params, opt_state, l = step(params, opt_state, bx, by)
                     losses.append(float(l))
+                    for cb in cbs:
+                        cb_state = cb.on_batch_end(i, cb_state)
                 history["train_loss"].append(
                     float(np.mean(losses)) if losses else 0.0)
                 pred = None
                 if metric_fns and n:
-                    pred = np.asarray(apply_fn(params, xs))
+                    pred = _predict_batched(apply_fn, params, xs)
                 for mname, fn in metric_fns.items():
                     history[f"train_{mname}"].append(
                         float(fn(pred, ys)) if pred is not None else 0.0)
                 if len(vx):
-                    vpred = np.asarray(apply_fn(params, vx))
+                    vpred = _predict_batched(apply_fn, params, vx)
                     history["val_loss"].append(
                         float(loss_fn(vpred, vy)))
                     for mname, fn in metric_fns.items():
                         history[f"val_{mname}"].append(
                             float(fn(vpred, vy)))
+                if cbs:
+                    # callbacks may rewrite logs in place (e.g.
+                    # MetricAverageCallback's cross-rank average) or add
+                    # new keys (Keras-style logs["lr"] = ...)
+                    logs = {k: v[-1] for k, v in history.items() if v}
+                    for cb in cbs:
+                        cb_state = cb.on_epoch_end(epoch, logs, cb_state)
+                    for k, v in logs.items():
+                        series = history.setdefault(k, [])
+                        if len(series) == epoch + 1:
+                            series[-1] = v
+                        else:
+                            series.append(v)
             hvd.shutdown()
             out = {"rank": rank, "rows_touched": int(touched),
                    "history": history}
@@ -454,6 +498,7 @@ class TorchEstimator:
         seed: int = 0,
         validation: float = 0.0,
         metrics: Optional[Dict[str, Callable]] = None,
+        callbacks: Optional[Sequence] = None,
     ):
         from .store import store_or_none
 
@@ -472,6 +517,8 @@ class TorchEstimator:
         self.seed = seed
         self.validation = float(validation)
         self.metrics = dict(metrics or {})
+        # same contract as JaxEstimator.callbacks (runs in every slot)
+        self.callbacks = list(callbacks or [])
 
     def fit(self, df) -> "TorchModel":
         import torch
@@ -493,6 +540,7 @@ class TorchEstimator:
         n_features = len(self.feature_cols)
         n_labels = len(self.label_cols)
         metric_fns = self.metrics
+        cbs = self.callbacks
 
         def train():
             import os
@@ -528,7 +576,12 @@ class TorchEstimator:
                 history[f"train_{mname}"] = []
                 if len(vx):
                     history[f"val_{mname}"] = []
+            cb_state = None
+            for cb in cbs:
+                cb_state = cb.on_train_begin(cb_state)
             for epoch in range(epochs):
+                for cb in cbs:
+                    cb_state = cb.on_epoch_begin(epoch, cb_state)
                 perm = torch.from_numpy(
                     np.random.RandomState(seed + epoch).permutation(
                         max(n, 1)))
@@ -546,20 +599,40 @@ class TorchEstimator:
                     loss.backward()
                     opt.step()
                     losses.append(float(loss.detach()))
+                    for cb in cbs:
+                        cb_state = cb.on_batch_end(i, cb_state)
                 history["train_loss"].append(float(np.mean(losses)))
-                with torch.no_grad():
-                    if metric_fns and n:
-                        pred = model(xs)
-                        for mname, fn in metric_fns.items():
-                            history[f"train_{mname}"].append(
-                                float(fn(pred, ys)))
-                    if len(vx):
-                        vpred = model(vx)
-                        history["val_loss"].append(
-                            float(loss_fn(vpred, vy)))
-                        for mname, fn in metric_fns.items():
-                            history[f"val_{mname}"].append(
-                                float(fn(vpred, vy)))
+                def eval_batched(t):
+                    # bounded chunks: metric eval must not materialize
+                    # the whole shard's activations in one call
+                    with torch.no_grad():
+                        return torch.cat([
+                            model(t[i:i + 4096])
+                            for i in range(0, len(t), 4096)
+                        ]) if len(t) else model(t)
+
+                if metric_fns and n:
+                    pred = eval_batched(xs)
+                    for mname, fn in metric_fns.items():
+                        history[f"train_{mname}"].append(
+                            float(fn(pred, ys)))
+                if len(vx):
+                    vpred = eval_batched(vx)
+                    history["val_loss"].append(
+                        float(loss_fn(vpred, vy)))
+                    for mname, fn in metric_fns.items():
+                        history[f"val_{mname}"].append(
+                            float(fn(vpred, vy)))
+                if cbs:
+                    logs = {k: v[-1] for k, v in history.items() if v}
+                    for cb in cbs:
+                        cb_state = cb.on_epoch_end(epoch, logs, cb_state)
+                    for k, v in logs.items():
+                        series = history.setdefault(k, [])
+                        if len(series) == epoch + 1:
+                            series[-1] = v
+                        else:
+                            series.append(v)
             thvd.shutdown()
             out = {"rank": rank, "rows_touched": int(touched),
                    "history": history}

@@ -101,3 +101,43 @@ def test_unknown_op_raises_not_passthrough():
     )
     with pytest.raises(HorovodInternalError):
         LoopbackExecutor(1)(batch, {"z": np.ones((1,), np.float32)})
+
+
+def test_hier_reduce_leaf_matches_flat_psum(hvd8):
+    """The autotuned hierarchical allreduce leaf (XlaExecutor
+    _hier_reduce_leaf — live during the Bayes search, round 4) is
+    value-equal to the flat psum for every block size that divides the
+    world."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.eager_runtime import XlaExecutor
+
+    # the executor's leaves are written against its own 'proc' axis
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("proc",))
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 16), jnp.float32)
+    ex = XlaExecutor.__new__(XlaExecutor)  # only the leaf is exercised
+    for block in (2, 4):
+        leaf = ex._hier_reduce_leaf(
+            reduce_op=0, prescale=2.0, postscale=0.5, n=8, block=block)  # AVERAGE
+
+        def wrapped(v):
+            return leaf(v.reshape(-1)).reshape(v.shape)
+
+        def flat(v):
+            return (jax.lax.psum(v * 2.0, "proc") / 8 * 0.5)
+
+        out_h = jax.jit(shard_map(
+            wrapped, mesh=mesh, in_specs=P("proc"), out_specs=P("proc"),
+            check_vma=False))(x)
+        out_f = jax.jit(shard_map(
+            flat, mesh=mesh, in_specs=P("proc"), out_specs=P("proc"),
+            check_vma=False))(x)
+        np.testing.assert_allclose(np.asarray(out_h), np.asarray(out_f),
+                                   rtol=1e-6, atol=1e-6)

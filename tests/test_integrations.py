@@ -740,3 +740,55 @@ def test_read_shard_partitions_rows_disjointly(tmp_path):
         assert sorted(seen) == sorted(
             float(v + 100 * p) for p in range(nparts)
             for v in range(rows_per_part)), f"size={size}"
+
+
+def test_jax_estimator_callbacks(monkeypatch, tmp_path):
+    """Reference KerasEstimator's callbacks param: horovod_tpu.callbacks
+    instances run inside the training slots — epoch-end sees (and may
+    rewrite) the epoch's logs."""
+    import horovod_tpu.spark as sp
+    from horovod_tpu.callbacks import Callback
+
+    _install_fake_pyspark(monkeypatch, ["h1:1"], default_parallelism=1)
+
+    class Recorder(Callback):
+        calls = []
+
+        def on_train_begin(self, state=None):
+            Recorder.calls.append("train_begin")
+            return state
+
+        def on_epoch_begin(self, epoch, state=None):
+            Recorder.calls.append(f"epoch_begin:{epoch}")
+            return state
+
+        def on_batch_end(self, batch, state=None):
+            Recorder.calls.append("batch")
+            return state
+
+        def on_epoch_end(self, epoch, logs=None, state=None):
+            Recorder.calls.append(f"epoch_end:{epoch}")
+            logs["train_loss"] = -123.0  # visible rewrite
+            return state
+
+    def init_fn(rng, x):
+        import jax.numpy as jnp
+
+        return {"w": jnp.zeros((x.shape[-1], 1))}
+
+    def apply_fn(p, x):
+        return x @ p["w"]
+
+    est = sp.JaxEstimator(
+        model=(init_fn, apply_fn),
+        feature_cols=["x1", "x2"], label_cols=["label"],
+        optimizer_spec=("adam", {"learning_rate": 0.05}),
+        batch_size=16, epochs=2, num_proc=1,
+        callbacks=[Recorder()],
+    )
+    model = est.fit(_linear_df(n=32))
+    assert Recorder.calls[0] == "train_begin"
+    assert "epoch_begin:0" in Recorder.calls
+    assert "epoch_end:1" in Recorder.calls
+    assert Recorder.calls.count("batch") >= 2
+    assert model.history["train_loss"] == [-123.0, -123.0]
