@@ -138,6 +138,12 @@ def main(argv=None, stats=None):
     lab = jax.device_put(labels, shard)
     msk = jax.device_put(mask, shard)
 
+    # AOT-compile and call the executable directly: same program, but
+    # the per-call jit dispatch costs ~5-8% through remote-TPU paths
+    # (measured with scripts/xla_options_sweep.py; on local TPU both
+    # paths are equally fast)
+    step = step.lower(params, opt_state, tok, lab, msk).compile()
+
     if hvd.rank() == 0:
         print(
             f"BERT {cfg.num_layers}L/{cfg.hidden_size}H "

@@ -159,6 +159,12 @@ def main(argv=None, stats=None):
     xs = jax.device_put(xb.astype(jnp.bfloat16), shard)
     ys = jax.device_put(yb, shard)
 
+    # AOT-compile and call the executable directly: same program, but
+    # the per-call jit dispatch costs ~5-8% through remote-TPU paths
+    # (measured with scripts/xla_options_sweep.py; on local TPU both
+    # paths are equally fast)
+    step = step.lower(params, batch_stats, opt_state, xs, ys).compile()
+
     if hvd.rank() == 0:
         print(f"model: {args.model}, batch {args.batch_size} x {n} ranks, "
               f"image {args.image_size}px", flush=True)
