@@ -26,7 +26,10 @@ images/sec/GPU; vs_baseline = ours / 103.55.
 Config provenance (measured on v5e, round 4): ResNet batch 256 +
 space-to-depth stem (256 > 128/512/1024; s2d +1.5%); BERT batch 26 +
 flash attention (26 > 24/27/28/30/32 after the single-chip
-fusion-bucket skip freed HBM; see docs/benchmarks.md).
+fusion-bucket skip freed HBM). Steps execute through AOT-compiled
+executables with >= 12-batch timing windows — the per-call jit
+dispatch and per-window host sync cost ~5-8% through remote-TPU
+paths (see docs/benchmarks.md).
 """
 
 import json
@@ -58,26 +61,26 @@ def main():
 
     rs, bs, is_, vs = {}, {}, {}, {}
     img_per_chip, resnet_mfu = resnet.main(
-        ["--num-iters", "5", "--num-batches-per-iter", "10",
+        ["--num-iters", "5", "--num-batches-per-iter", "16",
          "--num-warmup-batches", "3", "--batch-size", "256",
          "--s2d-stem"],
         stats=rs,
     )
     tok_per_chip, bert_mfu = bert.main(
-        ["--num-iters", "4", "--num-batches-per-iter", "6",
+        ["--num-iters", "4", "--num-batches-per-iter", "12",
          "--num-warmup-batches", "2", "--batch-size", "26", "--flash"],
         stats=bs,
     )
     # the scaling trio's other two models (secondary evidence)
     inc_per_chip, inc_mfu = resnet.main(
         ["--model", "inception3", "--num-iters", "3",
-         "--num-batches-per-iter", "8", "--num-warmup-batches", "3",
+         "--num-batches-per-iter", "12", "--num-warmup-batches", "3",
          "--batch-size", "256"],
         stats=is_,
     )
     vgg_per_chip, vgg_mfu = resnet.main(
         ["--model", "vgg16", "--num-iters", "3",
-         "--num-batches-per-iter", "8", "--num-warmup-batches", "3",
+         "--num-batches-per-iter", "12", "--num-warmup-batches", "3",
          "--batch-size", "128"],
         stats=vs,
     )

@@ -6,10 +6,12 @@ axon client does not register libtpu flags), but per-compile
 ``compiler_options`` ship with the compile request and DO apply —
 probed working set includes the fusion-shaping knobs
 (xla_tpu_scoped_vmem_limit_kib, xla_jf_conv_input/output_fusion,
-xla_tpu_rwb_fusion, ...). This script AOT-compiles the same train step
-bench.py measures under each candidate option set and times real steps,
-because docs/benchmarks.md's trace analysis says the CNN gap lives in
-conv+BN fusion codegen quality — exactly what these knobs move.
+xla_tpu_rwb_fusion, ...). This script AOT-compiles a replica of the train
+step bench.py measures (same model/loss/shard_map/donation; keep it in
+sync with examples/resnet50_synthetic.py when that changes) under each
+candidate option set and times real steps, because docs/benchmarks.md's
+trace analysis says the CNN gap lives in conv+BN fusion codegen
+quality — exactly what these knobs move.
 
 Usage:
     python scripts/xla_options_sweep.py --model resnet50 --batch-size 256
@@ -66,14 +68,20 @@ def main(argv=None):
                    help="comma-separated subset of sweep names")
     args = p.parse_args(argv)
 
+    if args.s2d_stem and not args.model.startswith("resnet"):
+        raise SystemExit("--s2d-stem applies to the resnet family")
     hvd.init()
     mesh = hvd.mesh()
+    n = hvd.size()
     model_cls, size = _MODELS[args.model]
     kw = {"stem": "space_to_depth"} if args.s2d_stem else {}
     model = model_cls(num_classes=1000, dtype=jnp.bfloat16, **kw)
     rng = jax.random.PRNGKey(0)
-    xb = np.random.rand(args.batch_size, size, size, 3).astype(np.float32)
-    yb = np.random.randint(0, 1000, args.batch_size)
+    # per-RANK batch (matching the example's semantics): the global
+    # batch is batch_size * n, so per-chip workload equals bench.py's
+    xb = np.random.rand(
+        args.batch_size * n, size, size, 3).astype(np.float32)
+    yb = np.random.randint(0, 1000, args.batch_size * n)
     variables = jax.jit(model.init)(
         rng, jnp.zeros((1, size, size, 3), jnp.bfloat16))
     params0 = variables["params"]
@@ -101,11 +109,15 @@ def main(argv=None):
         return optax.apply_updates(p, upd), bs, s, jax.lax.psum(
             l, "hvd").reshape(1)
 
+    # donation matches the example exactly — the options being swept
+    # trade codegen shape against live-HBM pressure, so the timed
+    # program must have the benchmark's memory profile
     jitted = jax.jit(
         jax.shard_map(step_fn, mesh=mesh,
                       in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
                       out_specs=(P(), P(), P(), P()),
-                      check_vma=False))
+                      check_vma=False),
+        donate_argnums=(0, 1, 2))
     lowered = jitted.lower(
         params0, bs0, state0,
         jax.ShapeDtypeStruct(xb.shape, jnp.bfloat16),
@@ -126,7 +138,10 @@ def main(argv=None):
         except Exception as e:
             print(f"{name}: COMPILE FAILED {str(e)[:90]}", flush=True)
             continue
-        params, bs, state = params0, bs0, state0
+        # fresh copies per config: the donated originals are consumed
+        params = jax.tree.map(jnp.copy, params0)
+        bs = jax.tree.map(jnp.copy, bs0)
+        state = jax.tree.map(jnp.copy, state0)
         for _ in range(3):
             params, bs, state, loss = compiled(params, bs, state, xs, ys)
         float(loss[0])
@@ -135,9 +150,10 @@ def main(argv=None):
             params, bs, state, loss = compiled(params, bs, state, xs, ys)
         float(loss[0])
         dt = time.perf_counter() - t0
-        rate = args.batch_size * args.steps / dt
+        del params, bs, state
+        rate = args.batch_size * n * args.steps / dt / max(n, 1)
         results[name] = round(rate, 1)
-        print(f"{name}: {rate:.1f} img/s", flush=True)
+        print(f"{name}: {rate:.1f} img/s/chip", flush=True)
     print(json.dumps(results))
 
 
