@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """Comm/compute overlap analysis → OVERLAP_r{N}.json.
 
-AOT-compiles the DistributedOptimizer train step for a real 8-chip
-v5e topology (jax.experimental.topologies — needs a TPU client but not
-8 physical chips) and reports how the optimized schedule places the
-per-bucket gradient all-reduces relative to backward compute. See
+AOT-compiles the DistributedOptimizer train step for a real v5e
+topology (jax.experimental.topologies — needs a TPU client but not the
+physical chips; --topology v5e:16x16 compiles the full 256-chip
+BASELINE-scale program) and reports how the optimized schedule places
+the per-bucket gradient all-reduces relative to backward compute. See
 tests/test_overlap_schedule.py for the suite-side assertions and
 docs/benchmarks.md for the findings.
 
@@ -28,6 +29,9 @@ from jax.sharding import PartitionSpec as P
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="OVERLAP_r04.json")
+    ap.add_argument("--topology", default="v5e:2x4",
+                    help="AOT topology, e.g. v5e:2x4 (8 chips) or "
+                         "v5e:16x16 (256 chips - the BASELINE scale)")
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--hidden", type=int, default=512)
     ap.add_argument("--fusion-mb", type=int, default=4)
@@ -40,15 +44,17 @@ def main(argv=None):
     from horovod_tpu.models.transformer import TransformerConfig
 
     topo = topologies.get_topology_desc(
-        topology_name="v5e:2x4", platform="tpu")
-    mesh = topologies.make_mesh(topo, (8,), ("hvd",))
+        topology_name=args.topology, platform="tpu")
+    nchips = len(topo.devices)
+    mesh = topologies.make_mesh(topo, (nchips,), ("hvd",))
     hvd.init(mesh=mesh)
 
     cfg = TransformerConfig(
         vocab_size=512, num_layers=args.layers, num_heads=8,
         hidden_size=args.hidden, max_seq_len=128, dtype=jnp.bfloat16)
     m = Transformer(cfg)
-    toks_s = jax.ShapeDtypeStruct((16, cfg.max_seq_len), jnp.int32)
+    toks_s = jax.ShapeDtypeStruct((2 * nchips, cfg.max_seq_len),
+                                  jnp.int32)
     params = jax.eval_shape(
         lambda: m.init(jax.random.PRNGKey(0),
                        jnp.ones((2, cfg.max_seq_len), jnp.int32)))
@@ -80,7 +86,7 @@ def main(argv=None):
            and re.search(r' (dot|fusion|convolution|custom-call)\(', l)]
     bwd_after_first_ar = sum(1 for b in bwd if b > ars[0]) if ars else 0
     report = {
-        "topology": "v5e:2x4 (AOT)",
+        "topology": f"{args.topology} ({nchips} chips, AOT)",
         "scheduled": "is_scheduled=true" in txt,
         "bucket_all_reduces_in_optimized_hlo": len(ars),
         "backward_compute_ops": len(bwd),
