@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""On-device numerics validation for the pallas kernel family.
+
+The test suite exercises these kernels in interpret mode on the CPU
+mesh (tests/test_pallas_*.py) — the same code path, but not the Mosaic
+compiler. This script re-runs the numerics oracles ON A REAL TPU so
+Mosaic-specific issues (tiling, masked loads/stores, accumulation
+order) can't hide. Run it on any TPU-attached environment:
+
+    python scripts/validate_tpu_kernels.py
+
+Exits non-zero on any mismatch; prints one PASS line per check.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _check(name, got, want, atol, rtol=1e-3):
+    got, want = np.asarray(got, np.float32), np.asarray(want, np.float32)
+    err = np.max(np.abs(got - want) / (np.abs(want) + atol))
+    ok = np.allclose(got, want, atol=atol, rtol=rtol)
+    print(f"{'PASS' if ok else 'FAIL'} {name}: max rel err {err:.2e}",
+          flush=True)
+    return ok
+
+
+def main():
+    if jax.default_backend() != "tpu":
+        print("no TPU attached; kernels would run in interpret mode "
+              "(already covered by the suite) — nothing to validate")
+        return 0
+    rng = np.random.RandomState(0)
+    ok = True
+
+    # flash attention fwd+bwd vs jnp oracle (bf16 inputs, f32 oracle)
+    from horovod_tpu.ops.pallas_attention import (
+        _reference_attention, flash_attention)
+
+    B, H, T, D = 2, 4, 512, 64
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.bfloat16)
+    for causal in (False, True):
+        def f(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=causal).astype(
+                    jnp.float32) ** 2)
+
+        def ref(q, k, v):
+            qq, kk, vv = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+            o = _reference_attention(qq, kk, vv, causal, 1.0 / D ** 0.5,
+                                     0, 0)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        o1 = jax.jit(f)(q, k, v)
+        o0 = jax.jit(ref)(q, k, v)
+        ok &= _check(f"flash fwd causal={causal}", o1, o0, atol=2.0,
+                     rtol=2e-2)
+        g1 = jax.jit(jax.grad(f))(q, k, v)
+        g0 = jax.jit(jax.grad(ref))(q, k, v)
+        ok &= _check(f"flash dq causal={causal}",
+                     jnp.sum(jnp.abs(g1.astype(jnp.float32))),
+                     jnp.sum(jnp.abs(g0.astype(jnp.float32))),
+                     atol=1.0, rtol=2e-2)
+
+    # fused BatchNorm (+relu+residual) vs jnp oracle, f32
+    from horovod_tpu.ops.pallas_batchnorm import fused_batch_norm
+
+    x = jnp.asarray(rng.randn(8, 14, 14, 256), jnp.float32)
+    res = jnp.asarray(rng.randn(*x.shape), jnp.float32)
+    g = jnp.asarray(rng.rand(256) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(256), jnp.float32)
+
+    def bn_ref(x, g, b, res):
+        m = x.mean((0, 1, 2))
+        vv = ((x - m) ** 2).mean((0, 1, 2))
+        y = (x - m) * jax.lax.rsqrt(vv + 1e-5) * g + b + res
+        return jnp.maximum(y, 0)
+
+    def bn_ours(x, g, b, res):
+        return fused_batch_norm(x, g, b, activation="relu",
+                                residual=res)[0]
+
+    y1 = jax.jit(bn_ours)(x, g, b, res)
+    y0 = jax.jit(bn_ref)(x, g, b, res)
+    ok &= _check("fused_bn fwd", y1, y0, atol=1e-4)
+    gr1 = jax.jit(jax.grad(lambda *a: jnp.sum(bn_ours(*a) ** 2),
+                           argnums=(0, 1, 2, 3)))(x, g, b, res)
+    gr0 = jax.jit(jax.grad(lambda *a: jnp.sum(bn_ref(*a) ** 2),
+                           argnums=(0, 1, 2, 3)))(x, g, b, res)
+    for i, nm in enumerate(("dx", "dgamma", "dbeta", "dres")):
+        ok &= _check(f"fused_bn {nm}", gr1[i], gr0[i], atol=1e-3,
+                     rtol=5e-3)
+
+    # fused LayerNorm / RMSNorm vs jnp oracle, f32
+    from horovod_tpu.ops.pallas_layernorm import fused_layer_norm
+
+    x2 = jnp.asarray(rng.randn(24 * 512, 1024), jnp.float32)
+    g2 = jnp.asarray(rng.rand(1024) + 0.5, jnp.float32)
+    b2 = jnp.asarray(rng.randn(1024), jnp.float32)
+
+    def ln_ref(x, g, b):
+        m = x.mean(-1, keepdims=True)
+        vv = ((x - m) ** 2).mean(-1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(vv + 1e-5) * g + b
+
+    y1 = jax.jit(lambda x, g, b: fused_layer_norm(x, g, b))(x2, g2, b2)
+    y0 = jax.jit(ln_ref)(x2, g2, b2)
+    ok &= _check("fused_ln fwd", y1, y0, atol=1e-4)
+    gl1 = jax.jit(jax.grad(
+        lambda *a: jnp.sum(fused_layer_norm(*a) ** 2),
+        argnums=(0, 1, 2)))(x2, g2, b2)
+    gl0 = jax.jit(jax.grad(lambda *a: jnp.sum(ln_ref(*a) ** 2),
+                           argnums=(0, 1, 2)))(x2, g2, b2)
+    for i, nm in enumerate(("dx", "dgamma", "dbeta")):
+        ok &= _check(f"fused_ln {nm}", gl1[i], gl0[i], atol=1e-3,
+                     rtol=5e-3)
+
+    def rms_ref(x, g):
+        return x * jax.lax.rsqrt((x * x).mean(-1, keepdims=True)
+                                 + 1e-5) * g
+
+    y1 = jax.jit(lambda x, g: fused_layer_norm(
+        x, g, kind="rmsnorm"))(x2, g2)
+    y0 = jax.jit(rms_ref)(x2, g2)
+    ok &= _check("fused_rms fwd", y1, y0, atol=1e-4)
+
+    # fused vocab-blocked cross-entropy vs dense oracle
+    from horovod_tpu.ops.fused_cross_entropy import (
+        fused_linear_cross_entropy)
+
+    N, Dh, V = 512, 256, 4099  # odd vocab exercises block masking
+    h = jnp.asarray(rng.randn(N, Dh) * 0.2, jnp.float32)
+    w = jnp.asarray(rng.randn(Dh, V) * 0.2, jnp.float32)
+    labels = jnp.asarray(rng.randint(0, V, N))
+
+    def ce_ref(h, w):
+        logits = h @ w
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, labels[:, None], axis=-1))
+
+    l1 = jax.jit(lambda h, w: fused_linear_cross_entropy(
+        h, w, labels)[0])(h, w)
+    l0 = jax.jit(ce_ref)(h, w)
+    ok &= _check("fused_ce loss", l1, l0, atol=1e-4)
+
+    print("ALL PASS" if ok else "FAILURES PRESENT", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
