@@ -28,6 +28,18 @@ from .collectives import (  # noqa: F401
 )
 from .adasum import adasum_allreduce, hierarchical_adasum  # noqa: F401
 from .fusion import flatten_pytree_buckets, fuse_apply  # noqa: F401
+# pallas kernel family (TPU-first hot ops; interpret-mode off-TPU)
+from .pallas_attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_bhtd,
+    make_flash_attention_fn,
+)
+from .pallas_batchnorm import FusedBatchNorm, fused_batch_norm  # noqa: F401
+from .pallas_layernorm import FusedLayerNorm, fused_layer_norm  # noqa: F401
+from .fused_cross_entropy import (  # noqa: F401
+    fused_causal_lm_loss,
+    fused_linear_cross_entropy,
+)
 from .sparse import (  # noqa: F401
     IndexedSlices,
     dense_to_sparse,
