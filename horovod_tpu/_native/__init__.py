@@ -104,6 +104,7 @@ def load() -> ctypes.CDLL:
     lib.hvd_native_tuned_cache_enabled.restype = ctypes.c_int
     lib.hvd_native_tuned_hierarchical.restype = ctypes.c_int
     lib.hvd_native_tuned_hier_block.restype = ctypes.c_longlong
+    lib.hvd_native_tuned_bayes.restype = ctypes.c_int
     lib.hvd_native_enqueue.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
@@ -153,9 +154,15 @@ class ExecutionBatch:
     def __init__(self, batch_id, op, reduce_op, root_rank, prescale,
                  postscale, dtype, total_bytes, names, handles, first_shape,
                  error_reason, cycle=0, rank_dim0=(), all_splits=(),
-                 shapes=(), process_set_id=0, set_ranks=()):
+                 shapes=(), process_set_id=0, set_ranks=(),
+                 tuned_hierarchical=False, tuned_hier_block=0):
         self.batch_id = batch_id
         self.cycle = cycle
+        # autotune sample point snapshotted by the native loop at batch
+        # creation — cycle-coherent across ranks, unlike a pop-time read
+        # of the rank-local atomics (ADVICE r4 #1)
+        self.tuned_hierarchical = tuned_hierarchical
+        self.tuned_hier_block = tuned_hier_block
         self.rank_dim0 = list(rank_dim0)    # allgather: per-MEMBER dim-0
         self.all_splits = list(all_splits)  # alltoall: set-local matrix
         self.shapes = [list(s) for s in shapes]  # per-tensor, ∥ names
@@ -211,6 +218,11 @@ class _BatchReader:
     def vec64(self):
         n = self.i32()
         return [self.i64() for _ in range(n)]
+
+    def u8(self):
+        v = self._d[self._p]
+        self._p += 1
+        return v
 
 
 class NativeRuntime:
@@ -343,12 +355,16 @@ class NativeRuntime:
         shapes = [r.vec64() for _ in range(r.i32())]
         process_set_id = r.i32()
         set_ranks = r.vec64()
+        tuned_hierarchical = r.u8() != 0
+        tuned_hier_block = r.i64()
         return ExecutionBatch(batch_id, op, reduce_op, root_rank, prescale,
                               postscale, dtype, total_bytes, names, handles,
                               first_shape, error_reason, cycle=cycle,
                               rank_dim0=rank_dim0, all_splits=all_splits,
                               shapes=shapes, process_set_id=process_set_id,
-                              set_ranks=set_ranks)
+                              set_ranks=set_ranks,
+                              tuned_hierarchical=tuned_hierarchical,
+                              tuned_hier_block=tuned_hier_block)
 
     def batch_done(self, batch: ExecutionBatch, ok: bool = True) -> None:
         arr = (ctypes.c_longlong * len(batch.handles))(*batch.handles)
@@ -388,3 +404,8 @@ class NativeRuntime:
 
     def tuned_hier_block(self) -> int:
         return self._lib.hvd_native_tuned_hier_block()
+
+    def tuned_bayes(self) -> bool:
+        """Whether the 5-D Bayes search owns the cache/hierarchical
+        dims (the 2-D coordinate-descent tuner never explores them)."""
+        return bool(self._lib.hvd_native_tuned_bayes())

@@ -200,6 +200,10 @@ struct ResponseList {
   // ranks per inner (ICI) domain for hierarchical collectives
   // (ops/hierarchical.py resolve_block); 0 = launcher-topology default
   int64_t tuned_hier_block = 0;
+  // true only when the 5-D Bayes search owns the cache/hierarchical
+  // dims; the 2-D coordinate-descent tuner never explores them, so its
+  // defaults must not override user-set knobs at pin time (ADVICE r4 #2)
+  bool tuned_bayes = false;
 };
 
 }  // namespace hvd

@@ -670,6 +670,7 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
     rl.tuned_cache_enabled = at_cache_enabled_;
     rl.tuned_hierarchical = at_hierarchical_;
     rl.tuned_hier_block = at_hier_block_;
+    rl.tuned_bayes = opts_.autotune_bayes;
   }
 
   // 7. broadcast the agreed list

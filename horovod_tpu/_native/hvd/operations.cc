@@ -53,6 +53,14 @@ struct Batch {
   // landing between negotiation and the executor's pop must not turn a
   // subset batch into an (empty-members = global) one
   std::vector<int64_t> set_members;
+  // autotune sample point SNAPSHOTTED at batch creation, cycle-coherent
+  // with the ResponseList that delivered it: workers lag the loop by
+  // many cycles (a JAX compile takes seconds, a cycle ~1ms), so reading
+  // the live atomics at pop time lets two ranks stamp different routing
+  // for the same negotiated batch — mismatched SPMD programs for one
+  // logical collective (ADVICE r4 #1)
+  bool tuned_hierarchical = false;
+  int64_t tuned_hier_block = 0;
 };
 
 struct Global {
@@ -114,6 +122,7 @@ struct Global {
   std::atomic<bool> tuned_cache_enabled{true};
   std::atomic<bool> tuned_hierarchical{false};
   std::atomic<long long> tuned_hier_block{0};
+  std::atomic<bool> tuned_bayes{false};
 
   std::mutex err_mu;
   std::string last_error;
@@ -263,6 +272,7 @@ bool RunLoopOnce() {
   if (rl.tuned_hier_block > 0) {
     g->tuned_hier_block.store(rl.tuned_hier_block);
   }
+  if (rl.tuned_bayes) g->tuned_bayes.store(true);
 
   // Apply the coordinated invalidations before any Put from this cycle's
   // responses: same order on every rank, identical cache state after.
@@ -323,6 +333,8 @@ bool RunLoopOnce() {
       b.cycle = cycle;
       b.response = resp;
       b.handles = hs;
+      b.tuned_hierarchical = g->tuned_hierarchical.load();
+      b.tuned_hier_block = g->tuned_hier_block.load();
       for (int64_t h : hs) SetHandle(h, kBatched);
       PushBatch(std::move(b));
       continue;
@@ -433,6 +445,11 @@ bool RunLoopOnce() {
     b.response = resp;
     b.handles = handles;
     b.set_members = std::move(snapshot_members);
+    // loop thread is the sole writer of the tuned atomics and updated
+    // them above from THIS cycle's ResponseList — reading them here is
+    // cycle-coherent in a way the worker thread's pop-time read is not
+    b.tuned_hierarchical = g->tuned_hierarchical.load();
+    b.tuned_hier_block = g->tuned_hier_block.load();
     for (int64_t h : handles) SetHandle(h, kBatched);
     PushBatch(std::move(b));
   }
@@ -721,7 +738,7 @@ int hvd_native_wait(long long handle, double timeout_s) {
 // Serialized batch: id, cycle, op, reduce_op, root_rank, prescale,
 // postscale, dtype, total_bytes, names, handles, first_shape,
 // error_reason, rank_dim0, all_splits, tensor_shapes, process_set_id,
-// set_members.
+// set_members, tuned_hierarchical, tuned_hier_block.
 // Returns: >0 bytes written; 0 timeout/none; <0 the NEGATED required
 // buffer size — the batch stays queued so the caller can retry with a
 // larger buffer (an alltoall batch carries an O(size^2) splits matrix,
@@ -770,6 +787,9 @@ long long hvd_native_next_batch(unsigned char* buf, long long buflen,
   // empty-members (= global!) batch for a subset op.
   w.I32(b.response.process_set_id);
   w.Vec(b.set_members);
+  // the cycle-coherent autotune sample point (see Batch)
+  w.U8(b.tuned_hierarchical ? 1 : 0);
+  w.I64(b.tuned_hier_block);
   if (static_cast<long long>(w.data().size()) > buflen) {
     // too small: requeue at the front (order preserved) and report the
     // needed size so the caller can retry — dropping a popped batch
@@ -852,6 +872,12 @@ int hvd_native_tuned_cache_enabled() {
 
 int hvd_native_tuned_hierarchical() {
   return g && g->tuned_hierarchical.load() ? 1 : 0;
+}
+
+// true iff the 5-D Bayes search owns the cache/hierarchical dims —
+// gate for applying those winners to user-visible knobs (ADVICE r4 #2)
+int hvd_native_tuned_bayes() {
+  return g && g->tuned_bayes.load() ? 1 : 0;
 }
 
 long long hvd_native_tuned_hier_block() {

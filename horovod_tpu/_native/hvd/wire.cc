@@ -2,6 +2,14 @@
 
 namespace hvd {
 
+// Wire protocol version, checked FIRST on every control-plane frame: a
+// mixed-version coordinator/worker pair fails cleanly at deserialize
+// instead of misparsing the stream from the first changed field onward
+// (ADVICE r4 #5). Bump whenever any serialized layout changes.
+//   v1: round-4 layout + ResponseList.tuned_bayes
+static constexpr uint8_t kWireMagic = 0xB5;
+static constexpr uint8_t kWireVersion = 1;
+
 static void WriteRequest(Writer* w, const Request& r) {
   w->I32(r.rank);
   w->I32(static_cast<int32_t>(r.op));
@@ -38,6 +46,8 @@ static Request ReadRequest(Reader* r) {
 
 std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
   Writer w;
+  w.U8(kWireMagic);
+  w.U8(kWireVersion);
   w.U8(rl.shutdown ? 1 : 0);
   w.U8(rl.join ? 1 : 0);
   w.Vec(rl.cache_bits);
@@ -50,6 +60,7 @@ std::vector<uint8_t> SerializeRequestList(const RequestList& rl) {
 bool DeserializeRequestList(const uint8_t* data, size_t len,
                             RequestList* rl) {
   Reader r(data, len);
+  if (r.U8() != kWireMagic || r.U8() != kWireVersion) return false;
   rl->shutdown = r.U8() != 0;
   rl->join = r.U8() != 0;
   rl->cache_bits = r.Vec<uint64_t>();
@@ -112,6 +123,8 @@ static Response ReadResponse(Reader* r) {
 
 std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   Writer w;
+  w.U8(kWireMagic);
+  w.U8(kWireVersion);
   w.U8(rl.shutdown ? 1 : 0);
   w.I32(rl.join_count);
   w.Vec(rl.agreed_invalid_bits);
@@ -121,6 +134,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.U8(rl.tuned_cache_enabled ? 1 : 0);
   w.U8(rl.tuned_hierarchical ? 1 : 0);
   w.I64(rl.tuned_hier_block);
+  w.U8(rl.tuned_bayes ? 1 : 0);
   w.I32(static_cast<int32_t>(rl.responses.size()));
   for (const auto& r : rl.responses) WriteResponse(&w, r);
   return w.data();
@@ -129,6 +143,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
 bool DeserializeResponseList(const uint8_t* data, size_t len,
                              ResponseList* rl) {
   Reader r(data, len);
+  if (r.U8() != kWireMagic || r.U8() != kWireVersion) return false;
   rl->shutdown = r.U8() != 0;
   rl->join_count = r.I32();
   rl->agreed_invalid_bits = r.Vec<uint64_t>();
@@ -138,6 +153,7 @@ bool DeserializeResponseList(const uint8_t* data, size_t len,
   rl->tuned_cache_enabled = r.U8() != 0;
   rl->tuned_hierarchical = r.U8() != 0;
   rl->tuned_hier_block = r.I64();
+  rl->tuned_bayes = r.U8() != 0;
   int32_t n = r.I32();
   rl->responses.clear();
   for (int32_t i = 0; i < n && r.ok(); ++i) {

@@ -404,6 +404,12 @@ class EagerRuntime:
         if self._tuning_applied or not self._native.tuned_pinned():
             return
         self._tuning_applied = True
+        # only the 5-D Bayes search explores the hierarchical dims; the
+        # 2-D coordinate-descent tuner leaves at_hierarchical_ at its
+        # default, and applying that default here would silently disable
+        # user-set HOROVOD_HIERARCHICAL_ALLREDUCE=1 (ADVICE r4 #2)
+        if not self._native.tuned_bayes():
+            return
         from ..core.state import global_state
 
         k = global_state().knobs
@@ -419,13 +425,13 @@ class EagerRuntime:
             batch = self._native.next_batch(timeout_s=0.1)
             if batch is None:
                 continue
-            # stamp the coordinator's CURRENT hierarchical sample point
-            # on the batch (one-cycle coherent with the ResponseList
-            # that delivered it) so the data plane executes — and the
-            # tuner therefore scores — the candidate routing during the
-            # search, not just after the pin
-            batch.tuned_hierarchical = self._native.tuned_hierarchical()
-            batch.tuned_hier_block = self._native.tuned_hier_block()
+            # batch.tuned_hierarchical / tuned_hier_block were stamped by
+            # the NATIVE loop at batch creation (operations.cc Batch) —
+            # cycle-coherent with the ResponseList that delivered them.
+            # Reading the rank-local atomics here instead would let two
+            # ranks stamp different routing for one negotiated batch
+            # while workers lag the loop during a Bayes search
+            # (ADVICE r4 #1).
             tl = _timeline()
             if tl is not None and batch.cycle != self._last_cycle:
                 # one marker per negotiation cycle, however many fused
@@ -751,9 +757,10 @@ class XlaExecutor:
         # ncclAllReduce, nccl_operations.cc:175-246)
         flats = [x.reshape(-1) for x in inputs]
         packed = np.concatenate(flats) if len(flats) > 1 else flats[0]
-        # autotuned hierarchical routing, stamped per-batch by the
-        # runtime worker from the coordinator's current sample point —
-        # LIVE during the Bayes search so the x3/x4 dimensions score
+        # autotuned hierarchical routing, stamped on the batch by the
+        # NATIVE loop at batch creation (operations.cc Batch) so every
+        # rank executes the sample point of the cycle that delivered it
+        # — LIVE during the Bayes search so the x3/x4 dimensions score
         # real schedules, not noise (ADVICE r4). Global-set SUM/AVERAGE
         # only, mirroring ops/hierarchical.hierarchy_enabled_for.
         hier_block = 0

@@ -53,13 +53,17 @@ def prepare_data(df, store, run_id: str, feature_cols: Sequence[str],
     row-group layout; npz parts instead of Petastorm parquet — the TPU
     input path is host numpy → device shards).
 
-    Each part carries its own train/validation split (every
-    ceil(1/validation)-th row, deterministic in `seed`), mirroring the
-    reference's validation-column split. Returns the part file names
-    (relative to ``store.get_data_path(run_id)``), sorted.
+    Each part carries its own train/validation split (a deterministic
+    per-row Bernoulli(validation) mask seeded by `seed` + partition
+    index), mirroring the reference's validation-column split. Returns
+    the part file names (relative to ``store.get_data_path(run_id)``),
+    sorted.
     """
     import io
 
+    if not 0.0 <= validation < 1.0:
+        raise ValueError(
+            f"validation must be in [0, 1), got {validation}")
     prefix = store.prefix_path
     data_path = store.get_data_path(run_id)
     fcols, lcols = list(feature_cols), list(label_cols)
@@ -74,10 +78,16 @@ def prepare_data(df, store, run_id: str, feature_cols: Sequence[str],
         y = _rows_to_matrix(rows, lcols)
         n = len(x)
         if validation > 0.0:
-            stride = max(2, int(round(1.0 / validation)))
-            off = (seed + idx) % stride
-            val_mask = np.zeros(n, dtype=bool)
-            val_mask[off::stride] = True
+            # fraction-exact (in expectation) deterministic mask: a
+            # stride of round(1/validation) caps the holdout at 50% and
+            # quantizes it (0.9 → 50%, 0.3 → 33%) — ADVICE r4 #4
+            rng = np.random.RandomState(seed + idx)
+            u = rng.random_sample(n)
+            val_mask = u < validation
+            if val_mask.all():
+                # tiny partition, unlucky draw: keep >= 1 training row
+                # (the old stride scheme guaranteed this for n >= 2)
+                val_mask[int(np.argmax(u))] = False
         else:
             val_mask = np.zeros(n, dtype=bool)
         buf = io.BytesIO()
@@ -369,13 +379,22 @@ class JaxEstimator:
             params = hvd.broadcast_parameters(params, root_rank=0)
 
             @jax.jit
-            def step(p, s, bx, by):
+            def step(p, s, bx, by, w):
+                # w = w_r[i] / mean_r(w[i]) (see `scale` above): keep-
+                # alive batches on empty/short shards run the SAME
+                # collectives (step-count parity) but their loss is
+                # scaled to 0, so they contribute identity gradients to
+                # the cross-rank average instead of biasing every rank's
+                # update with gradients of zero-filled rows; partial
+                # batches are weighted by their valid-sample fraction
+                # relative to the other ranks' (ADVICE r4 #3)
                 def lf(p):
-                    return loss_fn(apply_fn(p, bx), by)
+                    raw = loss_fn(apply_fn(p, bx), by)
+                    return raw * w, raw
 
-                l, g = jax.value_and_grad(lf)(p)
+                (_, raw), g = jax.value_and_grad(lf, has_aux=True)(p)
                 u, s = opt.update(g, s, p)
-                return optax.apply_updates(p, u), s, l
+                return optax.apply_updates(p, u), s, raw
 
             n = len(xs)
             # every rank must run the same number of steps (collectives
@@ -383,6 +402,25 @@ class JaxEstimator:
             steps = max(1, -(-n // batch_size)) if n else 1
             steps = int(np.max(np.asarray(
                 hvd.allgather(np.asarray([steps], np.int64)))))
+            # per-step gradient weights: w_r[i] = fraction of rank r's
+            # batch i that is real (un-wrapped) data. The loss is scaled
+            # by w_r[i] / mean_r(w[i]) so the allreduce-AVERAGE of the
+            # gradients equals the VALID-SAMPLE-weighted mean — scaling
+            # by w alone would shrink every update by mean(w) instead of
+            # reweighting across ranks (keep-alive batches then
+            # contribute exactly identity gradients, ADVICE r4 #3)
+            w_local = np.asarray(
+                [np.count_nonzero(
+                    np.arange(i * batch_size, (i + 1) * batch_size) < n)
+                 / batch_size for i in range(steps)], np.float32)
+            w_all = np.asarray(hvd.allgather(
+                w_local[None, :])).reshape(-1, steps)
+            w_mean = w_all.mean(axis=0)
+            # steps = max over ranks of ceil(n/batch): the max-achieving
+            # rank has w > 0 at every step, so w_mean > 0 always; guard
+            # for safety
+            scale = np.where(w_mean > 0, w_local / np.maximum(
+                w_mean, 1e-12), 0.0).astype(np.float32)
             history = {"train_loss": []}
             if len(vx):
                 history["val_loss"] = []
@@ -406,14 +444,14 @@ class JaxEstimator:
                         by = np.zeros(
                             (batch_size,) + ys.shape[1:], np.float32)
                     else:
-                        idx = np.take(
-                            perm,
-                            np.arange(i * batch_size,
-                                      (i + 1) * batch_size) % n,
-                            mode="wrap")
+                        pos = np.arange(i * batch_size,
+                                        (i + 1) * batch_size)
+                        idx = np.take(perm, pos % n, mode="wrap")
                         bx, by = xs[idx], ys[idx]
-                    params, opt_state, l = step(params, opt_state, bx, by)
-                    losses.append(float(l))
+                    params, opt_state, l = step(
+                        params, opt_state, bx, by, scale[i])
+                    if w_local[i] > 0:
+                        losses.append(float(l))
                     for cb in cbs:
                         cb_state = cb.on_batch_end(i, cb_state)
                 history["train_loss"].append(
