@@ -78,9 +78,11 @@ from .ops import (  # noqa: F401
     broadcast_async,
     dense_to_sparse,
     grouped_allgather,
+    grouped_allgather_async,
     grouped_allreduce,
     grouped_allreduce_async,
     grouped_reducescatter,
+    grouped_reducescatter_async,
     join,
     masked_allreduce,
     poll,

@@ -105,6 +105,9 @@ def load() -> ctypes.CDLL:
     lib.hvd_native_tuned_hierarchical.restype = ctypes.c_int
     lib.hvd_native_tuned_hier_block.restype = ctypes.c_longlong
     lib.hvd_native_tuned_bayes.restype = ctypes.c_int
+    lib.hvd_native_coord_cycle_stats.argtypes = [
+        ctypes.POINTER(ctypes.c_double)]
+    lib.hvd_native_coord_cycle_stats.restype = None
     lib.hvd_native_enqueue.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
@@ -409,3 +412,16 @@ class NativeRuntime:
         """Whether the 5-D Bayes search owns the cache/hierarchical
         dims (the 2-D coordinate-descent tuner never explores them)."""
         return bool(self._lib.hvd_native_tuned_bayes())
+
+    def coord_cycle_stats(self) -> dict:
+        """Coordinator-side cycle accounting (rank 0; zeros elsewhere):
+        separates the coordinator's CPU work per cycle from wall-clock
+        blocked on worker frames, plus bytes on the wire and cache-hit
+        positions — the attribution the control-plane scaling artifact
+        needs (reference cycle bookkeeping, operations.cc:722)."""
+        buf = (ctypes.c_double * 8)()
+        self._lib.hvd_native_coord_cycle_stats(buf)
+        keys = ("cycles", "busy_cycles", "wait_us", "work_us",
+                "bytes_rx", "bytes_tx", "cache_hit_positions",
+                "responses")
+        return {k: float(v) for k, v in zip(keys, buf)}

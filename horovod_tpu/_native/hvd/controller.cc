@@ -408,12 +408,20 @@ std::vector<Response> TcpController::FuseResponses(
 }
 
 ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
+  // cycle accounting: RecvFrame blocking is WAIT (worker lag + box
+  // contention); everything else in this function is coordinator WORK
+  const double t_enter = MonoSeconds();
+  double wait_s = 0.0;
+
   // 1. gather every worker's RequestList (rank order; lock-step cycle)
   std::vector<RequestList> all(opts_.size);
   all[0] = own;
   for (int32_t r = 1; r < opts_.size; ++r) {
     std::vector<uint8_t> frame;
-    if (!worker_socks_[r - 1].RecvFrame(&frame) ||
+    const double t_rx = MonoSeconds();
+    bool got = worker_socks_[r - 1].RecvFrame(&frame);
+    wait_s += MonoSeconds() - t_rx;
+    if (!got ||
         !DeserializeRequestList(frame.data(), frame.size(), &all[r])) {
       ResponseList err = ErrorList("lost connection to rank " +
                                    std::to_string(r));
@@ -425,6 +433,7 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
       }
       return err;
     }
+    cs_bytes_rx_.fetch_add(static_cast<int64_t>(frame.size()));
   }
 
   bool shutdown = false;
@@ -678,6 +687,17 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
   for (int32_t r = 1; r < opts_.size; ++r) {
     worker_socks_[r - 1].SendFrame(frame);
   }
+
+  cs_cycles_.fetch_add(1);
+  if (!rl.responses.empty()) cs_busy_.fetch_add(1);
+  cs_responses_.fetch_add(static_cast<int64_t>(rl.responses.size()));
+  cs_cache_hits_.fetch_add(
+      static_cast<int64_t>(agreed_positions.size()));
+  cs_bytes_tx_.fetch_add(
+      static_cast<int64_t>(frame.size()) * (opts_.size - 1));
+  cs_wait_us_.fetch_add(static_cast<int64_t>(wait_s * 1e6));
+  cs_work_us_.fetch_add(static_cast<int64_t>(
+      (MonoSeconds() - t_enter - wait_s) * 1e6));
   return rl;
 }
 

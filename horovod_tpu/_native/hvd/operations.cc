@@ -880,6 +880,24 @@ int hvd_native_tuned_bayes() {
   return g && g->tuned_bayes.load() ? 1 : 0;
 }
 
+// Coordinator cycle accounting (rank 0 only; zeros elsewhere). out[8]:
+// cycles, busy_cycles, wait_us, work_us, bytes_rx, bytes_tx,
+// cache_hit_positions, responses. Separates coordinator CPU work from
+// wall-clock blocked on worker frames (controller.h CycleStats).
+void hvd_native_coord_cycle_stats(double* out) {
+  for (int i = 0; i < 8; ++i) out[i] = 0.0;
+  if (g == nullptr || g->controller == nullptr) return;
+  auto s = g->controller->cycle_stats();
+  out[0] = static_cast<double>(s.cycles);
+  out[1] = static_cast<double>(s.busy_cycles);
+  out[2] = static_cast<double>(s.wait_us);
+  out[3] = static_cast<double>(s.work_us);
+  out[4] = static_cast<double>(s.bytes_rx);
+  out[5] = static_cast<double>(s.bytes_tx);
+  out[6] = static_cast<double>(s.cache_hit_positions);
+  out[7] = static_cast<double>(s.responses);
+}
+
 long long hvd_native_tuned_hier_block() {
   return g ? g->tuned_hier_block.load() : 0;
 }

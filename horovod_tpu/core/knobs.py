@@ -76,6 +76,15 @@ class Knobs:
     # all-grads-gated all-reduce — the property that lets collectives
     # overlap backward compute (optim/distributed.py, overlap tests)
     ordered_buckets: bool = True
+    # bucket the gradient pytree in reverse traversal order, so chained
+    # bucket 0 holds the LAST layers' gradients — the ones backward
+    # produces FIRST. With forward order, bucket 0 (first layers) is
+    # only ready when backward is nearly done, pinning the whole
+    # all-reduce chain to the tail of the step and killing overlap
+    # (measured: 4% -> 9x wider window, OVERLAP_r05.json). This is the
+    # compile-time mirror of the reference negotiating gradients in
+    # hook/backward order (torch/optimizer.py grad hooks).
+    bucket_backward_order: bool = True
 
     # --- background/eager runtime (operations.cc:515) ---
     cycle_time_ms: float = 1.0
@@ -143,6 +152,7 @@ class Knobs:
             ),
             batch_d2d_memcopies=_env_bool("BATCH_D2D_MEMCOPIES", True),
             ordered_buckets=_env_bool("ORDERED_BUCKETS", True),
+            bucket_backward_order=_env_bool("BUCKET_BACKWARD_ORDER", True),
             cycle_time_ms=_env_float("CYCLE_TIME", 1.0),
             cache_capacity=_env_int("CACHE_CAPACITY", 1024),
             cache_enabled=_env_int("CACHE_CAPACITY", 1024) > 0,

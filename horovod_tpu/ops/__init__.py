@@ -16,9 +16,11 @@ from .collectives import (  # noqa: F401
     broadcast,
     broadcast_async,
     grouped_allgather,
+    grouped_allgather_async,
     grouped_allreduce,
     grouped_allreduce_async,
     grouped_reducescatter,
+    grouped_reducescatter_async,
     join,
     masked_allreduce,
     poll,

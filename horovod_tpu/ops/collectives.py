@@ -877,13 +877,124 @@ def reducescatter(
     return _dispatch(tensor, spmd, eager, axes)
 
 
-def grouped_reducescatter(tensors, op=ReduceOp.AVERAGE, **kw):
-    """Grouped variant (torch/mpi_ops.py grouped_reducescatter)."""
-    return [reducescatter(t, op=op, **kw) for t in tensors]
+def _by_dtype_groups(arrs):
+    """Index groups per dtype, preserving submission order within each —
+    the reference fuses same-dtype responses only (controller.cc:830)."""
+    groups: dict = {}
+    for i, a in enumerate(arrs):
+        groups.setdefault(a.dtype, []).append(i)
+    return groups
 
 
-def grouped_allgather(tensors, **kw):
-    return [allgather(t, **kw) for t in tensors]
+def grouped_reducescatter(tensors, op=ReduceOp.AVERAGE, name=None,
+                          prescale_factor=1.0, postscale_factor=1.0,
+                          process_set=None, axis_name=None):
+    """Fused reduce-scatter of a list of tensors.
+
+    Reference: group negotiation + fused execution
+    (/root/reference/horovod/common/operations.cc:1532
+    EnqueueTensorReducescatters releases the members all-or-nothing and
+    FuseResponses packs them; torch/mpi_ops.py grouped_reducescatter).
+    Under jit the group packs rank-major into ONE reduce-scatter HLO per
+    dtype; through the native runtime the members enqueue under one
+    group tag so one negotiation cycle covers the whole group.
+    """
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    for t in tensors:
+        _reject_indexed_slices(t, "grouped_reducescatter")
+    axes = _resolve_axis(axis_name)
+    live = _bound_axes(axes)
+    ps = process_set
+    if live:
+        n = _group_size(ps, axis_name)
+        arrs = [jnp.asarray(t) for t in tensors]
+        results: list = [None] * len(arrs)
+        for dtype, idxs in _by_dtype_groups(arrs).items():
+            for i in idxs:
+                if arrs[i].shape[0] % n:
+                    raise HorovodInternalError(
+                        f"grouped_reducescatter dim0 {arrs[i].shape[0]} "
+                        f"not divisible by set size {n}")
+            # rank-major packing: chunk k of every member, concatenated —
+            # a tiled reduce-scatter then hands rank k exactly its chunks
+            # of every member in one collective
+            per_rank = [arrs[i].reshape(n, -1) for i in idxs]
+            packed = jnp.concatenate(per_rank, axis=1).reshape(-1)
+            red = _spmd_reducescatter_leaf(
+                packed, op, live, ps, prescale_factor, postscale_factor)
+            off = 0
+            for i in idxs:
+                a = arrs[i]
+                m = a.size // n
+                out_shape = (a.shape[0] // n,) + a.shape[1:]
+                results[i] = lax.dynamic_slice_in_dim(
+                    red, off, m).reshape(out_shape)
+                off += m
+        return results
+    rt = _native_rt_for_async(ps)
+    if rt is not None:
+        # one group-tagged negotiation round (all-or-nothing), then the
+        # executor fuses the batch — the runtime mirror of the packing
+        return synchronize(grouped_reducescatter_async(
+            tensors, op=op, name=name, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=ps))
+    namer = _leaf_namer(name)
+    return [reducescatter(t, op=op, name=namer(),
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=ps, axis_name=axis_name)
+            for t in tensors]
+
+
+def grouped_allgather(tensors, name=None, process_set=None,
+                      axis_name=None):
+    """Fused allgather of a list of tensors.
+
+    Reference: /root/reference/horovod/common/operations.cc:1725
+    (EnqueueTensorAllgathers — one all-or-nothing group) +
+    torch/mpi_ops.py grouped_allgather. Under jit the group packs into
+    ONE all-gather HLO per dtype; through the native runtime the members
+    ride one group-tagged negotiation cycle.
+    """
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    for t in tensors:
+        _reject_indexed_slices(t, "grouped_allgather")
+    axes = _resolve_axis(axis_name)
+    live = _bound_axes(axes)
+    ps = process_set
+    if live:
+        n = _group_size(ps, axis_name)
+        arrs = [jnp.asarray(t) for t in tensors]
+        results: list = [None] * len(arrs)
+        for dtype, idxs in _by_dtype_groups(arrs).items():
+            flats = [arrs[i].reshape(-1) for i in idxs]
+            packed = (jnp.concatenate(flats)
+                      if len(flats) > 1 else flats[0])
+            total = packed.shape[0]
+            # [n, total]: row k = rank k's contiguous block; ONE slice
+            # per member (not per member x rank — at n=256 that would
+            # bloat the trace by ~n ops per member)
+            g = _spmd_allgather_leaf(packed, live, ps).reshape(n, total)
+            off = 0
+            for i in idxs:
+                a = arrs[i]
+                # member i's column slab across ranks, folded back to
+                # dim-0 concatenation (allgather semantics)
+                slab = lax.dynamic_slice_in_dim(g, off, a.size, axis=1)
+                results[i] = slab.reshape((n * a.shape[0],) + a.shape[1:])
+                off += a.size
+        return results
+    rt = _native_rt_for_async(ps)
+    if rt is not None:
+        return synchronize(grouped_allgather_async(
+            tensors, name=name, process_set=ps))
+    namer = _leaf_namer(name)
+    return [allgather(t, name=namer(), process_set=ps,
+                      axis_name=axis_name) for t in tensors]
 
 
 def alltoall(
@@ -1261,6 +1372,41 @@ def grouped_allreduce_async(tensors, average=None, name=None, op=None,
             process_set_id=_ps_id(process_set),
         )
     return _async(grouped_allreduce, tensors, op=op, name=name,
+                  prescale_factor=prescale_factor,
+                  postscale_factor=postscale_factor,
+                  process_set=process_set, axis_name=axis_name)
+
+
+def grouped_allgather_async(tensors, name=None, process_set=None,
+                            axis_name=None) -> int:
+    """Grouped allgather through one all-or-nothing negotiation round
+    (reference operations.cc:1725, torch/mpi_ops.py)."""
+    tensors = list(tensors)
+    rt = _native_rt_for_async(process_set)
+    if rt is not None and not _contains_indexed_slices(tensors):
+        return _native_async(
+            rt, "allgather", tensors, name=name, grouped=True,
+            process_set_id=_ps_id(process_set),
+        )
+    return _async(grouped_allgather, tensors, name=name,
+                  process_set=process_set, axis_name=axis_name)
+
+
+def grouped_reducescatter_async(tensors, op: ReduceOp = ReduceOp.AVERAGE,
+                                name=None, prescale_factor=1.0,
+                                postscale_factor=1.0, process_set=None,
+                                axis_name=None) -> int:
+    """Grouped reduce-scatter through one all-or-nothing negotiation
+    round (reference operations.cc:1532, torch/mpi_ops.py)."""
+    tensors = list(tensors)
+    rt = _native_rt_for_async(process_set)
+    if rt is not None and not _contains_indexed_slices(tensors):
+        return _native_async(
+            rt, "reducescatter", tensors, op, prescale_factor,
+            postscale_factor, name=name, grouped=True,
+            process_set_id=_ps_id(process_set),
+        )
+    return _async(grouped_reducescatter, tensors, op=op, name=name,
                   prescale_factor=prescale_factor,
                   postscale_factor=postscale_factor,
                   process_set=process_set, axis_name=axis_name)

@@ -802,31 +802,46 @@ class XlaExecutor:
 
         mesh, n, _, tag = self._batch_ctx(batch)
         inputs = self._materialize(batch, tensors)
-        out = {}
-        for name, x in zip(batch.names, inputs):
-            reduce_op = batch.reduce_op
-            prescale, postscale = batch.prescale, batch.postscale
+        reduce_op = batch.reduce_op
+        prescale, postscale = batch.prescale, batch.postscale
+        # pack the fused batch rank-major into ONE flat buffer so the
+        # whole group runs as a single collective (reference: fused
+        # responses memcpy into the fusion buffer and issue one
+        # ncclReduceScatter): chunk k of every member concatenated, so a
+        # tiled psum_scatter hands rank k exactly its chunks of every
+        # member. Single-tensor batches reduce to the plain path.
+        per_rank = [x.reshape(n, -1) for x in inputs]
+        packed = (
+            np.concatenate(per_rank, axis=1).reshape(-1)
+            if len(per_rank) > 1 else per_rank[0].reshape(-1)
+        )
 
-            def leaf(v):
-                if prescale != 1.0:
-                    v = v * jnp.asarray(prescale, dtype=v.dtype)
-                y = lax.psum_scatter(
-                    v, "proc", scatter_dimension=0, tiled=True
-                )
-                if reduce_op == _REDUCE_AVERAGE:
-                    y = (y / n).astype(v.dtype)
-                if postscale != 1.0:
-                    y = y * jnp.asarray(postscale, dtype=y.dtype)
-                return y
-
-            prog = self._program(
-                ("reducescatter", tag, x.shape, str(x.dtype), reduce_op,
-                 prescale, postscale),
-                leaf, out_spec_sharded=True, mesh=mesh,
+        def leaf(v):
+            if prescale != 1.0:
+                v = v * jnp.asarray(prescale, dtype=v.dtype)
+            y = lax.psum_scatter(
+                v, "proc", scatter_dimension=0, tiled=True
             )
-            res = self._local_shard(prog(self._global_stack(x, mesh, n)))
+            if reduce_op == _REDUCE_AVERAGE:
+                y = (y / n).astype(v.dtype)
+            if postscale != 1.0:
+                y = y * jnp.asarray(postscale, dtype=y.dtype)
+            return y
+
+        prog = self._program(
+            ("reducescatter", tag, packed.shape, str(packed.dtype),
+             reduce_op, prescale, postscale),
+            leaf, out_spec_sharded=True, mesh=mesh,
+        )
+        res = np.asarray(
+            self._local_shard(prog(self._global_stack(packed, mesh, n))))
+        out, off = {}, 0
+        for name, x in zip(batch.names, inputs):
+            m = x.size // n
             if name in tensors:
-                out[name] = res
+                out[name] = res[off:off + m].reshape(
+                    (x.shape[0] // n,) + x.shape[1:])
+            off += m
         return out
 
     def _run_allgather(self, batch, tensors):

@@ -103,21 +103,85 @@ def fuse_apply(
     return out
 
 
-def flatten_pytree_buckets(tree, threshold_bytes: int | None = None):
+def _backward_availability_order(paths) -> List[int]:
+    """Leaf ordering that approximates when backward produces each
+    gradient (earliest first):
+
+    1. head-side leaves (no layer index in the path): final norms, cls
+       heads — backward reaches them first;
+    2. numbered layers, DESCENDING (layer N's backward runs before
+       layer N-1's);
+    3. embeddings last — their gradient closes at the very end of
+       backward (the input-lookup contribution), even when a tied head
+       also feeds them early.
+
+    Ties break by reversed traversal order. A numbered name counts as a
+    layer only when its alphabetic prefix occurs with >= 2 distinct
+    indices across the tree (block_0..block_23) — Flax auto-names like
+    a single Dense_0 head carry an index without being part of a stack,
+    and sending that large earliest-ready gradient to the tail bucket
+    would invert rule 1. The reference gets this ordering for free: its
+    grad hooks fire in backward execution order (torch/optimizer.py:176)
+    and the controller negotiates in arrival order. Misplacing a small
+    leaf (e.g. a CNN stem conv) only nudges a bucket boundary; the rule
+    exists to keep LARGE late-ready leaves (embeddings) out of the
+    chain's head bucket."""
+    import re as _re
+
+    pat = _re.compile(r"([a-z_]+?)_?(\d+)")
+    infos = []
+    stacks: dict = {}  # alphabetic prefix -> set of indices seen
+    for p in paths:
+        s = jax.tree_util.keystr(p).lower()
+        m = pat.search(s)
+        infos.append((s, m))
+        if m:
+            stacks.setdefault(m.group(1), set()).add(int(m.group(2)))
+    keys = []
+    for i, (s, m) in enumerate(infos):
+        if "emb" in s:
+            keys.append((2, 0, -i))
+        elif m and len(stacks[m.group(1)]) >= 2:
+            keys.append((1, -int(m.group(2)), -i))
+        else:
+            keys.append((0, 0, -i))
+    return sorted(range(len(paths)), key=lambda i: keys[i])
+
+
+def flatten_pytree_buckets(tree, threshold_bytes: int | None = None,
+                           backward_order: bool | None = None):
     """Bucket an arbitrary pytree (e.g. a grad pytree) for fused reduction.
 
     Returns (buckets, unflatten) where `buckets` is a list of 1-D arrays
     (per-dtype, threshold-bounded) and `unflatten(reduced_buckets)` restores
     the original pytree. Used by the DistributedOptimizer gradient
     transformation (optim/distributed.py), the analog of the reference's
-    grad-hook + fusion-buffer path (torch/optimizer.py:176)."""
+    grad-hook + fusion-buffer path (torch/optimizer.py:176).
+
+    With ``backward_order`` (default: knobs.bucket_backward_order) leaves
+    are bucketed in estimated backward-availability order (last layer
+    first, embeddings last — `_backward_availability_order`), the order
+    the reference gets for free from its grad hooks firing during
+    backward. It decides which bucket the ordered-bucket chain releases
+    first and therefore how much backward compute the collectives can
+    overlap (tests/test_overlap_schedule.py)."""
     if threshold_bytes is None:
         threshold_bytes = _threshold_bytes()
+    if backward_order is None:
+        from ..core.state import global_state
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
+        backward_order = global_state().knobs.bucket_backward_order
+
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [l for _, l in paths_leaves]
+    if backward_order:
+        order = _backward_availability_order(
+            [p for p, _ in paths_leaves])
+    else:
+        order = range(len(leaves))
     by_dtype: dict = {}
-    for i, a in enumerate(leaves):
-        by_dtype.setdefault(jnp.asarray(a).dtype, []).append(i)
+    for i in order:
+        by_dtype.setdefault(jnp.asarray(leaves[i]).dtype, []).append(i)
 
     buckets = []
     plan = []  # list of (leaf_idx, offset, size, shape) per bucket

@@ -454,8 +454,16 @@ class JaxEstimator:
                         losses.append(float(l))
                     for cb in cbs:
                         cb_state = cb.on_batch_end(i, cb_state)
+                # cross-rank VALID-SAMPLE-weighted epoch loss, identical
+                # on every rank: an empty-shard rank logging a 0.0
+                # sentinel would deflate MetricAverageCallback's average
+                loss_w = (float(np.dot(losses, w_local[w_local > 0]))
+                          if losses else 0.0)
+                sums = np.asarray(hvd.allreduce(np.asarray(
+                    [loss_w, float(w_local.sum())], np.float32),
+                    op=hvd.Sum))
                 history["train_loss"].append(
-                    float(np.mean(losses)) if losses else 0.0)
+                    float(sums[0]) / max(float(sums[1]), 1e-12))
                 pred = None
                 if metric_fns and n:
                     pred = _predict_batched(apply_fn, params, xs)
@@ -607,6 +615,20 @@ class TorchEstimator:
             steps = max(1, -(-n // batch_size)) if n else 1
             steps = int(torch.max(thvd.allgather(
                 torch.tensor([steps], dtype=torch.int64))))
+            # same keep-alive weighting as the Jax estimator (ADVICE r4
+            # #3): scale each batch's loss by w_r[i]/mean_r(w[i]) so
+            # zero-filled / wrapped batches contribute identity (or
+            # proportionally down-weighted) gradients to the allreduce
+            # average instead of full-weight zero-data gradients
+            w_local = np.asarray(
+                [np.count_nonzero(
+                    np.arange(i * batch_size, (i + 1) * batch_size) < n)
+                 / batch_size for i in range(steps)], np.float32)
+            w_all = thvd.allgather(
+                torch.from_numpy(w_local[None, :])).numpy()
+            w_mean = w_all.reshape(-1, steps).mean(axis=0)
+            scale = np.where(w_mean > 0, w_local / np.maximum(
+                w_mean, 1e-12), 0.0).astype(np.float32)
             history = {"train_loss": []}
             if len(vx):
                 history["val_loss"] = []
@@ -634,12 +656,23 @@ class TorchEstimator:
                         (batch_size, ys.shape[-1]))
                     opt.zero_grad()
                     loss = loss_fn(model(bx), by)
-                    loss.backward()
+                    (loss * float(scale[i])).backward()
                     opt.step()
-                    losses.append(float(loss.detach()))
+                    if w_local[i] > 0:
+                        losses.append(float(loss.detach()))
                     for cb in cbs:
                         cb_state = cb.on_batch_end(i, cb_state)
-                history["train_loss"].append(float(np.mean(losses)))
+                # cross-rank VALID-SAMPLE-weighted epoch loss, identical
+                # on every rank: an empty-shard rank logging a 0.0
+                # sentinel would deflate MetricAverageCallback's average
+                loss_w = float(np.dot(
+                    [float(v) for v in losses] or [0.0],
+                    w_local[w_local > 0] if len(losses) else [0.0]))
+                sums = thvd.allreduce(
+                    torch.tensor([loss_w, float(w_local.sum())]),
+                    op=thvd.Sum)
+                history["train_loss"].append(
+                    float(sums[0] / max(float(sums[1]), 1e-12)))
                 def eval_batched(t):
                     # bounded chunks: metric eval must not materialize
                     # the whole shard's activations in one call

@@ -144,6 +144,39 @@ def main():
         for i in range(3)
     )
 
+    # 6b'. grouped allgather + reducescatter: one group-tagged
+    # negotiation round each (reference operations.cc:1725, :1532); the
+    # fused reducescatter batch executes as ONE packed collective
+    ag_in = [
+        np.full((2, 2), float(rank * 10 + i), dtype=np.float32)
+        for i in range(2)
+    ]
+    ag = hvd.grouped_allgather(ag_in, name="gag")
+    out["grouped_allgather_ok"] = all(
+        np.array_equal(
+            np.asarray(ag[i]),
+            np.concatenate([
+                np.full((2, 2), float(r * 10 + i), np.float32)
+                for r in range(size)
+            ]),
+        )
+        for i in range(2)
+    )
+    d0 = 2 * size
+    rs_in = [
+        np.arange(d0 * (i + 1), dtype=np.float32).reshape(
+            d0, i + 1) * (rank + 1)
+        for i in range(2)
+    ]
+    rs = hvd.grouped_reducescatter(rs_in, op=hvd.Sum, name="grs")
+    rs_ok = True
+    for i in range(2):
+        full = np.arange(d0 * (i + 1), dtype=np.float32).reshape(
+            d0, i + 1) * s_world
+        rs_ok = rs_ok and np.allclose(
+            np.asarray(rs[i]), full[rank * 2:(rank + 1) * 2])
+    out["grouped_reducescatter_ok"] = rs_ok
+
     # 6c. process-set collectives through the negotiated path: every
     # rank registers the set (synchronized, reference process_sets.py:123),
     # members run subset ops over the set's sub-mesh, non-members run a

@@ -178,6 +178,64 @@ def test_grouped_allreduce_average(hvd8):
         )
 
 
+def test_grouped_allgather_packed_single_collective(hvd8):
+    """Values match per-tensor allgather AND the group lowers to ONE
+    all-gather HLO per dtype (reference operations.cc:1725 negotiates
+    grouped allgathers as one unit; here the pack is compile-time)."""
+    xs = [per_rank_values((2, 3), jnp.float32, seed=1),
+          per_rank_values((1, 5), jnp.float32, seed=2),
+          per_rank_values((4,), jnp.float32, seed=3)]
+    mesh = hvd.mesh()
+
+    def body(ts):
+        return hvd.grouped_allgather([t[0] for t in ts])
+
+    jf = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P("hvd"), out_specs=P(),
+                  check_vma=False)
+    )
+    outs = jf(xs)
+    for x, o in zip(xs, outs):
+        flat = np.asarray(x)  # [8, ...] per-rank values
+        expect = flat.reshape((-1,) + flat.shape[2:])
+        np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-6)
+    hlo = jf.lower(xs).as_text()
+    import re
+
+    n_ag = len(re.findall(r'"all_gather|stablehlo\.all_gather', hlo))
+    assert n_ag == 1, f"expected ONE packed all-gather, found {n_ag}"
+
+
+def test_grouped_reducescatter_packed_single_collective(hvd8):
+    """Values match per-tensor reducescatter AND the group lowers to ONE
+    reduce-scatter HLO (reference operations.cc:1532)."""
+    xs = [per_rank_values((8, 2), jnp.float32, seed=1),
+          per_rank_values((16,), jnp.float32, seed=2)]
+    mesh = hvd.mesh()
+
+    def body(ts):
+        outs = hvd.grouped_reducescatter(
+            [t[0] for t in ts], op=hvd.Sum)
+        singles = [hvd.reducescatter(t[0], op=hvd.Sum) for t in ts]
+        return outs, singles
+
+    jf = jax.jit(
+        shard_map(body, mesh=mesh, in_specs=P("hvd"),
+                  out_specs=P("hvd"), check_vma=False)
+    )
+    outs, singles = jf(xs)
+    for o, s in zip(outs, singles):
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(s), rtol=1e-5)
+    hlo = jf.lower(xs).as_text()
+    import re
+
+    n_rs = len(re.findall(
+        r'"reduce_scatter|stablehlo\.reduce_scatter', hlo))
+    # one packed collective for the group + one per single reference op
+    assert n_rs == 1 + len(xs), f"expected packed group, found {n_rs}"
+
+
 # ---------------------------------------------------------------------------
 # allgather / broadcast
 # ---------------------------------------------------------------------------

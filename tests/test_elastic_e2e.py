@@ -107,15 +107,18 @@ def test_elastic_end_to_end(tmp_path):
     # recovery-time metric (VERDICT r4 #8, spirit of the reference's
     # test/integration/elastic_common.py:34): seconds from host death
     # to the first batch committed by the replacement host's worker.
-    # The bound is generous — the window includes discovery polling,
-    # rendezvous, process spawn and jax import on a 1-core box — the
-    # value's job is to be MEASURED and logged so regressions show.
+    # Measured baseline: 12.6s on this box (round 4); the bound is a
+    # band around that — the window includes discovery polling,
+    # rendezvous, process spawn and jax import on a 1-core box, so
+    # ~2.5x headroom absorbs CPU-contention noise while a regression
+    # toward the old 90s ceiling still fails (VERDICT r5 directive #9).
     death = float((tmp_path / "death_ts").read_text())
     recovery = float((tmp_path / "recovery_ts").read_text())
     recovery_s = recovery - death
-    print(f"elastic recovery time: {recovery_s:.2f}s "
-          "(host death -> first post-rendezvous commit)", flush=True)
-    assert 0.0 < recovery_s < 90.0, recovery_s
+    print(f"METRIC elastic_recovery_seconds={recovery_s:.2f} "
+          "(host death -> first post-rendezvous commit; "
+          "r4 baseline 12.6s)", flush=True)
+    assert 0.0 < recovery_s < 30.0, recovery_s
 
     # rank stability: hostA keeps rank 0 in every round it appears;
     # hostB (failed) never reappears; hostC takes the vacated rank

@@ -90,7 +90,9 @@ def test_native_eager_end_to_end(size):
     for r in range(size):
         for key in (
             "allreduce_ok", "average_ok", "allgather_ok", "broadcast_ok",
-            "reducescatter_ok", "alltoall_ok", "grouped_ok", "sparse_ok",
+            "reducescatter_ok", "alltoall_ok", "grouped_ok",
+            "grouped_allgather_ok", "grouped_reducescatter_ok",
+            "sparse_ok",
             "process_set_ok", "join_ok",
         ):
             assert out[r][key], f"rank {r}: {key} failed: {out[r]}"

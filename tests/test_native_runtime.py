@@ -467,6 +467,62 @@ def test_grouped_partial_submission_blocks_and_errors():
         assert all(s == rt_mod_FAILED for s in payload["states"]), payload
 
 
+def scenario_grouped_ag_rs_partial(native, rt, rank, size):
+    """All-or-nothing also holds for allgather and reducescatter groups
+    (reference operations.cc:1725, :1532): rank 1 withholds one member
+    of each group — nothing executes, the stall shutdown fails all."""
+    hs = [
+        rt.enqueue("agp0", native.OP_ALLGATHER, "float32", [4],
+                   group="grp-ag", group_size=2),
+        rt.enqueue("rsp0", native.OP_REDUCESCATTER, "float32", [4],
+                   group="grp-rs", group_size=2),
+    ]
+    if rank == 0:
+        hs.append(rt.enqueue("agp1", native.OP_ALLGATHER, "float32",
+                             [4], group="grp-ag", group_size=2))
+        hs.append(rt.enqueue("rsp1", native.OP_REDUCESCATTER, "float32",
+                             [4], group="grp-rs", group_size=2))
+    deadline = time.time() + 25
+    pending = set(hs)
+    while pending and time.time() < deadline:
+        b = rt.next_batch(timeout_s=0.2)
+        if b is not None:
+            rt.batch_done(b, ok=True)
+        done = {h for h in pending
+                if rt.poll(h) in (rt_mod_DONE, rt_mod_FAILED)}
+        pending -= done
+    return {"states": [rt.poll(h) for h in hs]}
+
+
+def test_grouped_allgather_reducescatter_all_or_nothing():
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker_stall,
+                    args=(r, 2, port, scenario_grouped_ag_rs_partial, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    deadline = time.time() + 60
+    while len(results) < 2 and time.time() < deadline:
+        try:
+            rank, status, payload = q.get(timeout=1.0)
+            results[rank] = (status, payload)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(timeout=5)
+        if p.is_alive():
+            p.terminate()
+    assert len(results) == 2, f"only {len(results)}/2 reported"
+    for rank, (status, payload) in results.items():
+        assert status == "ok", f"rank {rank}: {payload}"
+        assert all(s == rt_mod_FAILED for s in payload["states"]), payload
+
+
 def scenario_group_mismatch(native, rt, rank, size):
     """Same tensor, different group metadata across ranks → consistent
     negotiated error."""
