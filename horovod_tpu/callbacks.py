@@ -162,6 +162,64 @@ class LearningRateScheduleCallback(Callback):
         return float(self.multiplier(e))
 
 
+class EarlyStoppingCallback(Callback):
+    """Stop training when a monitored metric stops improving — the
+    Lightning estimator's early-stop surface (reference
+    spark/lightning/estimator.py ships pytorch_lightning's
+    EarlyStopping through its callbacks param; semantics follow
+    keras.callbacks.EarlyStopping).
+
+    Sets ``self.stop_training = True``; the estimators check the flag
+    after epoch-end callbacks and break the epoch loop on EVERY rank in
+    the same epoch (the stop verdict is OR-reduced across ranks, so
+    per-rank metric noise cannot desynchronize the collective
+    schedule). ``best`` and ``stopped_epoch`` are left on the instance
+    for inspection.
+    """
+
+    def __init__(self, monitor: str = "val_loss", min_delta: float = 0.0,
+                 patience: int = 0, mode: str = "min"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self.monitor = monitor
+        self.min_delta = abs(float(min_delta))
+        self.patience = int(patience)
+        self.mode = mode
+        self.stop_training = False
+        self.best: Optional[float] = None
+        self.stopped_epoch: Optional[int] = None
+        self._wait = 0
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_train_begin(self, state=None):
+        self.stop_training = False
+        self.best = None
+        self.stopped_epoch = None
+        self._wait = 0
+        return state
+
+    def on_epoch_end(self, epoch, logs=None, state=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return state  # metric absent this epoch: no verdict
+        value = float(value)
+        if self._improved(value):
+            self.best = value
+            self._wait = 0
+        else:
+            self._wait += 1
+            if self._wait > self.patience:
+                self.stop_training = True
+                self.stopped_epoch = epoch
+        return state
+
+
 class CommitStateCallback(Callback):
     """Commit elastic state every N batches and at epoch end
     (reference _keras/elastic.py:17 CommitStateCallbackImpl). More

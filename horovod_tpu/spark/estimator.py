@@ -299,6 +299,7 @@ class JaxEstimator:
         validation: float = 0.0,
         metrics: Optional[Dict[str, Callable]] = None,
         callbacks: Optional[Sequence] = None,
+        restore_best_weights: bool = False,
     ):
         from .store import store_or_none
 
@@ -327,8 +328,15 @@ class JaxEstimator:
         # KerasEstimator's callbacks param: on_train_begin, per-epoch
         # begin/end (epoch-end receives the epoch's logs, so
         # MetricAverageCallback averages metrics across ranks), per-batch
-        # end. They run inside every training slot.
+        # end. They run inside every training slot. EarlyStoppingCallback
+        # members end training for every rank in the same epoch.
         self.callbacks = list(callbacks or [])
+        # Lightning checkpoint_callback analog (reference
+        # spark/lightning/estimator.py): return the epoch with the best
+        # monitored loss (val_loss when a validation split exists, else
+        # train_loss) instead of the last; with a Store the persisted
+        # model is therefore the best checkpoint.
+        self.restore_best_weights = bool(restore_best_weights)
 
     def fit(self, df) -> JaxModel:
         from . import run as spark_run
@@ -349,6 +357,7 @@ class JaxEstimator:
         n_labels = len(self.label_cols)
         metric_fns = self.metrics
         cbs = self.callbacks
+        restore_best = self.restore_best_weights
 
         def train():
             import os
@@ -429,6 +438,7 @@ class JaxEstimator:
                 if len(vx):
                     history[f"val_{mname}"] = []
             cb_state = None
+            best_val = best_params = best_epoch = stopped_epoch = None
             for cb in cbs:
                 cb_state = cb.on_train_begin(cb_state)
             for epoch in range(epochs):
@@ -490,11 +500,38 @@ class JaxEstimator:
                             series[-1] = v
                         else:
                             series.append(v)
+                # best-epoch tracking (Lightning checkpoint_callback
+                # analog, spark/lightning/estimator.py): monitor
+                # val_loss when a split exists, else the (cross-rank
+                # weighted, rank-identical) train_loss
+                monitor = "val_loss" if history.get("val_loss") else \
+                    "train_loss"
+                mval = history[monitor][-1]
+                if best_val is None or mval < best_val:
+                    best_val, best_epoch = mval, epoch
+                    if restore_best and rank == 0:
+                        best_params = jax.tree_util.tree_map(
+                            np.asarray, params)
+                # early stop: OR-reduce the callbacks' verdicts so every
+                # rank leaves the collective schedule in the SAME epoch
+                # (a per-rank break would deadlock the next allreduce)
+                want_stop = any(
+                    bool(getattr(cb, "stop_training", False))
+                    for cb in cbs)
+                agreed = np.asarray(hvd.allreduce(np.asarray(
+                    [1.0 if want_stop else 0.0], np.float32),
+                    op=hvd.Sum))
+                if float(agreed[0]) > 0:
+                    stopped_epoch = epoch
+                    break
             hvd.shutdown()
             out = {"rank": rank, "rows_touched": int(touched),
-                   "history": history}
+                   "history": history, "best_epoch": best_epoch,
+                   "stopped_epoch": stopped_epoch}
             if rank == 0:
-                out["params"] = jax.tree_util.tree_map(np.asarray, params)
+                out["params"] = (
+                    best_params if restore_best and best_params is not None
+                    else jax.tree_util.tree_map(np.asarray, params))
             return out
 
         results = spark_run(train, num_proc=self.num_proc,
@@ -503,7 +540,12 @@ class JaxEstimator:
         trained = root["params"]
         jm = JaxModel(trained, apply_fn, self.feature_cols,
                       self.output_col,
-                      metadata={"epochs": self.epochs},
+                      metadata={"epochs": self.epochs,
+                                "best_epoch": root.get("best_epoch"),
+                                "stopped_epoch": root.get(
+                                    "stopped_epoch"),
+                                "restored_best": bool(
+                                    self.restore_best_weights)},
                       optimizer_spec=self.optimizer_spec,
                       history=root["history"])
         jm.rows_touched_per_rank = {
@@ -545,6 +587,7 @@ class TorchEstimator:
         validation: float = 0.0,
         metrics: Optional[Dict[str, Callable]] = None,
         callbacks: Optional[Sequence] = None,
+        restore_best_weights: bool = False,
     ):
         from .store import store_or_none
 
@@ -565,6 +608,8 @@ class TorchEstimator:
         self.metrics = dict(metrics or {})
         # same contract as JaxEstimator.callbacks (runs in every slot)
         self.callbacks = list(callbacks or [])
+        # Lightning checkpoint_callback analog: see JaxEstimator
+        self.restore_best_weights = bool(restore_best_weights)
 
     def fit(self, df) -> "TorchModel":
         import torch
@@ -587,6 +632,7 @@ class TorchEstimator:
         n_labels = len(self.label_cols)
         metric_fns = self.metrics
         cbs = self.callbacks
+        restore_best = self.restore_best_weights
 
         def train():
             import os
@@ -637,6 +683,7 @@ class TorchEstimator:
                 if len(vx):
                     history[f"val_{mname}"] = []
             cb_state = None
+            best_val = best_params = best_epoch = stopped_epoch = None
             for cb in cbs:
                 cb_state = cb.on_train_begin(cb_state)
             for epoch in range(epochs):
@@ -704,14 +751,39 @@ class TorchEstimator:
                             series[-1] = v
                         else:
                             series.append(v)
+                # best-epoch tracking + OR-reduced early stop — same
+                # semantics as JaxEstimator (Lightning analog)
+                monitor = "val_loss" if history.get("val_loss") else \
+                    "train_loss"
+                mval = history[monitor][-1]
+                if best_val is None or mval < best_val:
+                    best_val, best_epoch = mval, epoch
+                    if restore_best and rank == 0:
+                        best_params = {
+                            k: v.detach().cpu().numpy().copy()
+                            for k, v in model.state_dict().items()
+                        }
+                want_stop = any(
+                    bool(getattr(cb, "stop_training", False))
+                    for cb in cbs)
+                agreed = thvd.allreduce(
+                    torch.tensor([1.0 if want_stop else 0.0]),
+                    op=thvd.Sum)
+                if float(agreed[0]) > 0:
+                    stopped_epoch = epoch
+                    break
             thvd.shutdown()
             out = {"rank": rank, "rows_touched": int(touched),
-                   "history": history}
+                   "history": history, "best_epoch": best_epoch,
+                   "stopped_epoch": stopped_epoch}
             if rank == 0:
-                out["params"] = {
-                    k: v.detach().cpu().numpy()
-                    for k, v in model.state_dict().items()
-                }
+                out["params"] = (
+                    best_params
+                    if restore_best and best_params is not None
+                    else {
+                        k: v.detach().cpu().numpy()
+                        for k, v in model.state_dict().items()
+                    })
             return out
 
         results = spark_run(train, num_proc=self.num_proc,
@@ -721,6 +793,8 @@ class TorchEstimator:
         tm = TorchModel(model, trained, self.feature_cols,
                         self.output_col)
         tm.history = root["history"]
+        tm.best_epoch = root.get("best_epoch")
+        tm.stopped_epoch = root.get("stopped_epoch")
         tm.rows_touched_per_rank = {
             r["rank"]: r["rows_touched"] for r in results if r}
         if self.store is not None:
