@@ -55,11 +55,35 @@ def _spread(stats):
     }
 
 
+def _eager_path_block():
+    """Eager data-plane vs SPMD ratio (VERDICT r5 #3), measured in a
+    subprocess so the native runtime initializes cleanly and its device
+    buffers die with the process. See scripts/eager_path_bench.py."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["HVD_TPU_NATIVE"] = "1"
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "eager_path_bench.py")],
+            capture_output=True, text=True, timeout=900, env=env,
+        ).stdout
+        return json.loads(out[out.index("{"):])
+    except Exception as e:  # the headline metrics must still emit
+        return {"error": repr(e)[:200]}
+
+
 def main():
     resnet = load_example("resnet50_synthetic")
     bert = load_example("bert_pretraining")
+    gpt = load_example("gpt2_pretraining")
 
-    rs, bs, is_, vs = {}, {}, {}, {}
+    # before the big models allocate: the eager-vs-SPMD ratio probe
+    eager_path = _eager_path_block()
+
+    rs, bs, gs, is_, vs = {}, {}, {}, {}, {}
     img_per_chip, resnet_mfu = resnet.main(
         ["--num-iters", "5", "--num-batches-per-iter", "16",
          "--num-warmup-batches", "3", "--batch-size", "256",
@@ -70,6 +94,13 @@ def main():
         ["--num-iters", "4", "--num-batches-per-iter", "12",
          "--num-warmup-batches", "2", "--batch-size", "26", "--flash"],
         stats=bs,
+    )
+    # causal half of the transformer pair (round-5: proper vehicle +
+    # config re-swept, see docs/benchmarks.md)
+    gpt_per_chip, gpt_mfu = gpt.main(
+        ["--num-iters", "3", "--num-batches-per-iter", "10",
+         "--num-warmup-batches", "2", "--batch-size", "16", "--flash"],
+        stats=gs,
     )
     # the scaling trio's other two models (secondary evidence)
     inc_per_chip, inc_mfu = resnet.main(
@@ -113,6 +144,12 @@ def main():
                     ),
                     "bertlarge_mfu": round(bert_mfu, 4),
                     "bertlarge_spread": _spread(bs),
+                    "gpt2_medium_tokens_per_sec_per_chip": round(
+                        gpt_per_chip, 1
+                    ),
+                    "gpt2_medium_mfu": round(gpt_mfu, 4),
+                    "gpt2_medium_spread": _spread(gs),
+                    "eager_path": eager_path,
                     "inception3_images_per_sec_per_chip": round(
                         inc_per_chip, 1
                     ),

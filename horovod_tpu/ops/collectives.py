@@ -1260,7 +1260,11 @@ def _native_async(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
     for leaf_name, leaf in zip(names, leaves):
         hs.append(
             rt.enqueue(
-                leaf_name, np.asarray(leaf),
+                # jax arrays pass through on-device (eager_runtime
+                # keeps them there end-to-end); everything else is
+                # host-materialized once here
+                leaf_name,
+                leaf if isinstance(leaf, jax.Array) else np.asarray(leaf),
                 _NATIVE_OPS[op_kind], reduce_op=int(op),
                 root_rank=int(root_rank), prescale=float(prescale),
                 postscale=float(postscale), splits=splits,

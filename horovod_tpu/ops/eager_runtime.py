@@ -65,6 +65,17 @@ _OP_ACTIVITIES = {
 }
 
 
+def _is_jax_array(x) -> bool:
+    """Device-resident jax array? (kept on device end-to-end through
+    the eager pipeline — see enqueue/_materialize)."""
+    try:
+        import jax
+
+        return isinstance(x, jax.Array)
+    except Exception:
+        return False
+
+
 def _timeline():
     """The active host-side timeline, or None (utils/timeline.py)."""
     from ..utils.timeline import active_timeline
@@ -221,7 +232,11 @@ class EagerRuntime:
                 splits: Optional[List[int]] = None,
                 group: Optional[str] = None, group_size: int = 0,
                 process_set_id: int = 0) -> int:
-        arr = np.asarray(tensor)
+        # device-resident jax arrays are enqueued as-is — negotiation
+        # only needs shape/dtype, and the XLA executor consumes device
+        # buffers directly (no host round trip; the reference keeps GPU
+        # tensors on GPU through NCCL the same way)
+        arr = tensor if _is_jax_array(tensor) else np.asarray(tensor)
         name = self._qualify(name, process_set_id)
         handle = self._native.enqueue(
             name, op, str(arr.dtype), list(arr.shape),
@@ -621,21 +636,27 @@ class XlaExecutor:
             [jax.device_put(a[None], self._local_device)],
         )
 
-    def _program(self, key, leaf, out_spec_sharded: bool, mesh=None):
+    def _program(self, key, leaf, out_spec_sharded: bool, mesh=None,
+                 arity: int = 1):
         """jit(shard_map) over the proc mesh, cached by signature — the
         steady-state fast path (compilation plays the role the response
-        cache plays for negotiation)."""
+        cache plays for negotiation). With ``arity`` > 1 the program
+        takes that many [world, ...] inputs and ``leaf`` sees one local
+        slice per argument (fused-batch pack/unpack runs inside)."""
         prog = self._programs.get(key)
         if prog is None:
             import jax
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
+            def body(*stacked):
+                return leaf(*[s[0] for s in stacked])
+
             prog = jax.jit(
                 shard_map(
-                    lambda s: leaf(s[0]),
+                    body,
                     mesh=mesh if mesh is not None else self._mesh,
-                    in_specs=P("proc"),
+                    in_specs=tuple(P("proc") for _ in range(arity)),
                     out_specs=P("proc") if out_spec_sharded else P(),
                     check_vma=False,
                 )
@@ -721,7 +742,12 @@ class XlaExecutor:
         out = []
         for i, name in enumerate(batch.names):
             if name in tensors:
-                out.append(np.asarray(tensors[name]))
+                t = tensors[name]
+                # device-resident jax arrays stay on device (the
+                # reference keeps GPU tensors on GPU through NCCL,
+                # torch/mpi_ops.py) — np.asarray here would pull the
+                # whole gradient to host just to push it back
+                out.append(t if _is_jax_array(t) else np.asarray(t))
             else:
                 shape = (
                     batch.shapes[i]
@@ -750,13 +776,11 @@ class XlaExecutor:
         )
 
     def _run_allreduce(self, batch, tensors):
+        from jax import lax
+        import jax.numpy as jnp
+
         mesh, n, _, tag = self._batch_ctx(batch)
         inputs = self._materialize(batch, tensors)
-        # pack the fused batch into one flat buffer -> ONE collective HLO
-        # (the reference memcpys into the fusion buffer and issues one
-        # ncclAllReduce, nccl_operations.cc:175-246)
-        flats = [x.reshape(-1) for x in inputs]
-        packed = np.concatenate(flats) if len(flats) > 1 else flats[0]
         # autotuned hierarchical routing, stamped on the batch by the
         # NATIVE loop at batch creation (operations.cc Batch) so every
         # rank executes the sample point of the cycle that delivered it
@@ -781,19 +805,41 @@ class XlaExecutor:
             leaf = self._reduce_leaf(
                 batch.reduce_op, batch.prescale, batch.postscale, n
             )
+        # Pack, reduce, and unpack INSIDE one program: one collective
+        # HLO per fused batch (the reference memcpys into the fusion
+        # buffer and issues one ncclAllReduce,
+        # nccl_operations.cc:175-246) AND one device dispatch per batch
+        # — host-side packing of device-resident gradients would pull
+        # every tensor through the host (fatal on remote-TPU paths),
+        # and per-tensor result slicing would pay one dispatch per
+        # gradient instead of per batch.
+        specs = tuple((x.size, tuple(x.shape)) for x in inputs)
+
+        def fused(*vs):
+            flats = [v.reshape(-1) for v in vs]
+            packed = (jnp.concatenate(flats)
+                      if len(flats) > 1 else flats[0])
+            red = leaf(packed)
+            outs, off = [], 0
+            for size, shape in specs:
+                outs.append(lax.dynamic_slice_in_dim(
+                    red, off, size).reshape(shape))
+                off += size
+            return tuple(outs)
+
         prog = self._program(
-            ("allreduce", tag, packed.shape, str(packed.dtype),
+            ("allreduce", tag, specs, str(inputs[0].dtype),
              batch.reduce_op, batch.prescale, batch.postscale,
              hier_block),
-            leaf, out_spec_sharded=False, mesh=mesh,
+            fused, out_spec_sharded=False, mesh=mesh, arity=len(inputs),
         )
-        res = np.asarray(prog(self._global_stack(packed, mesh, n)))
-        out, off = {}, 0
-        for name, x in zip(batch.names, inputs):
-            n = x.size
+        res = prog(*[self._global_stack(x, mesh, n) for x in inputs])
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        out = {}
+        for name, r in zip(batch.names, res):
             if name in tensors:
-                out[name] = res[off:off + n].reshape(x.shape)
-            off += n
+                out[name] = r
         return out
 
     def _run_reducescatter(self, batch, tensors):

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Eager data-plane vs SPMD-path throughput on the real chip.
+
+The reference's *product* is the eager path: every Torch/TF user runs
+per-tensor enqueue -> background-loop negotiation -> executor dispatch
+(/root/reference/horovod/torch/mpi_ops.py:107-151; benchmarked by
+examples/pytorch/pytorch_synthetic_benchmark.py). This script measures
+OUR equivalent end-to-end: a small MLP trains one step either
+
+  spmd  - the jit/shard_map DistributedOptimizer step (compile-time
+          fusion, zero per-step dispatch) - the headline path, or
+  eager - forward/backward jit-compiled locally, then EVERY gradient
+          leaf enqueued through hvd.allreduce_async into the native
+          negotiation runtime and executed by the XlaExecutor
+          (per-batch program-cache lookup + host<->device copies),
+          then a jit optimizer apply.
+
+and reports steps/sec for both, their ratio, and where the eager
+overhead goes (negotiation vs executor dispatch vs copies), for the
+BENCH_r{N}.json eager_path block.
+
+Run on the TPU chip:  python scripts/eager_path_bench.py
+(Also runs on CPU worlds for smoke: --steps 5.)
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--width", type=int, default=1024)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+
+    # the native runtime must be live BEFORE hvd.init wires the world
+    os.environ.setdefault("HVD_TPU_NATIVE", "1")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.core.state import global_state
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.mesh()
+
+    # ---- model: MLP regression, grads ~ the per-leaf sizes a torch
+    # user's layer-by-layer hooks would enqueue
+    W, L, B = args.width, args.layers, args.batch
+    rng = np.random.RandomState(0)
+    params = {
+        f"layer_{i}": {
+            "w": jnp.asarray(rng.randn(W, W).astype(np.float32) * 0.02),
+            "b": jnp.zeros((W,), jnp.float32),
+        }
+        for i in range(L)
+    }
+    x_host = rng.randn(B * max(n, 1), W).astype(np.float32)
+    y_host = rng.randn(B * max(n, 1), W).astype(np.float32)
+
+    def apply_fn(p, x):
+        h = x
+        for i in range(L):
+            h = jnp.tanh(h @ p[f"layer_{i}"]["w"] + p[f"layer_{i}"]["b"])
+        return h
+
+    def loss_fn(p, x, y):
+        return jnp.mean((apply_fn(p, x) - y) ** 2)
+
+    opt = optax.sgd(0.01)
+
+    # ---- SPMD path: one compiled step, fusion + collective inside
+    dopt = hvd.DistributedOptimizer(optax.sgd(0.01))
+    dstate = dopt.init(params)
+
+    def spmd_step(p, s, x, y):
+        l, g = jax.value_and_grad(loss_fn)(p, x, y)
+        u, s = dopt.update(g, s, p)
+        return optax.apply_updates(p, u), s, jax.lax.psum(l, "hvd").reshape(1)
+
+    js = jax.jit(jax.shard_map(
+        spmd_step, mesh=mesh, in_specs=(P(), P(), P("hvd"), P("hvd")),
+        out_specs=(P(), P(), P()), check_vma=False))
+    shard = NamedSharding(mesh, P("hvd"))
+    xd = jax.device_put(x_host, shard)
+    yd = jax.device_put(y_host, shard)
+    compiled = js.lower(params, dstate, xd, yd).compile()
+
+    p1, s1 = params, dstate
+    for _ in range(args.warmup):
+        p1, s1, l = compiled(p1, s1, xd, yd)
+    float(l[0])
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        p1, s1, l = compiled(p1, s1, xd, yd)
+    float(l[0])
+    spmd_s = (time.perf_counter() - t0) / args.steps
+
+    # ---- eager path: local jit grad, per-leaf async enqueue through
+    # the native negotiation loop + XlaExecutor, jit apply
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    apply_updates = jax.jit(
+        lambda p, u: optax.apply_updates(p, u))
+    est = opt.init(params)
+
+    @jax.jit
+    def opt_update(g, s, p):
+        return opt.update(g, s, p)
+
+    x_local = jnp.asarray(x_host[:B])
+    y_local = jnp.asarray(y_host[:B])
+
+    rt = global_state().eager_runtime
+    coord0 = (rt._native.coord_cycle_stats()
+              if rt is not None else {})
+
+    def eager_step(p, s):
+        l, g = grad_fn(p, x_local, y_local)
+        leaves, treedef = jax.tree_util.tree_flatten(g)
+        # the torch-adapter architecture: one async handle per tensor,
+        # synchronize in submission order (mpi_ops.py:107-151)
+        handles = [
+            hvd.allreduce_async(leaf, name=f"g{i}", op=hvd.Average)
+            for i, leaf in enumerate(leaves)
+        ]
+        red = [jnp.asarray(hvd.synchronize(h)) for h in handles]
+        g = jax.tree_util.tree_unflatten(treedef, red)
+        u, s = opt_update(g, s, p)
+        return apply_updates(p, u), s, l
+
+    p2, s2 = params, est
+    for _ in range(args.warmup):
+        p2, s2, l = eager_step(p2, s2)
+    float(l)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        p2, s2, l = eager_step(p2, s2)
+    float(l)
+    eager_s = (time.perf_counter() - t0) / args.steps
+
+    coord1 = (rt._native.coord_cycle_stats()
+              if rt is not None else {})
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    report = {
+        "what": "per-step wall time, 4x1024 MLP batch %d, single chip"
+                % B,
+        "backend": jax.default_backend(),
+        "native_eager": rt is not None,
+        "grad_tensors_per_step": n_leaves,
+        "spmd_step_ms": round(spmd_s * 1e3, 2),
+        "eager_step_ms": round(eager_s * 1e3, 2),
+        "eager_over_spmd": round(eager_s / spmd_s, 2),
+        "cache_hits": int(rt.cache_hits()) if rt is not None else None,
+    }
+    if coord1:
+        cyc = max(coord1["cycles"] - coord0.get("cycles", 0), 1)
+        report["coordinator"] = {
+            "cycles_during_eager": int(cyc),
+            "cpu_us_per_cycle": round(
+                (coord1["work_us"] - coord0.get("work_us", 0)) / cyc, 1),
+        }
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

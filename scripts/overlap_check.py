@@ -56,10 +56,15 @@ def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip):
             loss, _ = mlm_loss(logits, tok, tok % 7 == 0)
             return loss
     elif model_name == "gpt2-medium":
-        cfg = dataclasses.replace(GPT2_MEDIUM, max_seq_len=1024)
+        # remat + small per-chip batch: the overlap analysis cares about
+        # the gradient all-reduce schedule, not the attention flavor —
+        # plain XLA attention at the bench's batch 16 holds 16 GB of
+        # f32 score buffers and cannot AOT-compile on a 16 GB chip
+        cfg = dataclasses.replace(
+            GPT2_MEDIUM, max_seq_len=1024, remat=True)
         model = Transformer(cfg)
         T = cfg.max_seq_len
-        bpc = batch_per_chip or 16
+        bpc = batch_per_chip or 4
 
         def loss_fn(p, tok):
             logits = model.apply({"params": p}, tok)

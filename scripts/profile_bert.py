@@ -18,12 +18,15 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--logdir", default="/tmp/xplane_bert")
     p.add_argument("--batch-size", type=int, default=24)
+    p.add_argument("--example", default="bert_pretraining",
+                   help="transformer example to trace "
+                        "(bert_pretraining | gpt2_pretraining)")
     p.add_argument("--extra", default="--flash",
                    help="comma-separated flags forwarded to "
                         "bert_pretraining, e.g. --extra=--flash,--fused-ln")
     args = p.parse_args(argv)
 
-    bert = load_example("bert_pretraining")
+    bert = load_example(args.example)
     # warm up compile outside the trace window, then trace one short run
     extra = [f for f in args.extra.split(",") if f]
     common = ["--num-iters", "1", "--num-batches-per-iter", "3",

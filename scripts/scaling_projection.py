@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Analytic 8→256-chip scaling projection → SCALING_PROJECTION_r{N}.json.
+
+Real multi-chip runs cannot happen in this environment (one v5e chip
+behind a tunnel), but every input of a roofline projection is measured:
+single-chip step time (bench.py), gradient bytes per step (the fusion
+buckets reduce the whole grad pytree once per step), the all-reduce's
+structural overlap window (scripts/overlap_check.py → OVERLAP_r05.json),
+and the public v5e interconnect numbers. This artifact writes the
+formula and all inputs down so a real pod run can falsify it — the
+claim structure of the reference's published scaling table
+(/root/reference/docs/benchmarks.rst:8-13: 90% scaling for Inception/
+ResNet-101/VGG at 512 GPUs; BASELINE.json target ≥90% @ 256).
+
+Model: synchronous data parallelism, ring/torus all-reduce over ICI.
+
+  t_comm(N)   = 2 * (N-1)/N * G / (L * B_ici)     [bidirectional torus
+                rings over L links of B_ici each; standard ring-AR cost]
+  t_exposed   = t_comm * (1 - overlap)            [overlap = fraction of
+                the all-reduce hideable behind backward compute]
+  eff(N)      = t_step / (t_step + t_exposed)
+
+v5e public interconnect: 1600 Gbps aggregate ICI per chip = 4 links x
+50 GB/s per direction (2D torus); a 16x16 slice is all-ICI (no DCN hop),
+so the 256-chip BASELINE point never leaves the torus.
+
+Usage: python scripts/scaling_projection.py [--out SCALING_PROJECTION_r05.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# v5e ICI: 4 links/chip (2D torus), ~50 GB/s per direction per link
+ICI_LINKS = 4
+ICI_GBPS_PER_LINK = 50e9
+
+MODELS = {
+    # params from the bench vehicles (fp32 master grads on the wire)
+    "resnet50": {
+        "params": 25.6e6,
+        "batch_per_chip": 256,
+        "rate_key": "resnet50_synthetic_images_per_sec_per_chip",
+        "rate_is_top": True,
+    },
+    "bert-large": {
+        "params": 334e6,
+        "batch_tokens_per_chip": 26 * 512,
+        "rate_key": "bertlarge_pretrain_tokens_per_sec_per_chip",
+        "rate_is_top": False,
+    },
+}
+
+
+def project(step_s, grad_bytes, overlap, n):
+    t_comm = 2 * (n - 1) / n * grad_bytes / (ICI_LINKS * ICI_GBPS_PER_LINK)
+    t_exposed = t_comm * (1.0 - overlap)
+    return {
+        "chips": n,
+        "t_comm_ms": round(t_comm * 1e3, 3),
+        "t_exposed_ms": round(t_exposed * 1e3, 3),
+        "efficiency": round(step_s / (step_s + t_exposed), 4),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", default="",
+                    help="BENCH_r*.json to read rates from (default: "
+                         "newest in repo root)")
+    ap.add_argument("--overlap", default="OVERLAP_r05.json",
+                    help="overlap artifact for the hideable fraction")
+    ap.add_argument("--out", default="SCALING_PROJECTION_r05.json")
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench_path = args.bench
+    if not bench_path:
+        cands = sorted(
+            f for f in os.listdir(root)
+            if f.startswith("BENCH_r") and f.endswith(".json"))
+        bench_path = os.path.join(root, cands[-1])
+    with open(bench_path) as f:
+        doc = json.load(f)
+    # the driver's BENCH file wraps the bench.py line in a "tail" field
+    if "tail" in doc:
+        line = next(l for l in doc["tail"].splitlines()
+                    if l.startswith('{"metric"'))
+        bench = json.loads(line)
+    else:
+        bench = doc
+    extra = bench.get("extra_metrics", bench)
+
+    overlap_frac = 0.0
+    overlap_src = "none (conservative: fully exposed all-reduce)"
+    op = os.path.join(root, args.overlap)
+    if os.path.exists(op):
+        with open(op) as f:
+            ov = json.load(f)
+        rows = ov if isinstance(ov, list) else [ov]
+        # structural bound from the headline BERT config; the schedule
+        # fraction is this build's lower bound. Use the SCHEDULED
+        # fraction (what the compiler provably does), not the
+        # structural one — conservative by construction.
+        for r in rows:
+            if r.get("model") == "bert-large":
+                overlap_frac = float(r.get("overlap_window_frac", 0.0))
+                overlap_src = (
+                    f"{args.overlap}: scheduled window "
+                    f"{overlap_frac} (structural bound "
+                    f"{r.get('overlappable_frac')})")
+                break
+
+    out = {
+        "what": "analytic DP scaling projection over the v5e 2D torus "
+                "(all-ICI at 16x16 = 256 chips; no DCN hop)",
+        "formula": "eff(N) = t_step / (t_step + (1-overlap) * "
+                   "2*(N-1)/N * G / (links*B_ici))",
+        "inputs": {
+            "ici_links": ICI_LINKS,
+            "ici_bytes_per_sec_per_link": ICI_GBPS_PER_LINK,
+            "bench_source": os.path.basename(bench_path),
+            "overlap_source": overlap_src,
+            "wire_dtype": "float32 (no compression; bf16 wire would "
+                          "halve G)",
+        },
+        "models": {},
+        "falsifiable_by": "a real v5e pod run of bench.py vehicles at "
+                          "8/32/64/256 chips; every input above is "
+                          "independently re-measurable",
+        "reference_claim": "docs/benchmarks.rst:8-13 (90% scaling, 512 "
+                           "GPUs); BASELINE target >=90% at 256 chips",
+    }
+
+    # resnet50
+    rate = float(bench["value"]) if MODELS["resnet50"]["rate_is_top"] \
+        else float(extra[MODELS["resnet50"]["rate_key"]])
+    step_s = MODELS["resnet50"]["batch_per_chip"] / rate
+    g = MODELS["resnet50"]["params"] * 4
+    out["models"]["resnet50"] = {
+        "step_ms_per_chip": round(step_s * 1e3, 2),
+        "grad_bytes": int(g),
+        "projection": [project(step_s, g, overlap_frac, n)
+                       for n in (8, 32, 64, 256)],
+    }
+
+    # bert-large
+    rate = float(extra[MODELS["bert-large"]["rate_key"]])
+    step_s = MODELS["bert-large"]["batch_tokens_per_chip"] / rate
+    g = MODELS["bert-large"]["params"] * 4
+    out["models"]["bert-large"] = {
+        "step_ms_per_chip": round(step_s * 1e3, 2),
+        "grad_bytes": int(g),
+        "projection": [project(step_s, g, overlap_frac, n)
+                       for n in (8, 32, 64, 256)],
+    }
+
+    txt = json.dumps(out, indent=1)
+    print(txt)
+    with open(os.path.join(root, args.out), "w") as f:
+        f.write(txt + "\n")
+
+
+if __name__ == "__main__":
+    main()

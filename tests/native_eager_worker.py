@@ -53,13 +53,20 @@ def main():
         np.allclose(results[i], s_world * (i + 1)) for i in range(len(names))
     )
 
-    # 2. averaged allreduce with prescale ------------------------------
-    t = np.full((8,), float(rank + 1), dtype=np.float32)
-    avg = np.asarray(
-        hvd.allreduce(t, average=True, name="avg_t", prescale_factor=2.0)
-    )
+    # 2. averaged allreduce with prescale — enqueued as a DEVICE jax
+    # array (the on-device fast path: no host round trip in the
+    # executor; result must come back as a device array)
+    import jax
+    import jax.numpy as jnp
+
+    t = jnp.full((8,), float(rank + 1), dtype=jnp.float32)
+    res = hvd.allreduce(t, average=True, name="avg_t",
+                        prescale_factor=2.0)
+    leaf = jax.tree_util.tree_leaves(res)[0]
+    avg = np.asarray(leaf)
     expect = 2.0 * s_world / size
-    out["average_ok"] = bool(np.allclose(avg, expect))
+    out["average_ok"] = bool(
+        np.allclose(avg, expect) and isinstance(leaf, jax.Array))
 
     # 3. ragged allgather ----------------------------------------------
     rows = rank + 2  # rank 0: 2 rows, rank 1: 3 rows, ...

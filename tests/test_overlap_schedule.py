@@ -22,6 +22,7 @@ property, asserted here at two levels:
    overlap_check.py writes the same analysis to OVERLAP_r04.json.
 """
 
+import pathlib
 import re
 
 import jax
@@ -30,6 +31,8 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import PartitionSpec as P
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 import horovod_tpu as hvd
 from horovod_tpu.models import Transformer
@@ -103,52 +106,42 @@ def _tpu_topology_mesh():
     return topologies.make_mesh(t, (8,), ("hvd",))
 
 
-def test_tpu_schedule_interleaves_bucket_collectives_with_backward():
-    """Level 2 (TPU AOT): the optimized schedule (is_scheduled=true, so
-    instruction order == execution order) issues the first bucket's
-    all-reduce strictly before the last backward op."""
+def test_tpu_schedule_overlap_window_on_real_bert():
+    """Level 2 (TPU AOT, REAL model): the BERT-Large train step at the
+    default 128MB fusion threshold with backward-availability bucket
+    ordering must satisfy, in the optimized v5e schedule
+    (is_scheduled=true → instruction order == execution order):
+
+    - >= 25% of backward compute is scheduled AFTER the first gradient
+      all-reduce issues (the VERDICT r5 #1 floor; measured 25.6%), and
+    - >= 85% of backward compute is structurally independent of the
+      first all-reduce (overlappable_frac; measured 90.8%) — the
+      schedule-independent property backward-order bucketing buys,
+      which the reference gets from grad hooks firing in backward
+      order (controller.cc:830's reason to exist).
+
+    scripts/overlap_check.py writes the same analysis for BERT-L and
+    GPT-2 at v5e:2x4 and 16x16 into OVERLAP_r05.json.
+    """
     try:
         mesh = _tpu_topology_mesh()
     except Exception as e:  # no TPU client in this environment
         pytest.skip(f"TPU AOT topology unavailable: {e}")
+    import sys
+
+    sys.path.insert(0, str(_REPO_ROOT))
+    from scripts.overlap_check import analyze, build_step
+
     hvd.shutdown()
     hvd.init(mesh=mesh)
     try:
-        m = Transformer(CFG)
-        toks_s = jax.ShapeDtypeStruct((16, CFG.max_seq_len), jnp.int32)
-        params = jax.eval_shape(
-            lambda: m.init(jax.random.PRNGKey(0),
-                           jnp.ones((2, CFG.max_seq_len), jnp.int32)))
-        opt = hvd.DistributedOptimizer(
-            optax.sgd(0.1), fusion_threshold_bytes=4 << 20)
-        state = jax.eval_shape(lambda: opt.init(jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), params)))
-
-        def step(p, s, b):
-            def loss_fn(p):
-                logits = m.apply(p, b)
-                return jnp.mean((logits.astype(jnp.float32) - 1.0) ** 2)
-
-            l, g = jax.value_and_grad(loss_fn)(p)
-            upd, s = opt.update(g, s, p)
-            return optax.apply_updates(p, upd), s, jax.lax.psum(
-                l, "hvd").reshape(1)
-
-        js = jax.jit(jax.shard_map(
-            step, mesh=mesh, in_specs=(P(), P(), P("hvd")),
-            out_specs=(P(), P(), P()), check_vma=False))
+        js, params, state, toks_s = build_step(
+            "bert-large", mesh, 8, 128, 0)
         txt = js.lower(params, state, toks_s).compile().as_text()
     finally:
         hvd.shutdown()
-    assert "is_scheduled=true" in txt
-    lines = txt.splitlines()
-    ars = [i for i, l in enumerate(lines)
-           if re.search(r' all-reduce(-start)?\(', l)]
-    bwd = [i for i, l in enumerate(lines)
-           if "op_name=" in l and "transpose" in l
-           and re.search(r' (dot|fusion|convolution|custom-call)\(', l)]
-    assert len(ars) >= 2, f"combiner merged the buckets: {len(ars)}"
-    assert bwd, "no backward ops identified"
-    assert ars[0] < bwd[-1], (
-        f"first all-reduce (line {ars[0]}) scheduled after the whole "
-        f"backward pass (last bwd line {bwd[-1]}) — no overlap possible")
+    a = analyze(txt)
+    assert a["scheduled"]
+    assert a["bucket_all_reduces_in_optimized_hlo"] >= 2, a
+    assert a["overlap_window_frac"] >= 0.25, a
+    assert a["overlappable_frac"] >= 0.85, a
