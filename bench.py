@@ -99,7 +99,8 @@ def main():
     # config re-swept, see docs/benchmarks.md)
     gpt_per_chip, gpt_mfu = gpt.main(
         ["--num-iters", "3", "--num-batches-per-iter", "10",
-         "--num-warmup-batches", "2", "--batch-size", "16", "--flash"],
+         "--num-warmup-batches", "2", "--batch-size", "16", "--flash",
+         "--fused-ce"],
         stats=gs,
     )
     # the scaling trio's other two models (secondary evidence)

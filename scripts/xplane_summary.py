@@ -47,6 +47,12 @@ def summarize_plane(plane, top):
         lname = line.name or line.display_name
         if "XLA Ops" not in lname and "Ops" != lname:
             continue
+        if "Async" in lname:
+            # 'Async XLA Ops' = overlapped DMA (slices/copies); its spans
+            # run CONCURRENTLY with the sync 'XLA Ops' timeline, so
+            # counting them both double-books the device and buries the
+            # compute categories under %copy/%slice
+            continue
         for ev in line.events:
             md = evmeta.get(ev.metadata_id)
             name = md.name if md else str(ev.metadata_id)
