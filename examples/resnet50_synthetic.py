@@ -162,8 +162,17 @@ def main(argv=None, stats=None):
     # AOT-compile and call the executable directly: same program, but
     # the per-call jit dispatch costs ~5-8% through remote-TPU paths
     # (measured with scripts/xla_options_sweep.py; on local TPU both
-    # paths are equally fast)
-    step = step.lower(params, batch_stats, opt_state, xs, ys).compile()
+    # paths are equally fast). Inception's conv+BN mega-fusions are
+    # VMEM-pressure-sensitive: xla_tpu_scoped_vmem_limit_kib=65536 is
+    # +3.7% at batch 256 and 2.9x at batch 192 (the r4 cliff was two
+    # mis-tiled 35x35x64 fusions at 119ms/step each, docs/benchmarks.md);
+    # ResNet measures WORSE with it, so the bump is per-model.
+    lowered = step.lower(params, batch_stats, opt_state, xs, ys)
+    if jax.default_backend() == "tpu" and args.model == "inception3":
+        step = lowered.compile(
+            compiler_options={"xla_tpu_scoped_vmem_limit_kib": "65536"})
+    else:
+        step = lowered.compile()
 
     if hvd.rank() == 0:
         print(f"model: {args.model}, batch {args.batch_size} x {n} ranks, "

@@ -200,15 +200,30 @@ def compile_and_analyze(model, mesh, nchips, fusion_mb, batch_per_chip):
     return analyze(txt)
 
 
+_NOTE = (
+    "overlap_window_frac = fraction of backward compute ops the "
+    "optimized schedule places after the first gradient all-reduce "
+    "issues; overlappable_frac = fraction the first all-reduce does "
+    "not transitively depend on (the schedule-independent bound that "
+    "backward-availability bucket ordering widens). "
+    "optimization_barrier chaining keeps one all-reduce per fusion "
+    "bucket. This XLA build emits TPU all-reduce synchronously in HLO "
+    "(no start/done pair surfaces) - schedule position is the "
+    "observable overlap property."
+)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="")
     ap.add_argument("--topology", default="v5e:2x4",
-                    help="AOT topology, e.g. v5e:2x4 (8 chips) or "
-                         "v5e:16x16 (256 chips - the BASELINE scale)")
+                    help="comma list of AOT topologies, e.g. v5e:2x4 "
+                         "(8 chips) or v5e:16x16 (256 chips - the "
+                         "BASELINE scale)")
     ap.add_argument("--model", default="bert-large",
-                    choices=["toy", "bert-large", "gpt2-medium"])
-    ap.add_argument("--fusion-mb", type=int, default=4)
+                    help="comma list of: toy, bert-large, gpt2-medium")
+    ap.add_argument("--fusion-mb", type=int, default=128,
+                    help="fusion threshold (default = the knob default)")
     ap.add_argument("--batch-per-chip", type=int, default=0)
     ap.add_argument("--sweep", action="store_true",
                     help="bucket order x fusion threshold table instead "
@@ -220,54 +235,55 @@ def main(argv=None):
     import horovod_tpu as hvd
     from horovod_tpu.core.state import global_state
 
-    topo = topologies.get_topology_desc(
-        topology_name=args.topology, platform="tpu")
-    nchips = len(topo.devices)
-    mesh = topologies.make_mesh(topo, (nchips,), ("hvd",))
-    hvd.init(mesh=mesh)
-    knobs = global_state().knobs
+    rows = []
+    for topology in args.topology.split(","):
+        topo = topologies.get_topology_desc(
+            topology_name=topology, platform="tpu")
+        nchips = len(topo.devices)
+        mesh = topologies.make_mesh(topo, (nchips,), ("hvd",))
+        hvd.shutdown()
+        hvd.init(mesh=mesh)
+        knobs = global_state().knobs
 
-    if args.sweep:
-        rows = []
-        for backward in (False, True):
-            for mb in (4, 16, 32):
-                knobs.bucket_backward_order = backward
-                r = compile_and_analyze(
-                    args.model, mesh, nchips, mb, args.batch_per_chip)
-                r.update(bucket_backward_order=backward, fusion_mb=mb)
-                rows.append(r)
-                print(json.dumps(r), flush=True)
-        print("\norder  mb   ARs  window")
-        for r in rows:
-            print(f"{'bwd' if r['bucket_backward_order'] else 'fwd':5}"
-                  f"{r['fusion_mb']:4}  "
-                  f"{r['bucket_all_reduces_in_optimized_hlo']:4} "
-                  f"{r['overlap_window_frac']:7.1%}")
-        return
+        if args.sweep:
+            for backward in (False, True):
+                for mb in (4, 16, 32):
+                    knobs.bucket_backward_order = backward
+                    r = compile_and_analyze(
+                        args.model.split(",")[0], mesh, nchips, mb,
+                        args.batch_per_chip)
+                    r.update(bucket_backward_order=backward,
+                             fusion_mb=mb)
+                    rows.append(r)
+                    print(json.dumps(r), flush=True)
+            print("\norder  mb   ARs  window")
+            for r in rows:
+                print(
+                    f"{'bwd' if r['bucket_backward_order'] else 'fwd':5}"
+                    f"{r['fusion_mb']:4}  "
+                    f"{r['bucket_all_reduces_in_optimized_hlo']:4} "
+                    f"{r['overlap_window_frac']:7.1%}")
+            return
 
-    report = compile_and_analyze(
-        args.model, mesh, nchips, args.fusion_mb, args.batch_per_chip)
-    report.update({
-        "model": args.model,
-        "topology": f"{args.topology} ({nchips} chips, AOT)",
-        "fusion_mb": args.fusion_mb,
-        "bucket_backward_order": knobs.bucket_backward_order,
-        "ordered_buckets_knob": knobs.ordered_buckets,
-        "note": "overlap_window_frac = fraction of backward compute ops "
-                "the optimized schedule places after the first gradient "
-                "all-reduce issues. optimization_barrier chaining keeps "
-                "one all-reduce per fusion bucket and backward-order "
-                "bucketing puts the earliest-ready gradients in the "
-                "chain's first bucket. This XLA build emits TPU "
-                "all-reduce synchronously in HLO (no start/done pair "
-                "surfaces) - schedule position is the observable overlap "
-                "property.",
-    })
-    out = json.dumps(report, indent=1)
-    print(out)
+        for model in args.model.split(","):
+            r = compile_and_analyze(
+                model, mesh, nchips, args.fusion_mb,
+                args.batch_per_chip)
+            r.update({
+                "model": model,
+                "topology": f"{topology} ({nchips} chips, AOT)",
+                "fusion_mb": args.fusion_mb,
+                "bucket_backward_order": knobs.bucket_backward_order,
+                "ordered_buckets_knob": knobs.ordered_buckets,
+            })
+            rows.append(r)
+            print(json.dumps(r), flush=True)
+
+    doc = {"note": _NOTE, "runs": rows}
     if args.out:
         with open(args.out, "w") as f:
-            f.write(out + "\n")
+            json.dump(doc, f, indent=1)
+            f.write("\n")
 
 
 if __name__ == "__main__":

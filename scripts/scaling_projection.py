@@ -100,7 +100,7 @@ def main(argv=None):
     if os.path.exists(op):
         with open(op) as f:
             ov = json.load(f)
-        rows = ov if isinstance(ov, list) else [ov]
+        rows = ov.get("runs", [ov]) if isinstance(ov, dict) else ov
         # structural bound from the headline BERT config; the schedule
         # fraction is this build's lower bound. Use the SCHEDULED
         # fraction (what the compiler provably does), not the
