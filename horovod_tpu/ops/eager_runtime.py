@@ -209,6 +209,7 @@ class EagerRuntime:
         self._handle_name: Dict[int, str] = {}
         self._handle_op: Dict[int, int] = {}
         self._last_cycle = -1
+        self._last_exec_error = ""
         self._tuning_applied = False
         self._shutdown = threading.Event()
         self._worker = threading.Thread(
@@ -238,14 +239,34 @@ class EagerRuntime:
         # tensors on GPU through NCCL the same way)
         arr = tensor if _is_jax_array(tensor) else np.asarray(tensor)
         name = self._qualify(name, process_set_id)
-        handle = self._native.enqueue(
-            name, op, str(arr.dtype), list(arr.shape),
-            reduce_op=reduce_op, root_rank=root_rank,
-            prescale=prescale, postscale=postscale,
-            splits=[int(s) for s in splits] if splits is not None else None,
-            group=group, group_size=group_size,
-            process_set_id=process_set_id,
-        )
+        # input + handle bookkeeping must be visible before the worker
+        # thread can snapshot them, so the WHOLE enqueue runs under the
+        # runtime lock: on a fast-negotiating world (response-cache
+        # hit, world=1, 1ms cycles) the background loop can emit the
+        # batch microseconds after native.enqueue returns, and a worker
+        # snapshot taken before our map writes would execute the batch
+        # with zeros for our own tensor and store no result for the
+        # handle (observed as an intermittent 'no result for handle N'
+        # under load). The native enqueue itself only pushes onto the
+        # C++ tensor queue — it never waits on this lock, so holding it
+        # across the call cannot deadlock.
+        with self._lock:
+            self._inputs[name] = arr
+            try:
+                handle = self._native.enqueue(
+                    name, op, str(arr.dtype), list(arr.shape),
+                    reduce_op=reduce_op, root_rank=root_rank,
+                    prescale=prescale, postscale=postscale,
+                    splits=[int(s) for s in splits]
+                    if splits is not None else None,
+                    group=group, group_size=group_size,
+                    process_set_id=process_set_id,
+                )
+            except Exception:
+                self._inputs.pop(name, None)
+                raise
+            self._handle_name[handle] = name
+            self._handle_op[handle] = op
         # span opens only after the native enqueue accepted the tensor — a
         # raise above would otherwise leave an unclosed 'B' corrupting the
         # trace's track nesting
@@ -254,10 +275,6 @@ class EagerRuntime:
             tl.activity_start(name, _OP_ACTIVITIES[op][0],
                               args={"shape": list(arr.shape),
                                     "dtype": str(arr.dtype)})
-        with self._lock:
-            self._inputs[name] = arr
-            self._handle_name[handle] = name
-            self._handle_op[handle] = op
         return handle
 
     # --------------------------------------------------- process sets
@@ -403,7 +420,7 @@ class EagerRuntime:
             if handle not in self._results:
                 raise HorovodInternalError(
                     f"no result for handle {handle}: "
-                    f"{self._native.last_error()}"
+                    f"{self._native.last_error() or self._last_exec_error}"
                 )
             return self._results.pop(handle)
 
@@ -493,6 +510,14 @@ class EagerRuntime:
                         self._inputs.pop(name, None)
                 self._native.batch_done(batch, ok=True)
             except Exception:
+                # keep the executor's failure for synchronize()'s error
+                # message — the native error channel only carries
+                # negotiation/transport failures, so a swallowed
+                # executor exception would surface as a bare
+                # 'no result for handle N'
+                import traceback
+
+                self._last_exec_error = traceback.format_exc(limit=8)
                 self._native.batch_done(batch, ok=False)
                 with self._lock:
                     for h in batch.handles:
