@@ -759,6 +759,15 @@ def grouped_allreduce(
         )
 
     tensors = list(tensors)
+    # native eager world, all-dense: one group-tagged negotiation round
+    # (all-or-nothing) + fused execution, same as the async surface —
+    # the compile-time bucketing below is the jit/SPMD form
+    if (not _bound_axes(_resolve_axis(axis_name))
+            and _native_rt_for_async(process_set) is not None
+            and not _contains_indexed_slices(tensors)):
+        return synchronize(grouped_allreduce_async(
+            tensors, op=op, name=name, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set))
     # IndexedSlices members can't ride the fusion buffer (their indices
     # and static dense_shape would be summed as data); route each through
     # the sparse path, fuse only the dense members (reference

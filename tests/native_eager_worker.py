@@ -151,6 +151,16 @@ def main():
         for i in range(3)
     )
 
+    # 6b-sync. the sync grouped_allreduce surface rides the same
+    # group-tagged round in a native world (round-5 parity with async)
+    sres = hvd.grouped_allreduce(
+        [np.full((4,), float((rank + 1) * (i + 1)), np.float32)
+         for i in range(2)], op=hvd.Sum, name="gsync")
+    out["grouped_sync_ok"] = all(
+        np.allclose(np.asarray(sres[i]), s_world * (i + 1))
+        for i in range(2)
+    )
+
     # 6b'. grouped allgather + reducescatter: one group-tagged
     # negotiation round each (reference operations.cc:1725, :1532); the
     # fused reducescatter batch executes as ONE packed collective

@@ -91,6 +91,7 @@ def test_native_eager_end_to_end(size):
         for key in (
             "allreduce_ok", "average_ok", "allgather_ok", "broadcast_ok",
             "reducescatter_ok", "alltoall_ok", "grouped_ok",
+            "grouped_sync_ok",
             "grouped_allgather_ok", "grouped_reducescatter_ok",
             "sparse_ok",
             "process_set_ok", "join_ok",
