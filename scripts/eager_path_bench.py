@@ -154,6 +154,83 @@ def main(argv=None):
 
     coord1 = (rt._native.coord_cycle_stats()
               if rt is not None else {})
+
+    # ---- grouped eager path: the torch-adapter group API — ONE
+    # all-or-nothing negotiation round and one fused executor batch for
+    # all leaves (grouped_allreduce_async), vs 8 per-tensor rounds above
+    def eager_grouped_step(p, s):
+        l, g = grad_fn(p, x_local, y_local)
+        leaves, treedef = jax.tree_util.tree_flatten(g)
+        h = hvd.grouped_allreduce_async(leaves, op=hvd.Average,
+                                        name="ggrp")
+        red = [jnp.asarray(r) for r in hvd.synchronize(h)]
+        g = jax.tree_util.tree_unflatten(treedef, red)
+        u, s = opt_update(g, s, p)
+        return apply_updates(p, u), s, l
+
+    p4, s4 = params, opt.init(params)
+    for _ in range(args.warmup):
+        p4, s4, l = eager_grouped_step(p4, s4)
+    float(l)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        p4, s4, l = eager_grouped_step(p4, s4)
+    float(l)
+    grouped_s = (time.perf_counter() - t0) / args.steps
+
+    # ---- pure runtime round-trip: enqueue+synchronize one tiny
+    # PRE-COMPUTED tensor — no grad compute to wait on, so this is the
+    # floor cost of (coordinator cycle + worker wakeup + executor
+    # dispatch) alone, separating runtime latency from device-wait
+    # inside "negotiate_execute" below.
+    tiny = jnp.ones((8,), jnp.float32)
+    jax.block_until_ready(tiny)
+    for _ in range(args.warmup):
+        hvd.synchronize(hvd.allreduce_async(tiny, name="rtt"))
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        hvd.synchronize(hvd.allreduce_async(tiny, name="rtt"))
+    rtt_s = (time.perf_counter() - t0) / args.steps
+
+    # ---- phase decomposition: time each phase of the SAME pipelined
+    # step (no extra barriers — through the remote-TPU tunnel a single
+    # block_until_ready costs a ~100 ms RTT and would swamp the signal).
+    # grad/apply measure async dispatch; synchronize() is the step's
+    # only blocking point, so "negotiate_execute" absorbs the wait for
+    # grads to finish on device + negotiation + executor dispatch. The
+    # phases sum to the pipelined step time.
+    def timed_eager_step(p, s, acc):
+        t = time.perf_counter()
+        l, g = grad_fn(p, x_local, y_local)
+        acc["grad_dispatch"] += time.perf_counter() - t
+
+        t = time.perf_counter()
+        leaves, treedef = jax.tree_util.tree_flatten(g)
+        handles = [
+            hvd.allreduce_async(leaf, name=f"g{i}", op=hvd.Average)
+            for i, leaf in enumerate(leaves)
+        ]
+        acc["enqueue"] += time.perf_counter() - t
+
+        t = time.perf_counter()
+        red = [jnp.asarray(hvd.synchronize(h)) for h in handles]
+        acc["negotiate_execute"] += time.perf_counter() - t
+
+        t = time.perf_counter()
+        g = jax.tree_util.tree_unflatten(treedef, red)
+        u, s = opt_update(g, s, p)
+        p = apply_updates(p, u)
+        acc["apply_dispatch"] += time.perf_counter() - t
+        return p, s, l
+
+    phases = {"grad_dispatch": 0.0, "enqueue": 0.0,
+              "negotiate_execute": 0.0, "apply_dispatch": 0.0}
+    p3, s3 = params, opt.init(params)
+    for _ in range(args.steps):
+        p3, s3, _ = timed_eager_step(p3, s3, phases)
+    breakdown = {k: round(v / args.steps * 1e3, 2)
+                 for k, v in phases.items()}
+
     n_leaves = len(jax.tree_util.tree_leaves(params))
     report = {
         "what": "per-step wall time, 4x1024 MLP batch %d, single chip"
@@ -164,7 +241,11 @@ def main(argv=None):
         "spmd_step_ms": round(spmd_s * 1e3, 2),
         "eager_step_ms": round(eager_s * 1e3, 2),
         "eager_over_spmd": round(eager_s / spmd_s, 2),
+        "eager_grouped_step_ms": round(grouped_s * 1e3, 2),
+        "eager_grouped_over_spmd": round(grouped_s / spmd_s, 2),
         "cache_hits": int(rt.cache_hits()) if rt is not None else None,
+        "runtime_roundtrip_ms": round(rtt_s * 1e3, 2),
+        "phase_breakdown_ms": breakdown,
     }
     if coord1:
         cyc = max(coord1["cycles"] - coord0.get("cycles", 0), 1)
