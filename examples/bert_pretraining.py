@@ -62,6 +62,10 @@ def main(argv=None, stats=None):
     p.add_argument("--fused-ln", action="store_true",
                    help="pallas single-pass LayerNorm kernels "
                         "(ops/pallas_layernorm.py)")
+    p.add_argument("--autotune-spmd", action="store_true",
+                   help="SPMDStepTuner sweep (bucket size + overlap "
+                        "chain) before the timed run; winners are "
+                        "pinned into the knobs the final compile reads")
     args = p.parse_args(argv)
 
     hvd.init()
@@ -137,6 +141,24 @@ def main(argv=None, stats=None):
     tok = jax.device_put(tokens, shard)
     lab = jax.device_put(labels, shard)
     msk = jax.device_put(mask, shard)
+
+    if args.autotune_spmd:
+        # each candidate is a fresh trace (no donation — the tuner
+        # re-runs one candidate's step many times on the same buffers);
+        # the winning knobs persist for the donating AOT compile below
+        def build_step(overrides):
+            js = jax.jit(jax.shard_map(
+                step_fn, mesh=mesh,
+                in_specs=(P(), P(), P("hvd"), P("hvd"), P("hvd")),
+                out_specs=(P(), P(), P()), check_vma=False))
+            return js.lower(params, opt_state, tok, lab, msk).compile()
+
+        winners = hvd.SPMDStepTuner(
+            thresholds=[16 << 20, 64 << 20, 128 << 20, 256 << 20],
+            warmup=1, measure=4,
+        ).tune(build_step, params, opt_state, tok, lab, msk)
+        if hvd.rank() == 0:
+            print(f"autotune-spmd pinned: {winners}", flush=True)
 
     # AOT-compile and call the executable directly: same program, but
     # the per-call jit dispatch costs ~5-8% through remote-TPU paths

@@ -66,6 +66,7 @@ from .ops import (  # noqa: F401
     Min,
     Product,
     ReduceOp,
+    SPMDStepTuner,
     Sum,
     allgather,
     allgather_async,

@@ -98,3 +98,173 @@ class ParameterManager:
             for thr, score in self._log_rows:
                 f.write(f"{thr},{score}\n")
             f.write(f"# pinned,{self._current}\n")
+
+
+class SPMDStepTuner:
+    """Live tuner for the *compiled* (jit/SPMD) path, where the headline
+    perf lives. Under XLA a traced step bakes its bucket structure in,
+    so in-step observation (ParameterManager above) can only steer
+    future compilations — on the jit path, tuning IS recompiling. This
+    tuner makes that explicit: the user hands it a step *factory*, and
+    it coordinate-descends over the knobs that change the compiled
+    collective structure, compiling + measuring each candidate and
+    pinning the winners into the global knobs:
+
+      * ``fusion_threshold_bytes`` — bucket size (launch latency vs
+        overlap window);
+      * ``ordered_buckets`` — chained per-bucket all-reduces vs letting
+        XLA's combiner merge them (docs/benchmarks.md, overlap section);
+      * optionally ``hierarchical_allreduce`` × ``hierarchical_local_size``
+        — ICI-inner/DCN-outer routing (ops/hierarchical.py).
+
+    Coordinate descent visits O(sum of dims) candidates, not the
+    product — the same economy the reference's ParameterManager buys
+    with Bayesian search over its knob space
+    (/root/reference/horovod/common/parameter_manager.h:42); a GP is
+    overkill for <= a dozen compiles.
+
+    Usage::
+
+        def build_step(overrides):
+            # knobs already carry `overrides` when this is called;
+            # (re)trace the train step and return a callable
+            return jax.jit(train_step).lower(*example).compile()
+
+        tuner = hvd.SPMDStepTuner(tune_hierarchical=False)
+        winners = tuner.tune(build_step, params, state, batch)
+
+    The factory is invoked once per candidate; each returned step is
+    timed post-warmup on the real arguments. Winners persist in
+    ``global_state().knobs`` so later compilations (and checkpointed
+    restarts reading the autotune log) inherit them.
+    """
+
+    def __init__(
+        self,
+        knobs: Optional[Knobs] = None,
+        thresholds: Optional[List[int]] = None,
+        warmup: int = 2,
+        measure: int = 8,
+        tune_ordered: bool = True,
+        tune_hierarchical: bool = False,
+        hier_blocks: Optional[List[int]] = None,
+        log_path: str = "",
+    ):
+        if knobs is None:
+            from ..core.state import global_state
+
+            knobs = global_state().knobs
+        self._knobs = knobs
+        self._thresholds = list(thresholds) if thresholds else [
+            4 << 20, 16 << 20, 64 << 20, 128 << 20, 256 << 20,
+        ]
+        # seed the sweep with the incumbent so tuning can never pin a
+        # setting slower than what the user already had
+        if knobs.fusion_threshold_bytes not in self._thresholds:
+            self._thresholds.insert(0, knobs.fusion_threshold_bytes)
+        self._warmup = max(int(warmup), 0)
+        self._measure = max(int(measure), 1)
+        self._tune_ordered = tune_ordered
+        self._tune_hier = tune_hierarchical
+        self._hier_blocks = list(hier_blocks) if hier_blocks else [0]
+        # distinct default path from ParameterManager's (both write mode
+        # "w"; sharing knobs.autotune_log would clobber whichever
+        # finishes first)
+        self._log_path = log_path or (
+            knobs.autotune_log + ".spmd" if knobs.autotune_log else "")
+        self.trials: List[dict] = []
+
+    # -- knob plumbing -------------------------------------------------
+    def _apply(self, overrides: dict) -> dict:
+        saved = {k: getattr(self._knobs, k) for k in overrides}
+        for k, v in overrides.items():
+            setattr(self._knobs, k, v)
+        return saved
+
+    def _time_candidate(self, build_step, args, overrides: dict) -> float:
+        import jax
+
+        saved = self._apply(overrides)
+        try:
+            step = build_step(dict(overrides))
+            out = None
+            for _ in range(self._warmup):
+                out = step(*args)
+            if out is not None:
+                jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(self._measure):
+                out = step(*args)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / self._measure
+        finally:
+            self._apply(saved)
+        self.trials.append({**overrides, "step_s": dt})
+        return dt
+
+    # -- search --------------------------------------------------------
+    def tune(self, build_step, *args) -> dict:
+        """Coordinate descent; returns the winning overrides (already
+        pinned into the knobs)."""
+        best = {
+            "fusion_threshold_bytes": self._knobs.fusion_threshold_bytes,
+            "ordered_buckets": self._knobs.ordered_buckets,
+        }
+        if self._tune_hier:
+            best["hierarchical_allreduce"] = (
+                self._knobs.hierarchical_allreduce)
+            best["hierarchical_local_size"] = (
+                self._knobs.hierarchical_local_size)
+
+        def score(ov):
+            return self._time_candidate(build_step, args, {**best, **ov})
+
+        # dim 1: bucket size
+        timed = {t: score({"fusion_threshold_bytes": t})
+                 for t in self._thresholds}
+        best["fusion_threshold_bytes"] = min(timed, key=timed.get)
+        best_t = timed[best["fusion_threshold_bytes"]]
+
+        # dim 2: ordered chain on/off
+        if self._tune_ordered:
+            flipped = not best["ordered_buckets"]
+            t = score({"ordered_buckets": flipped})
+            if t < best_t:
+                best["ordered_buckets"], best_t = flipped, t
+
+        # dim 3: hierarchical routing
+        if self._tune_hier:
+            for blk in self._hier_blocks:
+                t = score({"hierarchical_allreduce": True,
+                           "hierarchical_local_size": blk})
+                if t < best_t:
+                    best_t = t
+                    best["hierarchical_allreduce"] = True
+                    best["hierarchical_local_size"] = blk
+
+        # multi-controller agreement: every rank measured locally on its
+        # own (noisy) clock; rank 0's winner is broadcast so all ranks
+        # compile the SAME collective structure — the reference
+        # broadcasts ParameterManager winners from the coordinator for
+        # exactly this reason (parameter_manager.cc). Single-controller
+        # worlds (one process drives the mesh) skip the round trip.
+        from ..core.basics import cross_size, is_initialized
+
+        if is_initialized() and cross_size() > 1:
+            from ..optim.functions import broadcast_object
+
+            best = broadcast_object(best, root_rank=0)
+
+        self._apply(best)  # pin winners
+        self._write_log(best, best_t)
+        return best
+
+    def _write_log(self, best: dict, best_t: float) -> None:
+        if not self._log_path:
+            return
+        keys = sorted({k for row in self.trials for k in row})
+        with open(self._log_path, "w") as f:
+            f.write(",".join(keys) + "\n")
+            for row in self.trials:
+                f.write(",".join(str(row.get(k, "")) for k in keys) + "\n")
+            f.write(f"# pinned,{best},step_s={best_t:.6f}\n")
