@@ -219,11 +219,33 @@ class SPMDStepTuner:
         def score(ov):
             return self._time_candidate(build_step, args, {**best, **ov})
 
+        def agree(best):
+            """Multi-controller agreement, after EVERY dimension: each
+            rank measured candidates on its own noisy clock, and a
+            divergent pick would make the NEXT dimension's candidates
+            compile rank-mismatched collective structures (a cross-host
+            hang inside _time_candidate). Within a dimension every rank
+            times the same candidate list in the same order, so trials
+            are consistent; only the argmin needs agreeing. Rank 0's
+            pick wins — the reference broadcasts ParameterManager
+            winners from the coordinator the same way
+            (parameter_manager.cc). Single-controller worlds (one
+            process drives the mesh) skip the round trip.
+            """
+            from ..core.basics import cross_size, is_initialized
+
+            if is_initialized() and cross_size() > 1:
+                from ..optim.functions import broadcast_object
+
+                best = broadcast_object(best, root_rank=0)
+            return best
+
         # dim 1: bucket size
         timed = {t: score({"fusion_threshold_bytes": t})
                  for t in self._thresholds}
         best["fusion_threshold_bytes"] = min(timed, key=timed.get)
         best_t = timed[best["fusion_threshold_bytes"]]
+        best = agree(best)
 
         # dim 2: ordered chain on/off
         if self._tune_ordered:
@@ -231,6 +253,7 @@ class SPMDStepTuner:
             t = score({"ordered_buckets": flipped})
             if t < best_t:
                 best["ordered_buckets"], best_t = flipped, t
+            best = agree(best)
 
         # dim 3: hierarchical routing
         if self._tune_hier:
@@ -241,19 +264,7 @@ class SPMDStepTuner:
                     best_t = t
                     best["hierarchical_allreduce"] = True
                     best["hierarchical_local_size"] = blk
-
-        # multi-controller agreement: every rank measured locally on its
-        # own (noisy) clock; rank 0's winner is broadcast so all ranks
-        # compile the SAME collective structure — the reference
-        # broadcasts ParameterManager winners from the coordinator for
-        # exactly this reason (parameter_manager.cc). Single-controller
-        # worlds (one process drives the mesh) skip the round trip.
-        from ..core.basics import cross_size, is_initialized
-
-        if is_initialized() and cross_size() > 1:
-            from ..optim.functions import broadcast_object
-
-            best = broadcast_object(best, root_rank=0)
+            best = agree(best)
 
         self._apply(best)  # pin winners
         self._write_log(best, best_t)

@@ -209,6 +209,7 @@ def run_elastic(
 from .estimator import (  # noqa: E402,F401
     JaxEstimator,
     JaxModel,
+    LightningEstimator,
     TorchEstimator,
     TorchModel,
 )

@@ -569,6 +569,13 @@ class TorchEstimator:
     contract as JaxEstimator; training uses
     horovod_tpu.torch.DistributedOptimizer."""
 
+    # Lightning-style hook points (set by LightningEstimator): when
+    # non-None, the train loop computes loss via
+    # _train_step_fn(model, bx, by, batch_idx) instead of
+    # loss(model(bx), by), and validation via _val_step_fn likewise.
+    _train_step_fn = None
+    _val_step_fn = None
+
     def __init__(
         self,
         model,
@@ -633,6 +640,8 @@ class TorchEstimator:
         metric_fns = self.metrics
         cbs = self.callbacks
         restore_best = self.restore_best_weights
+        train_step_fn = self._train_step_fn
+        val_step_fn = self._val_step_fn
 
         def train():
             import os
@@ -702,7 +711,9 @@ class TorchEstimator:
                     by = ys[idx] if n else torch.zeros(
                         (batch_size, ys.shape[-1]))
                     opt.zero_grad()
-                    loss = loss_fn(model(bx), by)
+                    loss = (train_step_fn(model, bx, by, i)
+                            if train_step_fn is not None
+                            else loss_fn(model(bx), by))
                     (loss * float(scale[i])).backward()
                     opt.step()
                     if w_local[i] > 0:
@@ -720,13 +731,14 @@ class TorchEstimator:
                     op=thvd.Sum)
                 history["train_loss"].append(
                     float(sums[0] / max(float(sums[1]), 1e-12)))
+                chunk = 4096  # bounded eval: never materialize the
+                # whole shard's activations in one call
+
                 def eval_batched(t):
-                    # bounded chunks: metric eval must not materialize
-                    # the whole shard's activations in one call
                     with torch.no_grad():
                         return torch.cat([
-                            model(t[i:i + 4096])
-                            for i in range(0, len(t), 4096)
+                            model(t[i:i + chunk])
+                            for i in range(0, len(t), chunk)
                         ]) if len(t) else model(t)
 
                 if metric_fns and n:
@@ -735,9 +747,21 @@ class TorchEstimator:
                         history[f"train_{mname}"].append(
                             float(fn(pred, ys)))
                 if len(vx):
-                    vpred = eval_batched(vx)
-                    history["val_loss"].append(
-                        float(loss_fn(vpred, vy)))
+                    vpred = (eval_batched(vx)
+                             if (metric_fns or val_step_fn is None)
+                             else None)
+                    if val_step_fn is not None:
+                        with torch.no_grad():
+                            tot = sum(
+                                float(val_step_fn(
+                                    model, vx[j:j + chunk],
+                                    vy[j:j + chunk], j // chunk))
+                                * len(vx[j:j + chunk])
+                                for j in range(0, len(vx), chunk))
+                        history["val_loss"].append(tot / len(vx))
+                    else:
+                        history["val_loss"].append(
+                            float(loss_fn(vpred, vy)))
                     for mname, fn in metric_fns.items():
                         history[f"val_{mname}"].append(
                             float(fn(vpred, vy)))
@@ -838,3 +862,98 @@ class TorchModel:
         return _transform_rdd(
             df, self.feature_cols, self.output_col, self.predict
         )
+
+
+def _lightning_loss(out):
+    """training_step/validation_step may return the loss tensor or a
+    dict carrying it under "loss" (Lightning contract)."""
+    if isinstance(out, dict):
+        out = out["loss"]
+    return out
+
+
+def _first_optimizer(cfg):
+    """Unwrap configure_optimizers()'s accepted shapes — a single
+    optimizer, [optimizers], ([optimizers], [schedulers]), or
+    {"optimizer": opt, ...} — down to one optimizer. Multi-optimizer
+    setups (GANs) are out of scope here, as in the reference's
+    estimator; LR schedulers are not stepped by this train loop, so
+    their presence warns rather than being silently dropped."""
+    import warnings
+
+    schedulers = None
+    if isinstance(cfg, dict):
+        schedulers = cfg.get("lr_scheduler")
+        cfg = cfg["optimizer"]
+    if isinstance(cfg, (list, tuple)):
+        if (len(cfg) == 2 and isinstance(cfg[0], (list, tuple))
+                and isinstance(cfg[1], (list, tuple))):
+            cfg, schedulers = cfg[0], (cfg[1] or None)
+        opts = list(cfg) if isinstance(cfg, (list, tuple)) else [cfg]
+        if len(opts) != 1:
+            raise ValueError(
+                "LightningEstimator supports exactly one optimizer; "
+                f"configure_optimizers() returned {len(opts)}")
+        cfg = opts[0]
+    if schedulers:
+        warnings.warn(
+            "LightningEstimator does not step LR schedulers returned "
+            "by configure_optimizers(); training runs at the "
+            "optimizer's base LR. Fold the schedule into the optimizer "
+            "or train with horovod_tpu.torch directly.",
+            stacklevel=3)
+    return cfg
+
+
+class LightningEstimator(TorchEstimator):
+    """Fit a Lightning-STYLE module to a Spark DataFrame — the third
+    estimator flavor (reference
+    /root/reference/horovod/spark/lightning/estimator.py:1).
+
+    The module contract is duck-typed, so real
+    ``pytorch_lightning.LightningModule`` subclasses work unchanged and
+    no pytorch-lightning install is required:
+
+      * ``training_step((x, y), batch_idx) -> loss`` (or
+        ``{"loss": ...}``) — required; the module does its own forward.
+      * ``configure_optimizers()`` — required; single-optimizer forms
+        (optimizer, [optimizer], ([opts], [scheds]), {"optimizer": ...}).
+      * ``validation_step((x, y), batch_idx)`` — optional; drives
+        ``val_loss`` history (and early stopping / best-checkpoint
+        monitoring) when a validation split exists.
+      * ``forward(x)`` — optional; needed by ``transform()`` and
+        metric fns.
+
+    Batches arrive as ``(features, labels)`` float tensors, matching
+    the reference estimator's (feature_cols, label_cols) DataFrame
+    contract. Everything else — store-backed shards, keep-alive
+    weighting, metric/early-stop callbacks, ``restore_best_weights``,
+    per-epoch ``history`` on the returned model — is shared with
+    TorchEstimator.
+    """
+
+    def __init__(self, model, feature_cols: Sequence[str],
+                 label_cols: Sequence[str], **kwargs):
+        for hook in ("training_step", "configure_optimizers"):
+            if not callable(getattr(model, hook, None)):
+                raise ValueError(
+                    f"Lightning-style module must define {hook}(); "
+                    "got " + type(model).__name__)
+        if "optimizer_factory" in kwargs or "loss" in kwargs:
+            raise ValueError(
+                "LightningEstimator derives the optimizer from "
+                "configure_optimizers() and the loss from "
+                "training_step(); don't pass optimizer_factory/loss")
+        super().__init__(
+            model=model, feature_cols=feature_cols,
+            label_cols=label_cols,
+            optimizer_factory=lambda params: _first_optimizer(
+                model.configure_optimizers()),
+            **kwargs)
+        self._train_step_fn = (
+            lambda m, bx, by, i: _lightning_loss(
+                m.training_step((bx, by), i)))
+        if callable(getattr(model, "validation_step", None)):
+            self._val_step_fn = (
+                lambda m, bx, by, i: _lightning_loss(
+                    m.validation_step((bx, by), i)))

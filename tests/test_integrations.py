@@ -871,3 +871,103 @@ def test_jax_estimator_callbacks(monkeypatch, tmp_path):
     assert "epoch_end:1" in Recorder.calls
     assert Recorder.calls.count("batch") >= 2
     assert model.history["train_loss"] == [-123.0, -123.0]
+
+
+class _LightningStyleModule:
+    """Duck-typed LightningModule: training_step/configure_optimizers/
+    validation_step/forward — no pytorch_lightning import needed."""
+
+    def __new__(cls):
+        import torch
+
+        class Mod(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(2, 1)
+                self.train_batches = []
+
+            def forward(self, x):
+                return self.lin(x)
+
+            def training_step(self, batch, batch_idx):
+                x, y = batch
+                self.train_batches.append(batch_idx)
+                return {"loss": torch.nn.functional.mse_loss(
+                    self.lin(x), y)}
+
+            def validation_step(self, batch, batch_idx):
+                x, y = batch
+                return torch.nn.functional.mse_loss(self.lin(x), y)
+
+            def configure_optimizers(self):
+                opt = torch.optim.Adam(self.parameters(), lr=0.1)
+                return ([opt], [])  # ([opts], [schedulers]) form
+
+        return Mod()
+
+
+def test_lightning_estimator_fit_and_transform(monkeypatch):
+    pytest.importorskip("torch")
+    import numpy as np
+
+    import horovod_tpu.spark as sp
+
+    _install_fake_pyspark(monkeypatch, ["h1:1"], default_parallelism=1)
+    model = _LightningStyleModule()
+    est = sp.LightningEstimator(
+        model=model,
+        feature_cols=["x1", "x2"], label_cols=["label"],
+        batch_size=16, epochs=60, num_proc=1, validation=0.25,
+    )
+    tmodel = est.fit(_linear_df(128))
+    w = tmodel.module.lin.weight.detach().numpy().ravel()
+    np.testing.assert_allclose(w, [2.0, -1.0], atol=0.15)
+    # training went through the module's own training_step
+    assert model.train_batches, "training_step never called"
+    # validation_step drove the val_loss history
+    assert "val_loss" in tmodel.history
+    assert len(tmodel.history["val_loss"]) == 60
+    assert tmodel.history["val_loss"][-1] < 0.05
+    out = tmodel.transform(_linear_df(16)).collect()
+    preds = np.asarray([r["prediction"][0] for r in out])
+    labels = np.asarray([r["label"] for r in out])
+    assert np.mean((preds - labels) ** 2) < 0.05
+
+
+def test_lightning_estimator_early_stopping(monkeypatch):
+    pytest.importorskip("torch")
+    import horovod_tpu.spark as sp
+    from horovod_tpu.callbacks import EarlyStoppingCallback
+
+    _install_fake_pyspark(monkeypatch, ["h1:1"], default_parallelism=1)
+    est = sp.LightningEstimator(
+        model=_LightningStyleModule(),
+        feature_cols=["x1", "x2"], label_cols=["label"],
+        batch_size=16, epochs=500, num_proc=1, validation=0.25,
+        callbacks=[EarlyStoppingCallback(monitor="val_loss",
+                                         patience=5, min_delta=1e-5)],
+    )
+    tmodel = est.fit(_linear_df(128))
+    assert tmodel.stopped_epoch is not None
+    assert tmodel.stopped_epoch < 499
+
+
+def test_lightning_estimator_rejects_non_lightning_module():
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.spark as sp
+
+    with pytest.raises(ValueError, match="training_step"):
+        sp.LightningEstimator(
+            model=torch.nn.Linear(2, 1),
+            feature_cols=["x1"], label_cols=["y"])
+
+
+def test_lightning_estimator_rejects_loss_override():
+    pytest.importorskip("torch")
+    import horovod_tpu.spark as sp
+
+    with pytest.raises(ValueError, match="configure_optimizers"):
+        sp.LightningEstimator(
+            model=_LightningStyleModule(),
+            feature_cols=["x1"], label_cols=["y"],
+            loss=lambda p, y: 0.0)
