@@ -62,6 +62,10 @@ def main(argv=None, stats=None):
     p.add_argument("--fused-ln", action="store_true",
                    help="pallas single-pass LayerNorm kernels "
                         "(ops/pallas_layernorm.py)")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1 sharded optimizer states "
+                        "(hvd.ShardedOptimizer): Adam m/v split 1/N "
+                        "across ranks")
     p.add_argument("--autotune-spmd", action="store_true",
                    help="SPMDStepTuner sweep (bucket size + overlap "
                         "chain) before the timed run; winners are "
@@ -100,8 +104,14 @@ def main(argv=None, stats=None):
         jax.random.PRNGKey(0), jnp.zeros((1, T), dtype=jnp.int32)
     )["params"]
     n_params = count_params(params)
-    opt = hvd.DistributedOptimizer(optax.adamw(args.lr))
+    if args.zero:
+        # ZeRO-1: Adam m/v sharded 1/N per rank (optim/zero.py)
+        opt = hvd.ShardedOptimizer(optax.adamw(args.lr))
+    else:
+        opt = hvd.DistributedOptimizer(optax.adamw(args.lr))
     opt_state = opt.init(params)
+    state_specs = (hvd.sharded_state_specs(opt_state)
+                   if args.zero else P())
     params = hvd.broadcast_parameters(params, root_rank=0)
 
     if args.fused_ce:
@@ -130,8 +140,8 @@ def main(argv=None, stats=None):
     step = jax.jit(
         jax.shard_map(
             step_fn, mesh=mesh,
-            in_specs=(P(), P(), P("hvd"), P("hvd"), P("hvd")),
-            out_specs=(P(), P(), P()),
+            in_specs=(P(), state_specs, P("hvd"), P("hvd"), P("hvd")),
+            out_specs=(P(), state_specs, P()),
             check_vma=False,
         ),
         donate_argnums=(0, 1),
@@ -149,8 +159,9 @@ def main(argv=None, stats=None):
         def build_step(overrides):
             js = jax.jit(jax.shard_map(
                 step_fn, mesh=mesh,
-                in_specs=(P(), P(), P("hvd"), P("hvd"), P("hvd")),
-                out_specs=(P(), P(), P()), check_vma=False))
+                in_specs=(P(), state_specs, P("hvd"), P("hvd"),
+                          P("hvd")),
+                out_specs=(P(), state_specs, P()), check_vma=False))
             return js.lower(params, opt_state, tok, lab, msk).compile()
 
         winners = hvd.SPMDStepTuner(

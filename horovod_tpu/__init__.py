@@ -97,10 +97,12 @@ from .optim import (  # noqa: F401
     Compression,
     DistributedGradientTape,
     DistributedOptimizer,
+    ShardedOptimizer,
     allgather_object,
     broadcast_object,
     broadcast_optimizer_state,
     broadcast_parameters,
+    sharded_state_specs,
 )
 
 # Elastic + timeline live under their own namespaces, mirroring

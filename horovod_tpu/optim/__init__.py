@@ -10,3 +10,4 @@ from .functions import (  # noqa: F401
     broadcast_optimizer_state,
     broadcast_parameters,
 )
+from .zero import ShardedOptimizer, sharded_state_specs  # noqa: F401

@@ -1,0 +1,157 @@
+"""ZeRO-1-style sharded optimizer states over the data-parallel axis.
+
+SURVEY §2.5 frames the reference's first-class reducescatter/allgather
+as "ZeRO-style building blocks" (reference operations.cc:1725,1532) —
+but the reference stops at the blocks; users hand-roll the optimizer.
+On TPU the composition is one psum_scatter and one all_gather riding
+ICI, so this module ships it:
+
+  * the flat gradient is reduce-scattered so each rank owns 1/N of it
+    (the reduction does allreduce-equivalent bytes, split across the
+    two collectives);
+  * the inner optax optimizer updates ONLY that shard — its state
+    (Adam's m/v, momentum, ...) lives sharded, cutting optimizer-state
+    HBM by the world size (BERT-L Adam fp32 m+v: 2.7 GB → 334 MB on 8
+    chips);
+  * the resulting update shard is all-gathered back so `update()`
+    still returns a full updates pytree (drop-in optax contract, same
+    call shape as DistributedOptimizer).
+
+Usage (single-controller SPMD, inside shard_map like
+DistributedOptimizer):
+
+    opt = hvd.ShardedOptimizer(optax.adam(1e-3))
+    state = opt.init(params)                # leaves sharded over ranks
+    specs = hvd.sharded_state_specs(state)  # P("hvd") / P() per leaf
+
+    def step(p, s, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        upd, s = opt.update(g, s, p)
+        return optax.apply_updates(p, upd), s, ...
+
+    jax.jit(jax.shard_map(step, mesh=mesh,
+                          in_specs=(P(), specs, P("hvd"), P("hvd")),
+                          out_specs=(P(), specs, ...), check_vma=False))
+
+Constraints (documented, asserted): the inner optimizer must be
+elementwise in its state (adam/adamw/sgd/momentum/rmsprop... — anything
+whose state leaves mirror the flat parameter vector); factored-state
+optimizers (adafactor) need the parameter structure and cannot shard
+this way. One live data-parallel axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+
+from ..ops import collectives
+
+
+def _live_axis(axis_name):
+    axes = collectives._resolve_axis(axis_name)
+    live = collectives._bound_axes(axes)
+    if len(live) > 1:
+        raise ValueError(
+            "ShardedOptimizer shards over exactly one data-parallel "
+            f"axis; got live axes {live}")
+    return live[0] if live else None
+
+
+def _flat_size(params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def _world(axis_name) -> int:
+    n = collectives._group_size(None, axis_name)
+    return max(int(n), 1)
+
+
+def ShardedOptimizer(optimizer, axis_name=None):
+    """Wrap an elementwise optax optimizer so its state is sharded 1/N
+    per rank (ZeRO stage 1). Returns an optax GradientTransformation
+    whose `update()` reduce-scatters gradients, updates the local
+    shard, and all-gathers the updates."""
+    import optax
+
+    def _shapes(params):
+        n = _world(axis_name)
+        size = _flat_size(params)
+        k = -(-size // n)  # ceil: per-rank shard length
+        return n, size, k
+
+    def init_fn(params):
+        n, size, k = _shapes(params)
+        if n <= 1:
+            return optimizer.init(params)
+        flat, _ = jax.flatten_util.ravel_pytree(params)
+        padded = jnp.zeros((n * k,), flat.dtype).at[:size].set(flat)
+        # (n, k): row r is rank r's parameter shard. Outside jit this is
+        # a global array; under jit, sharded_state_specs() places one
+        # row per device — the actual N x memory saving.
+        return optimizer.init(padded.reshape(n, k))
+
+    def update_fn(grads, state, params=None, **extra):
+        n, size, k = _shapes(grads)
+        if n <= 1:
+            return optimizer.update(grads, state, params, **extra)
+        if params is None:
+            raise ValueError(
+                "ShardedOptimizer.update requires params (the local "
+                "parameter shard is sliced from them)")
+        ax = _live_axis(axis_name)
+        if ax is None:
+            raise RuntimeError(
+                "ShardedOptimizer.update must run inside shard_map/jit "
+                "with the data-parallel mesh axis bound (it issues "
+                "psum_scatter/all_gather)")
+        flat_g, _ = jax.flatten_util.ravel_pytree(grads)
+        flat_p, unravel = jax.flatten_util.ravel_pytree(params)
+        pad_g = jnp.zeros((n * k,), flat_g.dtype).at[:size].set(flat_g)
+        # reduce-scatter: rank r receives the SUM over ranks of block r
+        g_shard = jax.lax.psum_scatter(
+            pad_g, ax, scatter_dimension=0, tiled=True) / n
+        r = jax.lax.axis_index(ax)
+        p_shard = jax.lax.dynamic_slice(
+            jnp.zeros((n * k,), flat_p.dtype).at[:size].set(flat_p),
+            (r * k,), (k,))
+        # state rows arrive (1, k) per device via sharded_state_specs;
+        # flatten to (k,) for the inner elementwise update
+        local_state = jax.tree_util.tree_map(
+            lambda s: s.reshape(-1) if _is_sharded_leaf(s, k) else s,
+            state)
+        upd_shard, new_local = optimizer.update(
+            g_shard, local_state, p_shard, **extra)
+        new_state = jax.tree_util.tree_map(
+            lambda s: s.reshape(1, -1) if (
+                hasattr(s, "ndim") and s.ndim == 1 and s.size == k
+            ) else s,
+            new_local)
+        upd_full = jax.lax.all_gather(upd_shard, ax, tiled=True)[:size]
+        return unravel(upd_full), new_state
+
+    def _is_sharded_leaf(s, k):
+        return (hasattr(s, "ndim") and s.ndim == 2
+                and s.shape[-1] == k and s.shape[0] == 1)
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
+
+
+def sharded_state_specs(state, axis_name=None):
+    """Pytree of PartitionSpec for a ShardedOptimizer state: (n, k)
+    leaves shard their leading dim over the data-parallel axis (one row
+    per rank), scalars (e.g. Adam's count) replicate. Pass as the
+    state's in_specs/out_specs in shard_map."""
+    from jax.sharding import PartitionSpec as P
+
+    axes = collectives._resolve_axis(axis_name)
+    ax = axes[0] if axes else "hvd"
+    n = _world(axis_name)
+
+    def spec(leaf):
+        if hasattr(leaf, "ndim") and leaf.ndim == 2 and leaf.shape[0] == n:
+            return P(ax)
+        return P()
+
+    return jax.tree_util.tree_map(spec, state)
