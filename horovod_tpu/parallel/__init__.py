@@ -16,7 +16,9 @@ from .ulysses import (  # noqa: F401
 )
 from .pipeline import (  # noqa: F401
     gpipe,
+    one_f_one_b,
     pipeline_lm_apply,
+    pipeline_lm_train_step_1f1b,
     stack_block_params,
     unstack_block_params,
 )
