@@ -102,6 +102,7 @@ from .optim import (  # noqa: F401
     broadcast_object,
     broadcast_optimizer_state,
     broadcast_parameters,
+    reshard_state,
     sharded_state_specs,
 )
 

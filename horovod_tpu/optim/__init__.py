@@ -10,4 +10,8 @@ from .functions import (  # noqa: F401
     broadcast_optimizer_state,
     broadcast_parameters,
 )
-from .zero import ShardedOptimizer, sharded_state_specs  # noqa: F401
+from .zero import (  # noqa: F401
+    ShardedOptimizer,
+    reshard_state,
+    sharded_state_specs,
+)

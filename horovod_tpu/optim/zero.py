@@ -138,6 +138,51 @@ def ShardedOptimizer(optimizer, axis_name=None):
     return optax.GradientTransformationExtraArgs(init_fn, update_fn)
 
 
+def reshard_state(state, params, old_world: int, new_world: int):
+    """Re-shard a ShardedOptimizer state across a world-size change
+    (elastic resize: the reference's elastic reset re-broadcasts
+    optimizer state, common/elastic.py — here the state LAYOUT is
+    world-size-dependent, so a resize must re-slice it). `params` (the
+    pytree the optimizer was built for) supplies the true flat length:
+    the new shard width must be ceil(size / new_world) — exactly what
+    update_fn will recompute from the gradients — NOT a re-split of the
+    padded old layout, whose tail zeros would shift every boundary.
+    Shapes only, no collectives: call it on the restored host-side
+    state inside the elastic reset callback before re-entering the
+    train loop."""
+    if old_world == new_world:
+        return state
+    if old_world <= 1 or new_world <= 1:
+        raise ValueError(
+            "reshard_state converts between sharded layouts; a size-1 "
+            "world uses the plain (unsharded) inner state — re-init "
+            "the optimizer instead")
+    size = _flat_size(params)
+    k1 = -(-size // old_world)
+    k2 = -(-size // new_world)
+    matched = [0]
+
+    def leaf(s):
+        if not (hasattr(s, "ndim") and s.ndim == 2
+                and s.shape == (old_world, k1)):
+            return s
+        matched[0] += 1
+        flat = s.reshape(-1)[:size]
+        out = jnp.zeros((new_world * k2,), flat.dtype)
+        out = out.at[:size].set(flat)
+        return out.reshape(new_world, k2)
+
+    out = jax.tree_util.tree_map(leaf, state)
+    if not matched[0]:
+        # a wrong old_world / params would otherwise pass the stale
+        # layout through silently and fail far away in shard_map
+        raise ValueError(
+            f"no state leaf has the ({old_world}, {k1}) layout implied "
+            f"by old_world={old_world} and these params — wrong "
+            "old_world, wrong params, or not a ShardedOptimizer state")
+    return out
+
+
 def sharded_state_specs(state, axis_name=None):
     """Pytree of PartitionSpec for a ShardedOptimizer state: (n, k)
     leaves shard their leading dim over the data-parallel axis (one row

@@ -124,3 +124,49 @@ def test_update_outside_mesh_raises():
     g = jax.tree_util.tree_map(jnp.ones_like, params)
     with pytest.raises(RuntimeError, match="shard_map"):
         opt.update(g, state, params)
+
+
+def test_reshard_state_across_world_sizes(monkeypatch):
+    """Elastic resize: (n1, k1) state re-slices to (n2, k2) with
+    k2 = ceil(size/n2) — the exact width update_fn recomputes from the
+    grads — and every parameter's slot value survives the move."""
+    import horovod_tpu.ops.collectives as coll
+    from horovod_tpu.optim.zero import reshard_state
+
+    hvd.init()
+    rng = np.random.RandomState(1)
+    params = {"w": jnp.asarray(rng.randn(13, 7).astype(np.float32)),
+              "b": jnp.asarray(rng.randn(9).astype(np.float32))}
+    size = 13 * 7 + 9  # 100, not divisible by either world size
+
+    monkeypatch.setattr(coll, "_group_size", lambda ps, ax: 8)
+    opt = hvd.ShardedOptimizer(optax.adam(0.01))
+    s8 = opt.init(params)
+    # stamp recognizable values into the (n, k) slots
+    flat_vals = jnp.arange(size, dtype=jnp.float32)
+    k1 = -(-size // 8)
+    mu = jnp.zeros((8 * k1,)).at[:size].set(flat_vals).reshape(8, k1)
+    s8 = jax.tree_util.tree_map(
+        lambda l: mu if (hasattr(l, "shape") and l.shape == (8, k1))
+        else l, s8)
+
+    s4 = reshard_state(s8, params, 8, 4)
+    k2 = -(-size // 4)
+    for l in jax.tree_util.tree_leaves(s4):
+        if hasattr(l, "ndim") and l.ndim == 2:
+            assert l.shape == (4, k2)
+            np.testing.assert_array_equal(
+                np.asarray(l).reshape(-1)[:size], np.asarray(flat_vals))
+    # round trip back
+    s8b = reshard_state(s4, params, 4, 8)
+    for l in jax.tree_util.tree_leaves(s8b):
+        if hasattr(l, "ndim") and l.ndim == 2:
+            assert l.shape == (8, k1)
+            np.testing.assert_array_equal(
+                np.asarray(l).reshape(-1)[:size], np.asarray(flat_vals))
+
+    with pytest.raises(ValueError, match="size-1"):
+        reshard_state(s8, params, 8, 1)
+    # wrong old_world must fail loudly, not pass the stale layout
+    with pytest.raises(ValueError, match="no state leaf"):
+        reshard_state(s8, params, 16, 4)
