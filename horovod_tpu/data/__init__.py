@@ -11,5 +11,6 @@ from .data_loader_base import (  # noqa: F401
     AsyncDataLoaderMixin,
     BaseDataLoader,
     ShardedDataLoader,
+    device_prefetch,
 )
 from .sampler import ElasticSampler  # noqa: F401

@@ -136,3 +136,60 @@ class ShardedDataLoader(BaseDataLoader):
             yield jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, sharding), batch
             )
+
+
+def device_prefetch(iterator, sharding=None, size: int = 2):
+    """Keep `size` batches resident on (or in flight to) the device
+    ahead of the consumer, overlapping the host→device transfer with
+    the current step's compute.
+
+    The TPU-side complement to AsyncDataLoaderMixin: the mixin's queue
+    hides host-side batch PREPARATION behind compute, but each batch
+    still pays its host→device hop synchronously at consumption time.
+    `jax.device_put` is asynchronous — it returns immediately while the
+    DMA proceeds — so enqueueing the NEXT batch's transfer before the
+    current one is consumed hides that hop too (the flax
+    `prefetch_to_device` idiom). Works on any pytree of host arrays;
+    pass a `NamedSharding` (e.g. batch over the dp axis) to land shards
+    directly on their devices.
+
+        loader = ShardedDataLoader(batches, mesh)   # or any iterable
+        for batch in device_prefetch(iter(loader), size=2):
+            params, state, loss = step(params, state, batch)
+    """
+    import collections
+
+    import jax
+    import numpy as _np
+
+    buf = collections.deque()
+
+    def put_leaf(x):
+        # the batch sharding only fits leaves it can actually partition;
+        # scalars and ride-along arrays with incompatible leading dims
+        # (position ids, odd-shaped masks) land replicated instead of
+        # crashing the whole batch
+        if sharding is not None and _np.ndim(x) >= 1:
+            try:
+                sharding.shard_shape(_np.shape(x))
+                return jax.device_put(x, sharding)
+            except (ValueError, ZeroDivisionError):
+                pass
+        return jax.device_put(x)
+
+    def put(b):
+        return jax.tree_util.tree_map(put_leaf, b)
+
+    if size <= 0:
+        # no lookahead, but the placement contract still holds — size
+        # only controls how many transfers run ahead of the consumer
+        for b in iterator:
+            yield put(b)
+        return
+
+    for b in iterator:
+        buf.append(put(b))
+        if len(buf) >= size:
+            yield buf.popleft()
+    while buf:
+        yield buf.popleft()

@@ -309,3 +309,53 @@ def test_elastic_callbacks_commit_and_cursors(hvd8):
     cb_commit.on_epoch_end(0)
     assert state.epoch == 1 and state.batch == 0
     assert len(commits) == 3
+
+
+def test_device_prefetch_orders_and_places(hvd8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.data import device_prefetch
+
+    sh = NamedSharding(hvd.mesh(), P("hvd"))
+    batches = [{"x": np.full((16, 4), i, np.float32),
+                "n": np.int32(i)} for i in range(5)]
+    out = list(device_prefetch(iter(batches), sharding=sh, size=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        assert isinstance(b["x"], jax.Array)
+        assert b["x"].sharding == sh
+        np.testing.assert_allclose(np.asarray(b["x"]), batches[i]["x"])
+        assert int(b["n"]) == i
+
+
+def test_device_prefetch_zero_size_still_places(hvd8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.data import device_prefetch
+
+    sh = NamedSharding(hvd.mesh(), P("hvd"))
+    src = [np.ones((16, 2), np.float32) * i for i in range(3)]
+    out = list(device_prefetch(iter(src), sharding=sh, size=0))
+    assert [int(b[0, 0]) for b in out] == [0, 1, 2]
+    # size=0 disables the lookahead only — placement still applies
+    assert all(isinstance(b, jax.Array) and b.sharding == sh
+               for b in out)
+
+
+def test_device_prefetch_incompatible_leaf_rides_replicated(hvd8):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.data import device_prefetch
+
+    sh = NamedSharding(hvd.mesh(), P("hvd"))
+    # 'pos' has a leading dim (10) the 8-way batch sharding cannot
+    # split: it must land replicated, not crash the batch
+    batches = [{"x": np.ones((16, 4), np.float32),
+                "pos": np.arange(10)}]
+    (b,) = list(device_prefetch(iter(batches), sharding=sh, size=2))
+    assert b["x"].sharding == sh
+    assert isinstance(b["pos"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(b["pos"]), np.arange(10))
