@@ -74,3 +74,17 @@ def test_llama_adasum_flash_remat_converges():
          "--flash", "--remat"]
     )
     assert last < first - 0.3, (first, last)
+
+
+def test_pipeline_pretraining_1f1b_learns():
+    first, last = _load("pipeline_pretraining").main(
+        ["--steps", "14", "--pp", "2", "--microbatches", "4",
+         "--layers", "2", "--seq-len", "64"])
+    assert last < first - 0.5, (first, last)
+
+
+def test_pipeline_pretraining_gpipe_learns():
+    first, last = _load("pipeline_pretraining").main(
+        ["--schedule", "gpipe", "--steps", "14", "--pp", "2",
+         "--microbatches", "4", "--layers", "2", "--seq-len", "64"])
+    assert last < first - 0.5, (first, last)
