@@ -148,6 +148,91 @@ def _backward_availability_order(paths) -> List[int]:
     return sorted(range(len(paths)), key=lambda i: keys[i])
 
 
+def pytree_bucket_plan(tree, threshold_bytes: int | None = None,
+                       backward_order: bool | None = None):
+    """Data-free bucketization: the same grouping flatten_pytree_buckets
+    applies, computed from leaf shapes/dtypes only (no concatenation,
+    no device work — reshard paths need just the bucket lengths).
+    Returns (treedef, plans) where `plans` is one list per bucket of
+    (leaf_idx, offset, size, shape) tuples. Deterministic in (pytree
+    structure, leaf shapes/dtypes, threshold, ordering) — the property
+    that lets init/update/reshard agree on a layout."""
+    if threshold_bytes is None:
+        threshold_bytes = _threshold_bytes()
+    if backward_order is None:
+        from ..core.state import global_state
+
+        backward_order = global_state().knobs.bucket_backward_order
+
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [l for _, l in paths_leaves]
+    if backward_order:
+        order = _backward_availability_order(
+            [p for p, _ in paths_leaves])
+    else:
+        order = range(len(leaves))
+
+    def _dtype(leaf):
+        return np.dtype(getattr(leaf, "dtype", None)
+                        or np.asarray(leaf).dtype)
+
+    by_dtype: dict = {}
+    for i in order:
+        by_dtype.setdefault(_dtype(leaves[i]), []).append(i)
+
+    plans = []
+    for dtype, idxs in by_dtype.items():
+        itemsize = dtype.itemsize
+        cur_plan, cur_bytes, off = [], 0, 0
+
+        def flush():
+            nonlocal cur_plan, cur_bytes, off
+            if cur_plan:
+                plans.append(cur_plan)
+            cur_plan, cur_bytes, off = [], 0, 0
+
+        for i in idxs:
+            shape = jnp.shape(leaves[i])
+            size = int(np.prod(shape)) if shape else 1
+            nbytes = size * itemsize
+            if cur_plan and cur_bytes + nbytes > threshold_bytes:
+                flush()
+            cur_plan.append((i, off, size, shape))
+            off += size
+            cur_bytes += nbytes
+        flush()
+    _record_fusion(len(leaves), len(plans), threshold_bytes)
+    return treedef, plans
+
+
+def pack_pytree_by_plan(tree, plan):
+    """Pack `tree`'s leaves into buckets following a pytree_bucket_plan
+    (possibly computed from a DIFFERENT tree of the same structure —
+    e.g. grads packed by the params' plan, so a grad-dtype cast can
+    never shift the bucket boundaries the optimizer state was laid out
+    with). Returns (buckets, unflatten)."""
+    treedef, plans = plan
+    leaves = jax.tree_util.tree_leaves(tree)
+
+    buckets = []
+    for bplan in plans:
+        flats = [jnp.asarray(leaves[i]).reshape(-1)
+                 for (i, _, _, _) in bplan]
+        buckets.append(
+            jnp.concatenate(flats) if len(flats) > 1 else flats[0])
+
+    def unflatten(reduced_buckets):
+        new_leaves = [None] * len(leaves)
+        for bucket, bplan in zip(reduced_buckets, plans):
+            for (i, off, n, shape) in bplan:
+                new_leaves[i] = jax.lax.dynamic_slice_in_dim(
+                    bucket, off, n
+                ).reshape(shape)
+        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    return buckets, unflatten
+
+
 def flatten_pytree_buckets(tree, threshold_bytes: int | None = None,
                            backward_order: bool | None = None):
     """Bucket an arbitrary pytree (e.g. a grad pytree) for fused reduction.
@@ -165,58 +250,5 @@ def flatten_pytree_buckets(tree, threshold_bytes: int | None = None,
     backward. It decides which bucket the ordered-bucket chain releases
     first and therefore how much backward compute the collectives can
     overlap (tests/test_overlap_schedule.py)."""
-    if threshold_bytes is None:
-        threshold_bytes = _threshold_bytes()
-    if backward_order is None:
-        from ..core.state import global_state
-
-        backward_order = global_state().knobs.bucket_backward_order
-
-    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    leaves = [l for _, l in paths_leaves]
-    if backward_order:
-        order = _backward_availability_order(
-            [p for p, _ in paths_leaves])
-    else:
-        order = range(len(leaves))
-    by_dtype: dict = {}
-    for i in order:
-        by_dtype.setdefault(jnp.asarray(leaves[i]).dtype, []).append(i)
-
-    buckets = []
-    plan = []  # list of (leaf_idx, offset, size, shape) per bucket
-    for dtype, idxs in by_dtype.items():
-        itemsize = np.dtype(dtype).itemsize
-        cur, cur_bytes, cur_plan, off = [], 0, [], 0
-
-        def flush():
-            nonlocal cur, cur_bytes, cur_plan, off
-            if cur:
-                buckets.append(jnp.concatenate(cur) if len(cur) > 1 else cur[0])
-                plan.append(cur_plan)
-            cur, cur_bytes, cur_plan, off = [], 0, [], 0
-
-        n_buckets_before = len(buckets)
-        for i in idxs:
-            a = jnp.asarray(leaves[i]).reshape(-1)
-            nbytes = a.size * itemsize
-            if cur and cur_bytes + nbytes > threshold_bytes:
-                flush()
-            cur_plan.append((i, off, a.size, jnp.shape(leaves[i])))
-            cur.append(a)
-            off += a.size
-            cur_bytes += nbytes
-        flush()
-        _record_fusion(len(idxs), len(buckets) - n_buckets_before,
-                       threshold_bytes)
-
-    def unflatten(reduced_buckets):
-        new_leaves = [None] * len(leaves)
-        for bucket, bplan in zip(reduced_buckets, plan):
-            for (i, off, n, shape) in bplan:
-                new_leaves[i] = jax.lax.dynamic_slice_in_dim(
-                    bucket, off, n
-                ).reshape(shape)
-        return jax.tree_util.tree_unflatten(treedef, new_leaves)
-
-    return buckets, unflatten
+    return pack_pytree_by_plan(
+        tree, pytree_bucket_plan(tree, threshold_bytes, backward_order))

@@ -3,19 +3,23 @@
 SURVEY §2.5 frames the reference's first-class reducescatter/allgather
 as "ZeRO-style building blocks" (reference operations.cc:1725,1532) —
 but the reference stops at the blocks; users hand-roll the optimizer.
-On TPU the composition is one psum_scatter and one all_gather riding
-ICI, so this module ships it:
+On TPU the composition is reduce-scatter + all-gather riding ICI, so
+this module ships it:
 
-  * the flat gradient is reduce-scattered so each rank owns 1/N of it
-    (the reduction does allreduce-equivalent bytes, split across the
-    two collectives);
-  * the inner optax optimizer updates ONLY that shard — its state
-    (Adam's m/v, momentum, ...) lives sharded, cutting optimizer-state
-    HBM by the world size (BERT-L Adam fp32 m+v: 2.7 GB → 334 MB on 8
-    chips);
-  * the resulting update shard is all-gathered back so `update()`
-    still returns a full updates pytree (drop-in optax contract, same
-    call shape as DistributedOptimizer).
+  * gradients are packed into the same backward-availability-ordered
+    fusion buckets the all-reduce path uses (ops/fusion.py), and each
+    bucket is `psum_scatter`'d — chained through optimization_barrier
+    (knobs.ordered_buckets) so bucket k's reduce-scatter can issue
+    while backward for earlier layers is still computing, the SAME
+    comm/compute-overlap structure as DistributedOptimizer
+    (docs/benchmarks.md);
+  * the inner optax optimizer updates ONLY this rank's shard of each
+    bucket — its state (Adam's m/v, momentum, ...) lives sharded,
+    cutting optimizer-state HBM by the world size (BERT-L Adam fp32
+    m+v: 2.7 GB → 334 MB on 8 chips);
+  * the update shards are all-gathered back so `update()` still
+    returns a full updates pytree (drop-in optax contract, same call
+    shape as DistributedOptimizer).
 
 Usage (single-controller SPMD, inside shard_map like
 DistributedOptimizer):
@@ -33,6 +37,13 @@ DistributedOptimizer):
                           in_specs=(P(), specs, P("hvd"), P("hvd")),
                           out_specs=(P(), specs, ...), check_vma=False))
 
+State layout: the inner optimizer is initialized on a LIST of
+per-bucket `(n, k_i)` arrays (`k_i = ceil(bucket_len / n)`, row r =
+rank r's shard), so its array-shaped state leaves mirror that list.
+The bucketization is deterministic in (pytree structure, dtypes,
+fusion threshold, bucket ordering), which is what makes init/update/
+reshard agree on the layout.
+
 Constraints (documented, asserted): the inner optimizer must be
 elementwise in its state (adam/adamw/sgd/momentum/rmsprop... — anything
 whose state leaves mirror the flat parameter vector); factored-state
@@ -43,7 +54,6 @@ this way. One live data-parallel axis.
 from __future__ import annotations
 
 import jax
-import jax.flatten_util
 import jax.numpy as jnp
 
 from ..ops import collectives
@@ -59,97 +69,142 @@ def _live_axis(axis_name):
     return live[0] if live else None
 
 
-def _flat_size(params) -> int:
-    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
-
-
 def _world(axis_name) -> int:
     n = collectives._group_size(None, axis_name)
     return max(int(n), 1)
 
 
-def ShardedOptimizer(optimizer, axis_name=None):
+def _plan(params, threshold_bytes, backward_order=None):
+    """The layout authority: ALWAYS computed from the params pytree
+    (data-free), so a grad-dtype cast (bf16 grads on fp32 params) can
+    never shift bucket boundaries away from the state layout."""
+    from ..ops.fusion import pytree_bucket_plan
+
+    return pytree_bucket_plan(params, threshold_bytes=threshold_bytes,
+                              backward_order=backward_order)
+
+
+def _pack(tree, plan):
+    from ..ops.fusion import pack_pytree_by_plan
+
+    return pack_pytree_by_plan(tree, plan)
+
+
+def _pad_rows(b, n):
+    """1-D bucket → (n, k) rows, zero-padded; row r is rank r's shard."""
+    k = -(-int(b.size) // n)
+    out = jnp.zeros((n * k,), b.dtype).at[: b.size].set(b)
+    return out.reshape(n, k)
+
+
+def ShardedOptimizer(optimizer, axis_name=None,
+                     fusion_threshold_bytes=None,
+                     bucket_backward_order=None):
     """Wrap an elementwise optax optimizer so its state is sharded 1/N
     per rank (ZeRO stage 1). Returns an optax GradientTransformation
-    whose `update()` reduce-scatters gradients, updates the local
-    shard, and all-gathers the updates."""
+    whose `update()` reduce-scatters gradient buckets (backward-ordered,
+    overlap-chained), updates the local shards, and all-gathers the
+    updates. `fusion_threshold_bytes` / `bucket_backward_order` default
+    to the global knobs, like DistributedOptimizer — pin them
+    explicitly when the state must be restorable in a process whose
+    knobs may differ (see reshard_state)."""
     import optax
 
-    def _shapes(params):
-        n = _world(axis_name)
-        size = _flat_size(params)
-        k = -(-size // n)  # ceil: per-rank shard length
-        return n, size, k
-
     def init_fn(params):
-        n, size, k = _shapes(params)
+        n = _world(axis_name)
         if n <= 1:
             return optimizer.init(params)
-        flat, _ = jax.flatten_util.ravel_pytree(params)
-        padded = jnp.zeros((n * k,), flat.dtype).at[:size].set(flat)
-        # (n, k): row r is rank r's parameter shard. Outside jit this is
-        # a global array; under jit, sharded_state_specs() places one
-        # row per device — the actual N x memory saving.
-        return optimizer.init(padded.reshape(n, k))
+        bs, _ = _pack(params, _plan(params, fusion_threshold_bytes,
+                                    bucket_backward_order))
+        return optimizer.init([_pad_rows(b, n) for b in bs])
 
     def update_fn(grads, state, params=None, **extra):
-        n, size, k = _shapes(grads)
+        n = _world(axis_name)
         if n <= 1:
             return optimizer.update(grads, state, params, **extra)
         if params is None:
             raise ValueError(
                 "ShardedOptimizer.update requires params (the local "
-                "parameter shard is sliced from them)")
+                "parameter shards are sliced from them)")
         ax = _live_axis(axis_name)
         if ax is None:
             raise RuntimeError(
                 "ShardedOptimizer.update must run inside shard_map/jit "
                 "with the data-parallel mesh axis bound (it issues "
                 "psum_scatter/all_gather)")
-        flat_g, _ = jax.flatten_util.ravel_pytree(grads)
-        flat_p, unravel = jax.flatten_util.ravel_pytree(params)
-        pad_g = jnp.zeros((n * k,), flat_g.dtype).at[:size].set(flat_g)
-        # reduce-scatter: rank r receives the SUM over ranks of block r
-        g_shard = jax.lax.psum_scatter(
-            pad_g, ax, scatter_dimension=0, tiled=True) / n
-        r = jax.lax.axis_index(ax)
-        p_shard = jax.lax.dynamic_slice(
-            jnp.zeros((n * k,), flat_p.dtype).at[:size].set(flat_p),
-            (r * k,), (k,))
-        # state rows arrive (1, k) per device via sharded_state_specs;
-        # flatten to (k,) for the inner elementwise update
-        local_state = jax.tree_util.tree_map(
-            lambda s: s.reshape(-1) if _is_sharded_leaf(s, k) else s,
-            state)
-        upd_shard, new_local = optimizer.update(
-            g_shard, local_state, p_shard, **extra)
-        new_state = jax.tree_util.tree_map(
-            lambda s: s.reshape(1, -1) if (
-                hasattr(s, "ndim") and s.ndim == 1 and s.size == k
-            ) else s,
-            new_local)
-        upd_full = jax.lax.all_gather(upd_shard, ax, tiled=True)[:size]
-        return unravel(upd_full), new_state
+        plan = _plan(params, fusion_threshold_bytes,
+                     bucket_backward_order)
+        gb, unflatten = _pack(grads, plan)
+        pb, _ = _pack(params, plan)
+        from ..core.state import global_state
 
-    def _is_sharded_leaf(s, k):
-        return (hasattr(s, "ndim") and s.ndim == 2
-                and s.shape[-1] == k and s.shape[0] == 1)
+        ordered = global_state().knobs.ordered_buckets and len(gb) > 1
+        r = jax.lax.axis_index(ax)
+
+        # chained per-bucket reduce-scatter: bucket j's collective
+        # depends only on ITS gradients (+ the chain edge), so it
+        # issues while backward for later buckets still computes —
+        # the same structural overlap as optim/distributed.py's
+        # all-reduce chain, asserted in tests/test_zero.py
+        g_shards, prev = [], None
+        for b in gb:
+            rows = _pad_rows(b, n)
+            if ordered and prev is not None:
+                rows, _ = jax.lax.optimization_barrier((rows, prev))
+            s = jax.lax.psum_scatter(
+                rows.reshape(-1), ax, scatter_dimension=0,
+                tiled=True) / n
+            prev = s
+            g_shards.append(s)
+        p_shards = [
+            jax.lax.dynamic_slice_in_dim(
+                _pad_rows(b, n).reshape(-1), r * _k(b, n), _k(b, n))
+            for b in pb
+        ]
+        # state rows arrive (1, k_i) per device via sharded_state_specs;
+        # flatten to (k_i,) for the inner elementwise update
+        local_state = jax.tree_util.tree_map(
+            lambda s: s.reshape(-1) if (
+                hasattr(s, "ndim") and s.ndim == 2 and s.shape[0] == 1
+            ) else s,
+            state)
+        upd_shards, new_local = optimizer.update(
+            g_shards, local_state, p_shards, **extra)
+        # restore each leaf to its incoming row shape (template = the
+        # incoming state, so no shape sniffing)
+        new_state = jax.tree_util.tree_map(
+            lambda nl, ol: nl.reshape(ol.shape) if (
+                hasattr(ol, "ndim") and ol.ndim == 2
+            ) else nl,
+            new_local, state)
+        reduced = [
+            jax.lax.all_gather(s, ax, tiled=True)[: b.size]
+            for s, b in zip(upd_shards, gb)
+        ]
+        return unflatten(reduced), new_state
 
     return optax.GradientTransformationExtraArgs(init_fn, update_fn)
 
 
-def reshard_state(state, params, old_world: int, new_world: int):
+def _k(b, n) -> int:
+    return -(-int(b.size) // n)
+
+
+def reshard_state(state, params, old_world: int, new_world: int,
+                  fusion_threshold_bytes=None, bucket_backward_order=None):
     """Re-shard a ShardedOptimizer state across a world-size change
     (elastic resize: the reference's elastic reset re-broadcasts
     optimizer state, common/elastic.py — here the state LAYOUT is
     world-size-dependent, so a resize must re-slice it). `params` (the
-    pytree the optimizer was built for) supplies the true flat length:
-    the new shard width must be ceil(size / new_world) — exactly what
-    update_fn will recompute from the gradients — NOT a re-split of the
-    padded old layout, whose tail zeros would shift every boundary.
-    Shapes only, no collectives: call it on the restored host-side
-    state inside the elastic reset callback before re-entering the
-    train loop."""
+    pytree the optimizer was built for) plus the SAME fusion threshold
+    and bucket ordering the state was built under reproduce the
+    bucketization (both default to the live knobs — pass them
+    explicitly when restoring in a process whose knobs may differ from
+    the saving process's), so each `(old_world, k_i)` leaf is re-sliced
+    to the `(new_world, k_i')` grid the new world's update step will
+    recompute. Shapes only — the plan is data-free and no collectives
+    run — so call it on the restored host-side state inside the elastic
+    reset callback before re-entering the train loop."""
     if old_world == new_world:
         return state
     if old_world <= 1 or new_world <= 1:
@@ -157,34 +212,52 @@ def reshard_state(state, params, old_world: int, new_world: int):
             "reshard_state converts between sharded layouts; a size-1 "
             "world uses the plain (unsharded) inner state — re-init "
             "the optimizer instead")
-    size = _flat_size(params)
-    k1 = -(-size // old_world)
-    k2 = -(-size // new_world)
+    _, plans = _plan(params, fusion_threshold_bytes,
+                     backward_order=bucket_backward_order)
+    lens = [sum(n for (_, _, n, _) in bp) for bp in plans]
+    k_old = [-(-L // old_world) for L in lens]
+    k_new = [-(-L // new_world) for L in lens]
     matched = [0]
 
-    def leaf(s):
+    def leaf(path, s):
         if not (hasattr(s, "ndim") and s.ndim == 2
-                and s.shape == (old_world, k1)):
+                and s.shape[0] == old_world):
             return s
+        # the bucket index is the state leaf's position in the list
+        # mirroring the params proxy — the last SequenceKey in its path
+        idx = None
+        for key in reversed(path):
+            if isinstance(key, jax.tree_util.SequenceKey):
+                idx = key.idx
+                break
+        if idx is None or idx >= len(lens) or \
+                s.shape != (old_world, k_old[idx]):
+            raise ValueError(
+                f"state leaf at {jax.tree_util.keystr(path)} has shape "
+                f"{s.shape}, which does not match bucket {idx} of the "
+                f"({old_world}-world, threshold-derived) layout — wrong "
+                "old_world, wrong params, or a different fusion "
+                "threshold than the state was built with")
         matched[0] += 1
-        flat = s.reshape(-1)[:size]
-        out = jnp.zeros((new_world * k2,), flat.dtype)
-        out = out.at[:size].set(flat)
-        return out.reshape(new_world, k2)
+        flat = s.reshape(-1)[: lens[idx]]
+        out = jnp.zeros((new_world * k_new[idx],), flat.dtype)
+        out = out.at[: lens[idx]].set(flat)
+        return out.reshape(new_world, k_new[idx])
 
-    out = jax.tree_util.tree_map(leaf, state)
+    out = jax.tree_util.tree_map_with_path(leaf, state)
     if not matched[0]:
         # a wrong old_world / params would otherwise pass the stale
         # layout through silently and fail far away in shard_map
         raise ValueError(
-            f"no state leaf has the ({old_world}, {k1}) layout implied "
-            f"by old_world={old_world} and these params — wrong "
-            "old_world, wrong params, or not a ShardedOptimizer state")
+            f"no state leaf has the {old_world}-row bucketed layout "
+            f"implied by old_world={old_world} and these params — "
+            "wrong old_world, wrong params, or not a ShardedOptimizer "
+            "state")
     return out
 
 
 def sharded_state_specs(state, axis_name=None):
-    """Pytree of PartitionSpec for a ShardedOptimizer state: (n, k)
+    """Pytree of PartitionSpec for a ShardedOptimizer state: (n, k_i)
     leaves shard their leading dim over the data-parallel axis (one row
     per rank), scalars (e.g. Adam's count) replicate. Pass as the
     state's in_specs/out_specs in shard_map."""

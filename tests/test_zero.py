@@ -85,6 +85,7 @@ def test_state_is_sharded_one_row_per_rank():
     state = opt.init(params)
     n = hvd.size()
     size = sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
+    # default threshold (128 MB) >> this model: one bucket, k=ceil(P/n)
     k = -(-size // n)
     big = [l for l in jax.tree_util.tree_leaves(state)
            if hasattr(l, "ndim") and l.ndim == 2]
@@ -96,6 +97,59 @@ def test_state_is_sharded_one_row_per_rank():
         specs, is_leaf=lambda s: isinstance(s, P))
     assert P("hvd") in spec_leaves  # m/v shard
     assert P() in spec_leaves      # adam count replicates
+
+
+def test_sharded_multibucket_matches_allreduce_training():
+    """A tiny fusion threshold forces several backward-ordered buckets
+    (the overlap-chained reduce-scatter path); the math must still be
+    exactly the allreduce step's."""
+    mesh, params, x, y = _world()
+    zopt = hvd.ShardedOptimizer(optax.adam(0.05),
+                                fusion_threshold_bytes=256)
+    zstate = zopt.init(params)
+    # multiple buckets actually materialized
+    assert sum(1 for l in jax.tree_util.tree_leaves(zstate)
+               if hasattr(l, "ndim") and l.ndim == 2) > 2
+    zspecs = hvd.sharded_state_specs(zstate)
+    p_zero, l_zero = _run_steps(mesh, zopt, zspecs, params, x, y)
+
+    dopt = hvd.DistributedOptimizer(optax.adam(0.05))
+    p_ref, l_ref = _run_steps(mesh, dopt, P(), params, x, y)
+    assert l_zero == pytest.approx(l_ref, rel=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                atol=1e-6),
+        p_zero, p_ref)
+
+
+def test_sharded_buckets_stay_separate_in_hlo():
+    """The chained per-bucket reduce-scatters must survive as separate
+    collectives in the lowered step (the overlap property: bucket j's
+    scatter depends only on its own gradients + the chain edge) —
+    mirror of test_overlap_schedule's level-1 assertion for the
+    allreduce path."""
+    mesh, params, x, y = _world()
+    opt = hvd.ShardedOptimizer(optax.adam(0.05),
+                               fusion_threshold_bytes=256)
+    state = opt.init(params)
+    specs = hvd.sharded_state_specs(state)
+
+    def step(p, s, x, y):
+        l, g = jax.value_and_grad(_loss)(p, x, y)
+        upd, s = opt.update(g, s, p)
+        return optax.apply_updates(p, upd), s, jax.lax.pmean(
+            l, "hvd").reshape(1)
+
+    js = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), specs, P("hvd"), P("hvd")),
+        out_specs=(P(), specs, P()), check_vma=False))
+    txt = js.lower(params, state, x, y).as_text()
+    # this model buckets to [s+b], [w] at a 256-byte threshold (w is a
+    # single leaf and cannot split): two scatters, one chain barrier
+    n_rs = txt.count("reduce_scatter")
+    assert n_rs >= 2, f"expected per-bucket reduce-scatters, got {n_rs}"
+    assert "optimization_barrier" in txt
 
 
 def test_single_rank_world_passthrough(monkeypatch):
@@ -142,7 +196,7 @@ def test_reshard_state_across_world_sizes(monkeypatch):
     monkeypatch.setattr(coll, "_group_size", lambda ps, ax: 8)
     opt = hvd.ShardedOptimizer(optax.adam(0.01))
     s8 = opt.init(params)
-    # stamp recognizable values into the (n, k) slots
+    # default threshold: one bucket. Stamp recognizable values into it.
     flat_vals = jnp.arange(size, dtype=jnp.float32)
     k1 = -(-size // 8)
     mu = jnp.zeros((8 * k1,)).at[:size].set(flat_vals).reshape(8, k1)
