@@ -33,15 +33,21 @@ from .core.basics import (  # noqa: F401
     is_homogeneous,
     is_initialized,
     local_rank,
+    local_rank_op,
     local_size,
+    local_size_op,
     mesh,
     mpi_built,
     mpi_enabled,
+    mpi_threads_supported,
     nccl_built,
+    process_set_included_op,
     rank,
+    rank_op,
     rocm_built,
     shutdown,
     size,
+    size_op,
     xla_built,
     xla_enabled,
 )

@@ -343,6 +343,72 @@ def local_rank():
     return 0
 
 
+# In-graph topology queries (reference tensorflow/mpi_ops.py:
+# rank_op/local_rank_op/size_op/local_size_op/process_set_included_op).
+# The reference needs dedicated graph OPS because a captured TF graph
+# outlives world changes; under XLA the topology is compile-time static
+# (elastic resizes re-trace) and rank() is already traced inside
+# shard_map, so these wrap the plain queries as jnp values. Process-set
+# forms resolve through static global→set tables so a TRACED rank still
+# indexes them correctly.
+
+def size_op(process_set_id: int = 0):
+    """Set size as an in-graph value (reference
+    tensorflow/mpi_ops.py size_op(process_set_id=0))."""
+    import jax.numpy as jnp
+
+    if process_set_id != 0:
+        from .process_sets import get_process_set_by_id
+
+        return jnp.int32(get_process_set_by_id(process_set_id).size())
+    return jnp.int32(size())
+
+
+def rank_op(process_set_id: int = 0):
+    """This rank as an in-graph value; with a non-global set, the rank
+    WITHIN that set. Non-member devices get -1 (there is no set-rank
+    for them) — pair with `process_set_included_op` to mask before
+    using the value as an index, as the reference's masking pattern
+    does; a raise is not expressible from inside a traced program."""
+    import jax.numpy as jnp
+
+    r = rank()
+    if process_set_id != 0:
+        from .process_sets import get_process_set_by_id
+
+        ps = get_process_set_by_id(process_set_id)
+        table = [-1] * size()
+        for i, g in enumerate(ps.ranks):
+            table[g] = i
+        return jnp.asarray(table, jnp.int32)[r]
+    return jnp.asarray(r, jnp.int32)
+
+
+def local_size_op():
+    import jax.numpy as jnp
+
+    return jnp.int32(local_size())
+
+
+def local_rank_op():
+    import jax.numpy as jnp
+
+    return jnp.asarray(local_rank(), jnp.int32)
+
+
+def process_set_included_op(process_set_id: int = 0):
+    """1 if this rank belongs to the process set, else 0 (reference
+    tensorflow/mpi_ops.py:571 — used to mask updates on excluded
+    ranks inside a compiled step)."""
+    import jax.numpy as jnp
+
+    from .process_sets import get_process_set_by_id
+
+    ps = get_process_set_by_id(process_set_id)
+    table = [1 if ps.included(g) else 0 for g in range(size())]
+    return jnp.asarray(table, jnp.int32)[rank()]
+
+
 def cross_size() -> int:
     _require_init()
     import jax
@@ -384,6 +450,14 @@ def is_homogeneous() -> bool:
 # compiled in (mpi_built/nccl_built/..., operations.cc:1167-1250). The TPU
 # data plane is always XLA collectives; report capabilities truthfully.
 def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    """Reference common/basics.py:273 — whether MPI was initialized with
+    MPI_THREAD_MULTIPLE. There is no MPI here (XLA collectives + the
+    native TCP control plane, both thread-safe by construction), so the
+    honest parity answer mirrors mpi_built(): False."""
     return False
 
 

@@ -89,3 +89,46 @@ def test_custom_mesh_spec(monkeypatch):
 def test_init_with_comm_rejected():
     with pytest.raises(ValueError):
         hvd.init(comm=object())
+
+
+def test_topology_op_family(hvd8):
+    """In-graph topology queries (reference tensorflow/mpi_ops.py
+    rank_op/size_op/...): plain jnp values eagerly, traced values that
+    resolve per-device inside shard_map."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    assert int(hvd.size_op()) == 8
+    assert int(hvd.local_size_op()) == 8
+    assert int(hvd.rank_op()) == 0  # coordinator-owned outside spmd
+    assert int(hvd.local_rank_op()) == 0
+    assert int(hvd.process_set_included_op(0)) == 1
+
+    ps = hvd.add_process_set([1, 3, 5])
+    try:
+        assert int(hvd.size_op(process_set_id=ps.process_set_id)) == 3
+
+        def f():
+            # traced forms: per-device rank, set-rank table lookup,
+            # inclusion mask
+            return (hvd.rank_op().reshape(1),
+                    hvd.rank_op(ps.process_set_id).reshape(1),
+                    hvd.process_set_included_op(
+                        ps.process_set_id).reshape(1))
+
+        r, sr, inc = jax.jit(jax.shard_map(
+            f, mesh=hvd.mesh(), in_specs=(),
+            out_specs=(P("hvd"), P("hvd"), P("hvd")),
+            check_vma=False))()
+        assert list(r) == list(range(8))
+        assert list(inc) == [0, 1, 0, 1, 0, 1, 0, 0]
+        assert [int(sr[g]) for g in (1, 3, 5)] == [0, 1, 2]
+        # non-members carry the documented -1 sentinel (mask with
+        # process_set_included_op before indexing)
+        assert [int(sr[g]) for g in (0, 2, 4, 6, 7)] == [-1] * 5
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_mpi_threads_supported_parity():
+    assert hvd.mpi_threads_supported() is False
