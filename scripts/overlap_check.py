@@ -36,9 +36,12 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 
-def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip):
+def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
+               zero=False):
     """The REAL train step: same model config, loss, optimizer and
-    sharding as the corresponding examples/ benchmark."""
+    sharding as the corresponding examples/ benchmark. With ``zero``,
+    the ShardedOptimizer (bucketed reduce-scatter) path instead of the
+    all-reduce path."""
     import horovod_tpu as hvd
     from horovod_tpu.models.transformer import (
         BERT_LARGE, GPT2_MEDIUM, Bert, Transformer, TransformerConfig,
@@ -88,10 +91,15 @@ def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip):
     params = jax.eval_shape(
         lambda: model.init(jax.random.PRNGKey(0),
                            jnp.ones((1, T), jnp.int32)))["params"]
-    opt = hvd.DistributedOptimizer(
-        optax.adamw(1e-4), fusion_threshold_bytes=fusion_mb << 20)
+    if zero:
+        opt = hvd.ShardedOptimizer(
+            optax.adamw(1e-4), fusion_threshold_bytes=fusion_mb << 20)
+    else:
+        opt = hvd.DistributedOptimizer(
+            optax.adamw(1e-4), fusion_threshold_bytes=fusion_mb << 20)
     state = jax.eval_shape(lambda: opt.init(jax.tree.map(
         lambda s: jnp.zeros(s.shape, s.dtype), params)))
+    state_specs = hvd.sharded_state_specs(state) if zero else P()
 
     def step(p, s, b):
         l, g = jax.value_and_grad(loss_fn)(p, b)
@@ -100,8 +108,8 @@ def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip):
             l, "hvd").reshape(1)
 
     js = jax.jit(jax.shard_map(
-        step, mesh=mesh, in_specs=(P(), P(), P("hvd")),
-        out_specs=(P(), P(), P()), check_vma=False))
+        step, mesh=mesh, in_specs=(P(), state_specs, P("hvd")),
+        out_specs=(P(), state_specs, P()), check_vma=False))
     return js, params, state, toks_s
 
 
@@ -118,7 +126,7 @@ def _ar_elems(line):
     return n
 
 
-def analyze(txt):
+def analyze(txt, collective="all-reduce"):
     """Schedule + dependency analysis of an optimized
     (is_scheduled=true) module, restricted to the ENTRY computation so
     fusion-body instructions don't pollute the counts.
@@ -143,12 +151,11 @@ def analyze(txt):
     start = next(i for i, l in enumerate(all_lines)
                  if l.startswith("ENTRY"))
     lines = all_lines[start:]
+    coll_re = rf' {collective}(-start)?\('
     ars = [i for i, l in enumerate(lines)
-           if re.search(r' all-reduce(-start)?\(', l)
-           and _ar_elems(l) >= 10_000]
+           if re.search(coll_re, l) and _ar_elems(l) >= 10_000]
     small_ars = [i for i, l in enumerate(lines)
-                 if re.search(r' all-reduce(-start)?\(', l)
-                 and _ar_elems(l) < 10_000]
+                 if re.search(coll_re, l) and _ar_elems(l) < 10_000]
     bwd = [i for i, l in enumerate(lines)
            if "op_name=" in l and "transpose" in l
            and re.search(r' (dot|fusion|convolution|custom-call)\(', l)]
@@ -193,10 +200,18 @@ def analyze(txt):
     }
 
 
-def compile_and_analyze(model, mesh, nchips, fusion_mb, batch_per_chip):
+def compile_and_analyze(model, mesh, nchips, fusion_mb, batch_per_chip,
+                        zero=False):
     js, params, state, toks_s = build_step(
-        model, mesh, nchips, fusion_mb, batch_per_chip)
+        model, mesh, nchips, fusion_mb, batch_per_chip, zero=zero)
     txt = js.lower(params, state, toks_s).compile().as_text()
+    # the ZeRO path's gradient collectives are per-bucket
+    # reduce-scatters in the lowered program, but this XLA TPU build
+    # decomposes reduce-scatter into all-reduce + slice in the
+    # optimized module (verified: 0 reduce-scatter ops, bucket-count
+    # all-reduces), so the schedule analysis reads all-reduces for
+    # both paths; the post-update all-gathers are a separate op name
+    # and never pollute the count
     return analyze(txt)
 
 
@@ -225,6 +240,9 @@ def main(argv=None):
     ap.add_argument("--fusion-mb", type=int, default=128,
                     help="fusion threshold (default = the knob default)")
     ap.add_argument("--batch-per-chip", type=int, default=0)
+    ap.add_argument("--zero", action="store_true",
+                    help="analyze the ShardedOptimizer (ZeRO-1 bucketed "
+                         "reduce-scatter) step instead of all-reduce")
     ap.add_argument("--sweep", action="store_true",
                     help="bucket order x fusion threshold table instead "
                          "of a single artifact")
@@ -268,8 +286,9 @@ def main(argv=None):
         for model in args.model.split(","):
             r = compile_and_analyze(
                 model, mesh, nchips, args.fusion_mb,
-                args.batch_per_chip)
+                args.batch_per_chip, zero=args.zero)
             r.update({
+                "optimizer": "zero" if args.zero else "allreduce",
                 "model": model,
                 "topology": f"{topology} ({nchips} chips, AOT)",
                 "fusion_mb": args.fusion_mb,
