@@ -27,6 +27,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu.compat import shard_map
 from horovod_tpu.models.transformer import (
     GPT2_SMALL,
     Transformer,
@@ -113,7 +114,7 @@ def main(argv=None):
         return p, s, jax.lax.psum(loss, "hvd").reshape(1) / n
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), P(), P("hvd")),
             out_specs=(P(), P(), P()),

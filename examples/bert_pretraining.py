@@ -28,6 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models.transformer import BERT_LARGE, Bert, mlm_loss
+from horovod_tpu.compat import shard_map
 from horovod_tpu.utils.mfu import (
     count_params,
     peak_flops_per_chip,
@@ -138,7 +139,7 @@ def main(argv=None, stats=None):
         return p, s, jax.lax.psum(loss, "hvd").reshape(1) / n
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), state_specs, P("hvd"), P("hvd"), P("hvd")),
             out_specs=(P(), state_specs, P()),
@@ -157,7 +158,7 @@ def main(argv=None, stats=None):
         # re-runs one candidate's step many times on the same buffers);
         # the winning knobs persist for the donating AOT compile below
         def build_step(overrides):
-            js = jax.jit(jax.shard_map(
+            js = jax.jit(shard_map(
                 step_fn, mesh=mesh,
                 in_specs=(P(), state_specs, P("hvd"), P("hvd"),
                           P("hvd")),

@@ -31,6 +31,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu.compat import shard_map
 from horovod_tpu.models.transformer import (
     GPT2_SMALL,
     Transformer,
@@ -51,7 +52,7 @@ def build_step(model, opt, n, mesh):
         return p, s, jax.lax.psum(loss, "hvd").reshape(1) / n
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), P(), P("hvd")),
             out_specs=(P(), P(), P()),

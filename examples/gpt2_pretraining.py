@@ -34,6 +34,7 @@ from horovod_tpu.models.transformer import (
     Transformer,
     causal_lm_loss,
 )
+from horovod_tpu.compat import shard_map
 from horovod_tpu.utils.mfu import (
     count_params,
     peak_flops_per_chip,
@@ -120,7 +121,7 @@ def main(argv=None, stats=None):
         return p, s, jax.lax.psum(loss, "hvd").reshape(1) / n
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), P(), P("hvd")),
             out_specs=(P(), P(), P()),

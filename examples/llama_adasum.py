@@ -39,6 +39,7 @@ from horovod_tpu.models.transformer import (
     causal_lm_loss,
 )
 from horovod_tpu.utils.mfu import count_params
+from horovod_tpu.compat import shard_map
 
 
 def main(argv=None):
@@ -117,7 +118,7 @@ def main(argv=None):
         return p, s, jax.lax.psum(loss, "hvd").reshape(1) / n
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), P(), P("hvd")),
             out_specs=(P(), P(), P()),

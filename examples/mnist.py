@@ -29,6 +29,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu.compat import shard_map
 
 
 class ConvNet(nn.Module):
@@ -103,7 +104,7 @@ def main(argv=None):
         return p, s, metrics
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), P(), P("hvd"), P("hvd")),
             out_specs=(P(), P(), P()),

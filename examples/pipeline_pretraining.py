@@ -88,11 +88,19 @@ def main(argv=None):
     # program trips XLA's partitioner (dedup_meshes sub-axis check).
     # The batch shards over dp (the pipeline shard_maps only make "pp"
     # manual, so XLA auto-partitions the dp dimension — real data
-    # parallelism, not dp-replicated redundant compute).
+    # parallelism, not dp-replicated redundant compute). On legacy jax
+    # the pipeline runs on a pp-only sub-mesh (compat.shard_map's
+    # legacy_submesh fallback), so commit to THAT mesh — jit rejects
+    # arguments on a different device set than an inner shard_map's —
+    # and drop the dp sharding it cannot express.
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    params = jax.device_put(params, NamedSharding(mesh, P()))
-    toks = jax.device_put(toks, NamedSharding(mesh, P("dp")))
+    from horovod_tpu.compat import placement_mesh
+
+    pmesh = placement_mesh(mesh)
+    batch_spec = P("dp") if "dp" in pmesh.axis_names else P()
+    params = jax.device_put(params, NamedSharding(pmesh, P()))
+    toks = jax.device_put(toks, NamedSharding(pmesh, batch_spec))
     opt = optax.adam(args.lr)
     state = opt.init(params)
     M = args.microbatches

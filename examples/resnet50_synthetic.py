@@ -32,6 +32,7 @@ from horovod_tpu.models import (
     InceptionV3, ResNet50, ResNet101, ResNet152, VGG16,
 )
 from horovod_tpu.utils.mfu import cnn_train_flops, peak_flops_per_chip
+from horovod_tpu.compat import shard_map
 
 _MODELS = {
     "resnet50": (ResNet50, 224),
@@ -143,7 +144,7 @@ def main(argv=None, stats=None):
         return p, bs, s, jax.lax.psum(loss, "hvd").reshape(1) / n
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_fn, mesh=mesh,
             in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
             out_specs=(P(), P(), P(), P()),

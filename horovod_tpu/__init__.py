@@ -113,8 +113,10 @@ from .optim import (  # noqa: F401
 )
 
 # Elastic + timeline live under their own namespaces, mirroring
-# hvd.elastic.* and hvd.start_timeline in the reference.
+# hvd.elastic.* and hvd.start_timeline in the reference. Metrics is the
+# live-telemetry namespace (hvd.metrics.step(), hvd.metrics.scrape()).
 from . import callbacks  # noqa: F401
+from .utils import metrics  # noqa: F401
 from .checkpoint import LoadedModel, load_model, save_model  # noqa: F401
 from . import data  # noqa: F401
 from . import elastic  # noqa: F401

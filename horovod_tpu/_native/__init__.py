@@ -413,6 +413,19 @@ class NativeRuntime:
         dims (the 2-D coordinate-descent tuner never explores them)."""
         return bool(self._lib.hvd_native_tuned_bayes())
 
+    def stats(self) -> dict:
+        """One consolidated cumulative-stats snapshot (cache, wire,
+        stalls, coordinator cycle accounting) — the native half of the
+        live telemetry surface (utils/metrics.py); everything here was
+        previously reachable only through separate per-stat calls."""
+        s = {
+            "cache_hits": int(self.cache_hits()),
+            "bytes_negotiated": int(self.bytes_negotiated()),
+            "stall_warnings": int(self.stall_warnings()),
+        }
+        s.update(self.coord_cycle_stats())
+        return s
+
     def coord_cycle_stats(self) -> dict:
         """Coordinator-side cycle accounting (rank 0; zeros elsewhere):
         separates the coordinator's CPU work per cycle from wall-clock

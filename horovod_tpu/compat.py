@@ -1,0 +1,108 @@
+"""JAX version-compatibility shims.
+
+The codebase targets the modern `jax.shard_map` API (top-level export,
+``check_vma=``, ``axis_names=``). Older installs (<= 0.4.x) only ship
+`jax.experimental.shard_map.shard_map` with ``check_rep=`` and express
+partially-manual meshes through ``auto=`` (the complement of
+``axis_names``). Every shard_map call in the library and tests routes
+through this wrapper so one process can run against either API.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+              check_vma: bool = True, axis_names: Optional[frozenset] = None,
+              legacy_submesh: bool = False):
+    """`jax.shard_map` with the modern signature on any supported jax.
+
+    ``check_vma`` maps to the legacy ``check_rep``; ``axis_names`` (the
+    axes the body is manual over) maps to legacy ``auto`` (the axes it
+    is NOT manual over). ``legacy_submesh`` opts a call site into the
+    legacy sub-mesh fallback below — only valid when the ENCLOSING jit
+    never shards anything over the non-manual axes (a shard_map bound to
+    a sub-mesh conflicts with full-mesh-sharded jit arguments).
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, **kw)
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # axis_names is deliberately NOT mapped to legacy ``auto``: the 0.4.x
+    # partial-manual lowering emits a PartitionId instruction the SPMD
+    # partitioner rejects whenever the body uses axis_index (pipeline
+    # schedules, ring attention). And running fully manual over the FULL
+    # mesh is not safe either: 0.4.x jit miscompiles a fully-manual
+    # region whose mesh carries axes the specs never name (the pipeline
+    # on a (pp, dp) mesh computes wrong logits under jit; exact on a
+    # pp-only mesh and exact un-jitted). So when the call site opted in
+    # (legacy_submesh) and the in/out specs reference only the declared
+    # manual axes, run fully manual on the SUB-MESH of exactly those
+    # axes (coordinate 0 on the rest) — the idle axes carried replicated
+    # data anyway, so dropping their replicas is numerically identical.
+    if legacy_submesh and axis_names is not None and mesh is not None:
+        if _spec_axes(in_specs) | _spec_axes(out_specs) <= set(axis_names):
+            mesh = _submesh(mesh, axis_names)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def _submesh(mesh, axis_names):
+    """`mesh` restricted to exactly `axis_names` (coordinate 0 on every
+    other axis); `mesh` itself when nothing is dropped."""
+    unused = [a for a in mesh.axis_names if a not in axis_names]
+    if not unused:
+        return mesh
+    from jax.sharding import Mesh
+
+    take = tuple(
+        slice(None) if a in axis_names else 0
+        for a in mesh.axis_names
+    )
+    return Mesh(
+        mesh.devices[take],
+        tuple(a for a in mesh.axis_names if a in axis_names),
+    )
+
+
+def placement_mesh(mesh, axis_names=frozenset({"pp"})):
+    """The mesh jit arguments feeding a ``legacy_submesh`` shard_map
+    should be committed to (``jax.device_put``). Modern jax: ``mesh``
+    itself. Legacy jax: the sub-mesh of exactly ``axis_names`` — the
+    fallback runs the shard_map there, and jit rejects arguments
+    committed to a different device set than an inner shard_map's.
+    Callers must drop the absent axes from their PartitionSpecs (e.g.
+    ``P("dp") if "dp" in pmesh.axis_names else P()``)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return mesh
+    return _submesh(mesh, axis_names)
+
+
+def _spec_axes(specs) -> set:
+    """Mesh axis names referenced anywhere in a specs pytree."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    names: set = set()
+    for s in jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, PartitionSpec)):
+        if not isinstance(s, PartitionSpec):
+            continue
+        for part in s:
+            if part is None:
+                continue
+            if isinstance(part, (tuple, list)):
+                names.update(part)
+            else:
+                names.add(part)
+    return names

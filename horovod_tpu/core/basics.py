@@ -217,6 +217,13 @@ def init(
             mark_cycles=st.knobs.timeline_mark_cycles,
         )
 
+        # live telemetry (utils/metrics.py): must precede the native
+        # eager runtime so its constructor sees the enabled state and
+        # registers the cycle/cache stats provider
+        from ..utils import metrics
+
+        metrics.configure(st.knobs)
+
         if st.knobs.autotune and not st.knobs.native_eager:
             # compile-time bucket tuner for the SPMD path (single
             # controller — no cross-rank agreement needed). In native
@@ -284,6 +291,9 @@ def shutdown() -> None:
             st.eager_runtime.shutdown()
         if st.timeline is not None:
             st.timeline.close()
+        from ..utils import metrics
+
+        metrics.on_shutdown()
         st.reset()
 
 

@@ -135,6 +135,17 @@ class Knobs:
     # eager semantics don't need negotiation.
     native_eager: bool = False
 
+    # --- metrics / telemetry (utils/metrics.py) ---
+    # live counters/gauges/histograms + /metrics endpoint; off by default
+    # so the disabled fast path is the only cost
+    metrics_enabled: bool = False
+    # JSONL per-step log (canonical env name HOROVOD_TPU_METRICS_FILE;
+    # HVD_TPU_METRICS_FILE / HOROVOD_METRICS_FILE also accepted)
+    metrics_file: str = ""
+    # standalone per-worker GET /metrics port; 0 = don't serve (the
+    # rendezvous KV server mounts /metrics regardless)
+    metrics_port: int = 0
+
     # --- logging ---
     log_level: str = "WARNING"
     log_hide_timestamp: bool = False
@@ -180,6 +191,14 @@ class Knobs:
             reset_limit=_env_int("RESET_LIMIT", 0),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
             native_eager=_env_bool("NATIVE", False),
+            metrics_enabled=_env_bool("METRICS", False),
+            # canonical name first so it wins when both are set
+            metrics_file=(
+                os.environ.get("HOROVOD_TPU_METRICS_FILE", "")
+                or _env("METRICS_FILE")
+                or ""
+            ),
+            metrics_port=_env_int("METRICS_PORT", 0),
             log_level=_env("LOG_LEVEL", "WARNING") or "WARNING",
             log_hide_timestamp=_env_bool("LOG_HIDE_TIME", False),
             mesh_spec=_env("MESH", "") or "",

@@ -188,6 +188,7 @@ def run(func: Callable) -> Callable:
     def wrapper(state: State, *args: Any, **kwargs: Any):
         from ..core import basics
         from ..core.state import global_state
+        from ..utils import metrics
 
         reset_limit = global_state().knobs.reset_limit
         resets = 0
@@ -197,13 +198,17 @@ def run(func: Callable) -> Callable:
                 if notify_needed:
                     state.on_reset()
                     notify_needed = False
+                if resets:  # re-sync after a world change, not first entry
+                    metrics.record_elastic_event("sync")
                 state.sync()
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
+                metrics.record_elastic_event("reset")
                 state.restore()
                 _reinitialize()
                 notify_needed = True
             except HostsUpdatedInterrupt as e:
+                metrics.record_elastic_event("hosts_updated")
                 if not e.skip_sync:
                     _reinitialize()
                 notify_needed = True

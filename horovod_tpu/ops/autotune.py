@@ -219,7 +219,7 @@ class SPMDStepTuner:
         def score(ov):
             return self._time_candidate(build_step, args, {**best, **ov})
 
-        def agree(best):
+        def agree(best, best_t):
             """Multi-controller agreement, after EVERY dimension: each
             rank measured candidates on its own noisy clock, and a
             divergent pick would make the NEXT dimension's candidates
@@ -229,23 +229,29 @@ class SPMDStepTuner:
             are consistent; only the argmin needs agreeing. Rank 0's
             pick wins — the reference broadcasts ParameterManager
             winners from the coordinator the same way
-            (parameter_manager.cc). Single-controller worlds (one
-            process drives the mesh) skip the round trip.
+            (parameter_manager.cc). `best_t` ships WITH the dict: the
+            next dimension's accept/reject compares against the root's
+            baseline for the root's winner, not a time this rank
+            measured for a different (locally-picked) candidate — and
+            _write_log records the best_t that belongs to the pinned
+            winners. Single-controller worlds (one process drives the
+            mesh) skip the round trip.
             """
             from ..core.basics import cross_size, is_initialized
 
             if is_initialized() and cross_size() > 1:
                 from ..optim.functions import broadcast_object
 
-                best = broadcast_object(best, root_rank=0)
-            return best
+                best, best_t = broadcast_object(
+                    (best, best_t), root_rank=0)
+            return best, best_t
 
         # dim 1: bucket size
         timed = {t: score({"fusion_threshold_bytes": t})
                  for t in self._thresholds}
         best["fusion_threshold_bytes"] = min(timed, key=timed.get)
         best_t = timed[best["fusion_threshold_bytes"]]
-        best = agree(best)
+        best, best_t = agree(best, best_t)
 
         # dim 2: ordered chain on/off
         if self._tune_ordered:
@@ -253,7 +259,7 @@ class SPMDStepTuner:
             t = score({"ordered_buckets": flipped})
             if t < best_t:
                 best["ordered_buckets"], best_t = flipped, t
-            best = agree(best)
+            best, best_t = agree(best, best_t)
 
         # dim 3: hierarchical routing
         if self._tune_hier:
@@ -264,7 +270,7 @@ class SPMDStepTuner:
                     best_t = t
                     best["hierarchical_allreduce"] = True
                     best["hierarchical_local_size"] = blk
-            best = agree(best)
+            best, best_t = agree(best, best_t)
 
         self._apply(best)  # pin winners
         self._write_log(best, best_t)

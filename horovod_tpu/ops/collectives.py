@@ -328,7 +328,7 @@ def _build_perrank_program(op_kind: str, mesh, axes, op: int,
     """jit(shard_map) program treating a [world, ...] stack as 'rank i's
     tensor on device i'. `root` is an index along `axes`. Shared by the
     global eager path and the process-set sub-mesh path."""
-    from jax import shard_map
+    from ..compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     # The per-rank stack is laid out [world, ...] and sharded on dim 0, so
@@ -477,6 +477,27 @@ _NATIVE_OPS = {
 }
 
 
+def _record_collective_leaf(op_kind: str, tensor) -> None:
+    """Telemetry for one issued eager collective (utils/metrics.py).
+    Counted at the dispatch site so the /metrics counters equal exactly
+    the collectives this process issued; the traced SPMD path is
+    accounted per executed step instead (optim/distributed.py)."""
+    from ..utils import metrics
+
+    if not metrics.enabled():
+        return
+    if hasattr(tensor, "dtype") and hasattr(tensor, "nbytes"):
+        dtype, nbytes = str(tensor.dtype), int(tensor.nbytes)
+    else:
+        # jnp.result_type, not the numpy dtype: the collective packs via
+        # jnp.asarray, so a python float moves as float32 under default
+        # JAX config while numpy would call (and size) it float64
+        dt = np.dtype(jnp.result_type(tensor))
+        dtype = str(dt)
+        nbytes = int(np.asarray(tensor).size) * dt.itemsize
+    metrics.record_collective(op_kind, dtype, nbytes)
+
+
 def _contains_indexed_slices(tensor) -> bool:
     from .sparse import IndexedSlices
 
@@ -543,6 +564,7 @@ def _native_eager(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
 def _eager_collective(op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
                       postscale=1.0, root_rank=0, process_set=None,
                       name=None):
+    _record_collective_leaf(op_kind, tensor)
     st = global_state()
     ps = process_set
     if ps is not None and ps.process_set_id == 0:
@@ -1054,6 +1076,7 @@ def alltoall(
                         "native runtime; call hvd.add_process_set on "
                         "every rank first (reference process_sets.py:123)"
                     )
+            _record_collective_leaf("alltoall", tensor)
             out, recv = _native_eager(
                 rt, "alltoall", tensor, name=name,
                 splits=[int(s) for s in np.asarray(splits)],
@@ -1071,6 +1094,7 @@ def alltoall(
         n = _group_size(ps, axis_name)
         rank_local = 0 if ps is None else ps.rank(basics.rank())
         x = np.asarray(tensor)
+        _record_collective_leaf("alltoall", x)
         batch = ExecutionBatch(
             batch_id=0, op=OP_ALLTOALL, reduce_op=0, root_rank=0,
             prescale=1.0, postscale=1.0, dtype=str(x.dtype),
@@ -1252,6 +1276,8 @@ def _native_async(rt, op_kind, tensor, op=ReduceOp.SUM, prescale=1.0,
     # must fail loudly.
     _reject_indexed_slices(tensor, f"native async {op_kind}")
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
+    for leaf in leaves:
+        _record_collective_leaf(op_kind, leaf)
     namer = _leaf_namer(name)
     names = [namer() or _auto_name(op_kind) for _ in leaves]
     group, group_size = None, 0

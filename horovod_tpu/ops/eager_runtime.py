@@ -26,11 +26,13 @@ pluggable executor:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from ..core.exceptions import HorovodInternalError
+from ..utils import metrics as _metrics
 from .._native import (
     BATCHED,
     DONE,
@@ -62,6 +64,15 @@ _OP_ACTIVITIES = {
     OP_BROADCAST: ("NEGOTIATE_BROADCAST", "BROADCAST"),
     OP_ALLTOALL: ("NEGOTIATE_ALLTOALL", "ALLTOALL"),
     OP_REDUCESCATTER: ("NEGOTIATE_REDUCESCATTER", "REDUCESCATTER"),
+}
+
+# op id -> metric label (utils/metrics.py batch-execution series)
+_OP_METRIC_NAMES = {
+    OP_ALLREDUCE: "allreduce",
+    OP_ALLGATHER: "allgather",
+    OP_BROADCAST: "broadcast",
+    OP_ALLTOALL: "alltoall",
+    OP_REDUCESCATTER: "reducescatter",
 }
 
 
@@ -208,6 +219,7 @@ class EagerRuntime:
         self._results: Dict[int, np.ndarray] = {}
         self._handle_name: Dict[int, str] = {}
         self._handle_op: Dict[int, int] = {}
+        self._handle_ts: Dict[int, float] = {}  # enqueue stamps (metrics)
         self._last_cycle = -1
         self._last_exec_error = ""
         self._tuning_applied = False
@@ -216,6 +228,9 @@ class EagerRuntime:
             target=self._run, daemon=True, name="hvd-eager-executor"
         )
         self._worker.start()
+        # publish cumulative cycle/cache stats for /metrics scrapes
+        # (pull model: gauges refresh at render time, utils/metrics.py)
+        _metrics.set_native_stats_provider(self.metrics_snapshot)
 
     # ------------------------------------------------------------ enqueue
 
@@ -267,6 +282,10 @@ class EagerRuntime:
                 raise
             self._handle_name[handle] = name
             self._handle_op[handle] = op
+            if _metrics.enabled():  # stamp only when someone will read it
+                self._handle_ts[handle] = time.perf_counter()
+            depth = len(self._inputs)
+        _metrics.set_queue_depth(depth)
         # span opens only after the native enqueue accepted the tensor — a
         # raise above would otherwise leave an unclosed 'B' corrupting the
         # trace's track nesting
@@ -409,6 +428,7 @@ class EagerRuntime:
             with self._lock:
                 name = self._handle_name.pop(handle, None)
                 op = self._handle_op.pop(handle, None)
+                self._handle_ts.pop(handle, None)
                 self._inputs.pop(name, None)
             tl = _timeline()
             if tl is not None and name is not None and op in _OP_ACTIVITIES:
@@ -478,11 +498,18 @@ class EagerRuntime:
             # only tensors THIS rank enqueued get span events — a joined
             # rank receives batches naming tensors it never started, and
             # an E without a B corrupts the trace's track nesting
+            m_on = _metrics.enabled()
             with self._lock:
                 ours = [
                     self._handle_name[h]
                     for h in batch.handles if h in self._handle_name
                 ]
+                if m_on:
+                    now = time.perf_counter()
+                    for h in batch.handles:
+                        ts = self._handle_ts.pop(h, None)
+                        if ts is not None:
+                            _metrics.record_negotiation_latency(now - ts)
             if tl is not None and negotiate is not None:
                 # negotiation ended for every tensor in the fused batch;
                 # the execution span carries the fused-batch composition
@@ -500,14 +527,26 @@ class EagerRuntime:
                         n: self._inputs[n]
                         for n in batch.names if n in self._inputs
                     }
+                t_exec = time.perf_counter() if m_on else 0.0
                 results = self._executor(batch, tensors)
+                if m_on:
+                    _metrics.record_batch_execution(
+                        _OP_METRIC_NAMES.get(batch.op, str(batch.op)),
+                        len(batch.names), batch.total_bytes,
+                        time.perf_counter() - t_exec,
+                    )
                 with self._lock:
                     for h in batch.handles:
                         name = self._handle_name.pop(h, None)
                         self._handle_op.pop(h, None)
+                        # stamped-while-enabled handles whose negotiation
+                        # ran after a disable() would otherwise linger
+                        self._handle_ts.pop(h, None)
                         if name is not None and name in results:
                             self._results[h] = results[name]
                         self._inputs.pop(name, None)
+                    depth = len(self._inputs)
+                _metrics.set_queue_depth(depth)
                 self._native.batch_done(batch, ok=True)
             except Exception:
                 # keep the executor's failure for synchronize()'s error
@@ -523,6 +562,7 @@ class EagerRuntime:
                     for h in batch.handles:
                         name = self._handle_name.pop(h, None)
                         self._handle_op.pop(h, None)
+                        self._handle_ts.pop(h, None)
                         self._inputs.pop(name, None)
             finally:
                 if tl is not None and execute is not None:
@@ -530,6 +570,15 @@ class EagerRuntime:
                         tl.activity_end(n, execute)
 
     # ------------------------------------------------------------ stats
+
+    def metrics_snapshot(self) -> dict:
+        """Cumulative native cycle/cache stats + live queue depth — the
+        pull source behind the hvd_cache_hits/hvd_coord_* gauges
+        (utils/metrics.py set_native_stats_provider)."""
+        s = self._native.stats()
+        with self._lock:
+            s["queue_depth"] = len(self._inputs)
+        return s
 
     def cache_hits(self) -> int:
         return self._native.cache_hits()
@@ -554,6 +603,7 @@ class EagerRuntime:
         }
 
     def shutdown(self) -> None:
+        _metrics.set_native_stats_provider(None)
         self._shutdown.set()
         self._native.shutdown()
         self._worker.join(timeout=5)
@@ -671,7 +721,7 @@ class XlaExecutor:
         prog = self._programs.get(key)
         if prog is None:
             import jax
-            from jax import shard_map
+            from ..compat import shard_map
             from jax.sharding import PartitionSpec as P
 
             def body(*stacked):

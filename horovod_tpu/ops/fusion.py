@@ -35,12 +35,18 @@ def _threshold_bytes() -> int:
     return st.knobs.fusion_threshold_bytes
 
 
-def _record_fusion(n_tensors: int, n_buckets: int, threshold: int) -> None:
+def _record_fusion(n_tensors: int, n_buckets: int, threshold: int,
+                   bucket_bytes: Sequence[int] = ()) -> None:
     """Timeline instant marking a (compile-time) fusion plan — the analog
     of the reference's MEMCPY_IN/OUT_FUSION_BUFFER runtime phases, which
-    XLA absorbs into the collective's prologue/epilogue here."""
+    XLA absorbs into the collective's prologue/epilogue here. Also feeds
+    the live telemetry (utils/metrics.py): plan/bucket counters + the
+    fill-ratio histogram from per-bucket byte totals."""
+    from ..utils import metrics
     from ..utils.timeline import active_timeline
 
+    metrics.record_fusion_plan(n_tensors, n_buckets, threshold,
+                               bucket_bytes)
     tl = active_timeline()
     if tl is not None:
         tl.instant("fusion", "FUSION_PLAN", args={
@@ -74,10 +80,12 @@ def fuse_apply(
         itemsize = np.dtype(dtype).itemsize
         bucket: List[int] = []
         bucket_bytes = 0
+        filled: List[int] = []  # per-flushed-bucket byte totals (metrics)
 
-        def flush(bucket: List[int]):
+        def flush(bucket: List[int], nbytes: int):
             if not bucket:
                 return
+            filled.append(nbytes)
             flats = [arrs[i].reshape(-1) for i in bucket]
             fused = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
             red = fn(fused)
@@ -93,13 +101,13 @@ def fuse_apply(
         for i in idxs:
             nbytes = arrs[i].size * itemsize
             if bucket and bucket_bytes + nbytes > threshold_bytes:
-                flush(bucket)
+                flush(bucket, bucket_bytes)
                 bucket, bucket_bytes = [], 0
                 n_buckets += 1
             bucket.append(i)
             bucket_bytes += nbytes
-        flush(bucket)
-        _record_fusion(len(idxs), n_buckets, threshold_bytes)
+        flush(bucket, bucket_bytes)
+        _record_fusion(len(idxs), n_buckets, threshold_bytes, filled)
     return out
 
 
@@ -173,14 +181,19 @@ def pytree_bucket_plan(tree, threshold_bytes: int | None = None,
         order = range(len(leaves))
 
     def _dtype(leaf):
-        return np.dtype(getattr(leaf, "dtype", None)
-                        or np.asarray(leaf).dtype)
+        # jnp.result_type, not np.asarray: a python float is float64 to
+        # numpy but packs as float32 under default JAX config
+        # (pack_pytree_by_plan goes through jnp.asarray) — grouping by
+        # the numpy dtype would split such a leaf into a spurious
+        # mis-sized bucket of its own
+        return np.dtype(jnp.result_type(leaf))
 
     by_dtype: dict = {}
     for i in order:
         by_dtype.setdefault(_dtype(leaves[i]), []).append(i)
 
     plans = []
+    plan_bytes: List[int] = []  # parallel to `plans` (metrics fill ratio)
     for dtype, idxs in by_dtype.items():
         itemsize = dtype.itemsize
         cur_plan, cur_bytes, off = [], 0, 0
@@ -189,6 +202,7 @@ def pytree_bucket_plan(tree, threshold_bytes: int | None = None,
             nonlocal cur_plan, cur_bytes, off
             if cur_plan:
                 plans.append(cur_plan)
+                plan_bytes.append(cur_bytes)
             cur_plan, cur_bytes, off = [], 0, 0
 
         for i in idxs:
@@ -201,7 +215,7 @@ def pytree_bucket_plan(tree, threshold_bytes: int | None = None,
             off += size
             cur_bytes += nbytes
         flush()
-    _record_fusion(len(leaves), len(plans), threshold_bytes)
+    _record_fusion(len(leaves), len(plans), threshold_bytes, plan_bytes)
     return treedef, plans
 
 

@@ -98,16 +98,28 @@ def _reduce_grad_tree(
         prev = red
         reduced.append(compression.decompress(red, ctx))
     pm = global_state().parameter_manager
-    if pm is not None:
+    from ..utils import metrics as _metrics
+
+    if pm is not None or _metrics.enabled():
         # io_callback fires at *execution* time, once per real step, so the
-        # tuner observes actual throughput even inside a jitted train step
-        # (a bare call here would only run once, at trace time). Note: an
-        # already-compiled step keeps its bucket structure; the tuned
-        # threshold applies to eager ops and subsequent compilations.
+        # tuner (and the metrics layer) observes actual throughput even
+        # inside a jitted train step (a bare call here would only run once,
+        # at trace time). Note: an already-compiled step keeps its bucket
+        # structure; the tuned threshold applies to eager ops and
+        # subsequent compilations — and a step compiled with metrics OFF
+        # stays uninstrumented until recompiled.
         total = sum(int(b.size) * b.dtype.itemsize for b in buckets)
         from jax.experimental import io_callback
 
-        io_callback(functools.partial(pm.observe, total), None)
+        if pm is not None:
+            io_callback(functools.partial(pm.observe, total), None)
+        if _metrics.enabled():
+            io_callback(
+                functools.partial(
+                    _metrics.record_grad_reduction, total, len(buckets)
+                ),
+                None,
+            )
     return unflatten(reduced)
 
 

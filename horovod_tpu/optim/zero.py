@@ -33,9 +33,10 @@ DistributedOptimizer):
         upd, s = opt.update(g, s, p)
         return optax.apply_updates(p, upd), s, ...
 
-    jax.jit(jax.shard_map(step, mesh=mesh,
-                          in_specs=(P(), specs, P("hvd"), P("hvd")),
-                          out_specs=(P(), specs, ...), check_vma=False))
+    from horovod_tpu.compat import shard_map  # version-portable jax.shard_map
+    jax.jit(shard_map(step, mesh=mesh,
+                      in_specs=(P(), specs, P("hvd"), P("hvd")),
+                      out_specs=(P(), specs, ...), check_vma=False))
 
 State layout: the inner optimizer is initialized on a LIST of
 per-bucket `(n, k_i)` arrays (`k_i = ceil(bucket_len / n)`, row r =
@@ -162,7 +163,25 @@ def ShardedOptimizer(optimizer, axis_name=None,
             for b in pb
         ]
         # state rows arrive (1, k_i) per device via sharded_state_specs;
-        # flatten to (k_i,) for the inner elementwise update
+        # flatten to (k_i,) for the inner elementwise update. A full
+        # (world, k_i) leaf here means the caller ran inside shard_map
+        # WITHOUT sharded_state_specs — every device got the whole
+        # state, and the elementwise update would broadcast (n, k)
+        # against (k,) grad shards, surfacing only as a baffling shape
+        # error in unflatten/all_gather far from the cause. Fail at the
+        # cause instead.
+        for path, s in jax.tree_util.tree_flatten_with_path(state)[0]:
+            if (n > 1 and hasattr(s, "ndim") and s.ndim == 2
+                    and s.shape[0] == n):
+                raise ValueError(
+                    "ShardedOptimizer.update received an unsharded "
+                    f"state leaf {jax.tree_util.keystr(path)} of shape "
+                    f"{tuple(s.shape)} — first dim equals the "
+                    f"data-parallel world size ({n}) instead of 1. "
+                    "Shard the optimizer state in your shard_map "
+                    "in_specs with hvd.sharded_state_specs(state) so "
+                    "each device receives its own (1, k) row."
+                )
         local_state = jax.tree_util.tree_map(
             lambda s: s.reshape(-1) if (
                 hasattr(s, "ndim") and s.ndim == 2 and s.shape[0] == 1

@@ -36,7 +36,9 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.transformer import TransformerConfig
@@ -203,6 +205,10 @@ def pipeline_lm_apply(
         out_specs=P(),
         axis_names=frozenset({"pp"}),
         check_vma=False,
+        # the enclosing jit never shards over the non-pp axes, so legacy
+        # jax may run on the pp-only sub-mesh (full-mesh fully-manual
+        # miscompiles under jit when idle axes exist — see compat.py)
+        legacy_submesh=True,
     )
     h = pipelined(stacked, h, pos_mb)
     return _HeadOnly(cfg).apply({"params": head_params}, h)
@@ -405,7 +411,14 @@ def pipeline_lm_train_step_1f1b(
     def loss_head_fn(hp, y_mb, toks_mb):
         logits = _HeadOnly(cfg).apply({"params": hp}, y_mb)
         mean, n = causal_lm_loss(logits, toks_mb)
-        return mean * n, n  # (sum, count) — see one_f_one_b's contract
+        # UNCLAMPED valid count for the summed denominator:
+        # causal_lm_loss clamps n to >= 1 (safe for its own mean), but a
+        # fully-padded microbatch must contribute 0 — not a phantom 1 —
+        # to the cross-microbatch count, or loss/grads diverge from the
+        # serial model. mean * n is still the exact nll sum (0 when no
+        # token is valid).
+        n_raw = jnp.sum(toks_mb[:, 1:] != -1).astype(jnp.float32)
+        return mean * n, n_raw  # (sum, count) — see one_f_one_b's contract
 
     def embed_fwd(ep):
         return _EmbedOnly(cfg).apply({"params": ep}, tokens, positions)
@@ -421,6 +434,8 @@ def pipeline_lm_train_step_1f1b(
         out_specs=(P(), P(), P("pp"), P(), P()),
         axis_names=frozenset({"pp"}),
         check_vma=False,
+        # see gpipe entry point: pp-only sub-mesh on legacy jax
+        legacy_submesh=True,
     )
     loss_sum, count, d_stacked, d_head, d_xs = pipelined(
         stacked, h, tokens, head_params, pos_mb)

@@ -29,6 +29,23 @@ class _KVHandler(BaseHTTPRequestHandler):
         return parts[0], parts[1]
 
     def do_GET(self):
+        if self.path.split("?", 1)[0].rstrip("/") == "/metrics":
+            # live telemetry scrape (utils/metrics.py) of THIS process's
+            # registry. In a multi-process launch the workers run in
+            # their own processes, so this shows only driver-side
+            # activity — per-worker telemetry needs HOROVOD_METRICS_PORT
+            # on the workers (docs/metrics.md). Single-segment path —
+            # can't collide with the scope/key namespace (always two
+            # segments).
+            from ...utils import metrics
+
+            ctype, body = metrics.exposition()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         sk = self._split()
         store = self.server.store  # type: ignore[attr-defined]
         if sk is None:

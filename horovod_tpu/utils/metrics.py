@@ -1,0 +1,804 @@
+"""Unified runtime telemetry: metrics registry + per-step stats.
+
+The reference's only observability surfaces are offline — the Chrome-trace
+timeline (timeline.cc) and the stall inspector's log warnings
+(stall_inspector.cc). This module is the live counterpart: a thread-safe
+registry of counters, gauges and fixed-bucket histograms that the hot
+paths (ops/collectives.py, ops/eager_runtime.py, ops/fusion.py,
+optim/distributed.py, elastic transitions, the native runtime's
+cycle/cache stats) feed while training runs, exposed as
+
+  * Prometheus text format on ``GET /metrics`` — mounted on the
+    rendezvous/KV HTTP server (runner/http/http_server.py) and, with
+    ``HOROVOD_METRICS_PORT``, on a standalone per-worker endpoint;
+  * an optional JSON-lines per-step log (``HOROVOD_TPU_METRICS_FILE``)
+    rendered by ``scripts/metrics_summary.py``.
+
+Cost discipline: everything is OFF by default and every hot-path record
+function begins with a module-level ``if not _enabled: return`` — the
+whole subsystem costs one predicted-not-taken branch + a function call
+(<1 µs) per site when disabled (tests/test_metrics.py asserts this).
+Enabled, updates are dict lookups + float adds under per-family locks;
+no I/O happens on the hot path (the JSONL writer runs at step
+boundaries, the HTTP server in its own thread).
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# module-level enable gate (the no-op fast path)
+# ---------------------------------------------------------------------------
+
+_enabled = False
+_configured = False  # True when init()/configure() turned metrics on
+
+
+def enabled() -> bool:
+    """Whether telemetry is recording. Hot paths check this themselves;
+    callers composing larger records (e.g. a stats dict) should gate on
+    it to skip the assembly work too."""
+    return _enabled
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+# Latency histogram buckets (seconds): 50µs .. 10s, roughly 1-2.5-5 per
+# decade — wide enough for host-side negotiation AND whole-step times.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    50e-6, 100e-6, 250e-6, 500e-6, 1e-3, 2.5e-3, 5e-3, 10e-3, 25e-3,
+    50e-3, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Fill-ratio buckets (dimensionless 0..1] for fusion-buffer utilization.
+RATIO_BUCKETS: Tuple[float, ...] = (
+    0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0,
+)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: threading.Lock) -> None:
+        self.value = 0.0
+        self._lock = lock
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+
+class _Gauge:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: threading.Lock) -> None:
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._lock:
+            self.value += v
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: Sequence[float],
+                 lock: threading.Lock) -> None:
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self._lock = lock
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.counts[bisect.bisect_left(self.buckets, v)] += 1
+            self.sum += v
+            self.count += 1
+
+
+class MetricFamily:
+    """One named metric with a fixed label set; children keyed by the
+    label-value tuple (the Prometheus data model)."""
+
+    def __init__(self, name: str, kind: str, help: str,
+                 labelnames: Sequence[str] = (),
+                 buckets: Optional[Sequence[float]] = None):
+        if kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown metric kind {kind!r}")
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._buckets = tuple(buckets) if buckets else LATENCY_BUCKETS
+        self._lock = threading.Lock()
+        self._children: Dict[tuple, object] = {}
+
+    def labels(self, *values, **kv):
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            values = tuple(kv[n] for n in self.labelnames)
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, got {key}"
+            )
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    # children share the family lock: updates are
+                    # read-modify-write sequences (value += v, bucket +
+                    # sum + count), so concurrent recorders would lose
+                    # increments without it
+                    child = {
+                        "counter": lambda: _Counter(self._lock),
+                        "gauge": lambda: _Gauge(self._lock),
+                        "histogram": lambda: _Histogram(
+                            self._buckets, self._lock),
+                    }[self.kind]()
+                    self._children[key] = child
+        return child
+
+    # no-label conveniences
+    def inc(self, v: float = 1.0) -> None:
+        self.labels().inc(v)
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    # -- rendering ---------------------------------------------------------
+
+    def _labelstr(self, key: tuple, extra: str = "") -> str:
+        parts = [
+            f'{n}="{_escape_label(v)}"'
+            for n, v in zip(self.labelnames, key)
+        ]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def render(self) -> List[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            if self.kind in ("counter", "gauge"):
+                lines.append(
+                    f"{self.name}{self._labelstr(key)} {_fmt(child.value)}"
+                )
+            else:
+                with self._lock:  # consistent (counts, sum, count) triple
+                    counts = list(child.counts)
+                    hsum, hcount = child.sum, child.count
+                cum = 0
+                for b, c in zip(child.buckets, counts):
+                    cum += c
+                    le = 'le="' + _fmt(b) + '"'
+                    lines.append(
+                        f"{self.name}_bucket{self._labelstr(key, le)} {cum}"
+                    )
+                cum += counts[-1]
+                inf_labels = self._labelstr(key, 'le="+Inf"')
+                lines.append(f"{self.name}_bucket{inf_labels} {cum}")
+                lines.append(
+                    f"{self.name}_sum{self._labelstr(key)} {_fmt(hsum)}"
+                )
+                lines.append(
+                    f"{self.name}_count{self._labelstr(key)} {hcount}"
+                )
+        return lines
+
+    def snapshot(self) -> dict:
+        out = {}
+        with self._lock:
+            items = sorted(self._children.items())
+        for key, child in items:
+            k = ",".join(key)
+            if self.kind in ("counter", "gauge"):
+                out[k] = child.value
+            else:
+                with self._lock:
+                    out[k] = {"count": child.count, "sum": child.sum}
+        return out
+
+
+class MetricsRegistry:
+    """Thread-safe family registry + pre-scrape collector hooks."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, MetricFamily] = {}
+        self._collectors: List[Callable[[], None]] = []
+
+    def _family(self, name: str, kind: str, help: str,
+                labelnames: Sequence[str] = (),
+                buckets: Optional[Sequence[float]] = None) -> MetricFamily:
+        fam = self._families.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = MetricFamily(name, kind, help, labelnames, buckets)
+                    self._families[name] = fam
+        if fam.kind != kind or fam.labelnames != tuple(labelnames):
+            raise ValueError(
+                f"metric {name} re-registered with different "
+                f"kind/labels ({fam.kind}/{fam.labelnames} vs "
+                f"{kind}/{tuple(labelnames)})"
+            )
+        return fam
+
+    def counter(self, name, help="", labelnames=()) -> MetricFamily:
+        return self._family(name, "counter", help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> MetricFamily:
+        return self._family(name, "gauge", help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=None) -> MetricFamily:
+        return self._family(name, "histogram", help, labelnames, buckets)
+
+    def register_collector(self, fn: Callable[[], None]) -> None:
+        """`fn` runs before every render/snapshot — the pull hook for
+        sources that keep their own cumulative state (native runtime)."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def unregister_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def collect(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                pass  # a dead provider must not break the scrape
+
+    def render(self) -> str:
+        self.collect()
+        lines: List[str] = []
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        for fam in fams:
+            lines.extend(fam.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        self.collect()
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        return {f.name: f.snapshot() for f in fams}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._families.clear()
+            self._collectors.clear()
+
+
+registry = MetricsRegistry()
+
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def scrape() -> str:
+    """Prometheus text exposition of the process-local registry."""
+    return registry.render()
+
+
+def exposition() -> Tuple[str, bytes]:
+    """(content-type, body) for serving a scrape over HTTP — the one
+    definition both the standalone endpoint and the rendezvous server
+    mount (runner/http/http_server.py)."""
+    return PROM_CONTENT_TYPE, scrape().encode()
+
+
+# ---------------------------------------------------------------------------
+# per-step aggregation
+# ---------------------------------------------------------------------------
+
+class StepStats:
+    """Accumulates per-interval telemetry between ``begin_step`` /
+    ``end_step`` and emits one JSONL record per step: step time,
+    collective count/bytes by (op, dtype), fusion fill ratio, cache hit
+    rate, negotiation latency, eager queue depth, elastic transitions —
+    the live analog of replaying a timeline after the run."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._log_fh = None
+        self._log_path = ""
+        self.step = 0
+        self._t0: Optional[float] = None
+        self._last_native: Dict[str, float] = {}
+        self._reset_interval()
+
+    def _reset_interval(self) -> None:
+        self.collectives: Dict[str, List[float]] = {}  # op/dtype -> [n, B]
+        self.neg_count = 0
+        self.neg_sum = 0.0
+        self.fusion_plans = 0
+        self.fusion_buckets = 0
+        self.fusion_fill_sum = 0.0
+        self.grad_bytes = 0
+        self.queue_depth = 0
+        self.elastic_events: List[str] = []
+
+    # -- accumulation hooks (called by the module record_* functions) ------
+
+    def add_collective(self, op: str, dtype: str, nbytes: int) -> None:
+        with self._lock:
+            ent = self.collectives.setdefault(f"{op}/{dtype}", [0, 0])
+            ent[0] += 1
+            ent[1] += int(nbytes)
+
+    def add_negotiation(self, seconds: float) -> None:
+        with self._lock:
+            self.neg_count += 1
+            self.neg_sum += seconds
+
+    def add_fusion(self, n_buckets: int, fill_sum: float) -> None:
+        with self._lock:
+            self.fusion_plans += 1
+            self.fusion_buckets += n_buckets
+            self.fusion_fill_sum += fill_sum
+
+    def add_grad_bytes(self, nbytes: int) -> None:
+        with self._lock:
+            self.grad_bytes += int(nbytes)
+
+    def add_elastic_event(self, kind: str) -> None:
+        with self._lock:
+            self.elastic_events.append(kind)
+
+    def set_queue_depth(self, n: int) -> None:
+        self.queue_depth = int(n)
+
+    # -- step boundary ------------------------------------------------------
+
+    def open_log(self, path: str) -> None:
+        with self._lock:
+            if self._log_fh is not None:
+                self._log_fh.close()
+            self._log_path = path
+            self._log_fh = open(path, "a")
+
+    def close_log(self) -> None:
+        with self._lock:
+            if self._log_fh is not None:
+                self._log_fh.close()
+                self._log_fh = None
+                self._log_path = ""
+
+    def begin_step(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end_step(self, extra: Optional[dict] = None) -> dict:
+        """Close the interval: compute the record, emit JSONL, feed the
+        step-level registry series, reset accumulators."""
+        now = time.perf_counter()
+        dt = (now - self._t0) if self._t0 is not None else 0.0
+        self._t0 = None
+        native = _native_stats_snapshot()
+        with self._lock:
+            self.step += 1
+            coll = {
+                k: {"count": int(v[0]), "bytes": int(v[1])}
+                for k, v in sorted(self.collectives.items())
+            }
+            n_coll = sum(v[0] for v in self.collectives.values())
+            record = {
+                "step": self.step,
+                "time_unix": time.time(),
+                "step_time_s": dt,
+                "collectives": coll,
+                "negotiation": {
+                    "count": self.neg_count, "sum_s": self.neg_sum,
+                },
+                "fusion": {
+                    "plans": self.fusion_plans,
+                    "buckets": self.fusion_buckets,
+                    "fill_ratio_mean": (
+                        self.fusion_fill_sum / self.fusion_buckets
+                        if self.fusion_buckets else 0.0
+                    ),
+                },
+                "grad_bytes": self.grad_bytes,
+                "queue_depth": self.queue_depth,
+                "elastic_events": list(self.elastic_events),
+            }
+            if native:
+                delta = {
+                    k: native[k] - self._last_native.get(k, 0.0)
+                    for k in ("cache_hits", "bytes_negotiated",
+                              "stall_warnings")
+                    if k in native
+                }
+                hits = delta.get("cache_hits", 0.0)
+                record["native"] = {
+                    **{k: int(v) for k, v in delta.items()},
+                    # hit RATE relative to collectives issued this step;
+                    # the native cache has no per-lookup counter, so this
+                    # is the closest well-defined live ratio
+                    "cache_hit_rate": (
+                        min(hits / n_coll, 1.0) if n_coll else 0.0
+                    ),
+                }
+                if "cycles" in native:
+                    record["native"]["coord_cycles"] = int(native["cycles"])
+                self._last_native = native
+            if extra:
+                record.update(extra)
+            # write under the lock: close_log (hvd.shutdown, possibly
+            # another thread) also takes it, so the handle can't be
+            # closed between the check and the write
+            if self._log_fh is not None:
+                self._log_fh.write(json.dumps(record) + "\n")
+                self._log_fh.flush()
+            self._reset_interval()
+        if _enabled:
+            registry.counter(
+                "hvd_steps_total", "Completed training steps").inc()
+            registry.histogram(
+                "hvd_step_seconds", "Step wall time").observe(dt)
+        return record
+
+
+step_stats = StepStats()
+
+
+@contextlib.contextmanager
+def step(extra: Optional[dict] = None):
+    """Mark one training step: ``with hvd.metrics.step(): step_fn(...)``.
+    No-ops entirely when metrics are disabled and no step log is open."""
+    if not _enabled:
+        yield step_stats
+        return
+    step_stats.begin_step()
+    try:
+        yield step_stats
+    finally:
+        step_stats.end_step(extra)
+
+
+# ---------------------------------------------------------------------------
+# hot-path record functions (each begins with the no-op fast path)
+# ---------------------------------------------------------------------------
+
+def record_collective(op: str, dtype: str, nbytes: int) -> None:
+    """One issued collective (eager/native dispatch site)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_collectives_total",
+        "Collectives issued, by op and dtype", ("op", "dtype"),
+    ).labels(op, dtype).inc()
+    registry.counter(
+        "hvd_collective_bytes_total",
+        "Payload bytes of issued collectives, by op and dtype",
+        ("op", "dtype"),
+    ).labels(op, dtype).inc(nbytes)
+    step_stats.add_collective(op, dtype, nbytes)
+
+
+def record_negotiation_latency(seconds: float) -> None:
+    """Enqueue → negotiated-batch-received latency for one tensor."""
+    if not _enabled:
+        return
+    registry.histogram(
+        "hvd_negotiation_seconds",
+        "Enqueue-to-negotiated latency in the eager runtime",
+    ).observe(seconds)
+    step_stats.add_negotiation(seconds)
+
+
+def record_batch_execution(op: str, n_tensors: int, nbytes: int,
+                           seconds: float) -> None:
+    """One negotiated fused batch executed by the data plane."""
+    if not _enabled:
+        return
+    registry.histogram(
+        "hvd_batch_execution_seconds",
+        "Fused-batch execution wall time, by op", ("op",),
+    ).labels(op).observe(seconds)
+    registry.counter(
+        "hvd_fused_tensors_total",
+        "Tensors carried by executed fused batches", ("op",),
+    ).labels(op).inc(n_tensors)
+    registry.counter(
+        "hvd_fused_batch_bytes_total",
+        "Bytes carried by executed fused batches", ("op",),
+    ).labels(op).inc(nbytes)
+
+
+def record_fusion_plan(n_tensors: int, n_buckets: int, threshold: int,
+                       bucket_bytes: Sequence[int] = ()) -> None:
+    """One (compile-time) fusion plan: bucket count + fill ratios."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_fusion_plans_total", "Fusion plans computed").inc()
+    registry.counter(
+        "hvd_fusion_buckets_total", "Fusion buckets produced"
+    ).inc(n_buckets)
+    registry.counter(
+        "hvd_fusion_tensors_total", "Tensors entering fusion plans"
+    ).inc(n_tensors)
+    fill_sum = 0.0
+    hist = registry.histogram(
+        "hvd_fusion_fill_ratio",
+        "Bucket bytes / fusion threshold per produced bucket",
+        buckets=RATIO_BUCKETS,
+    )
+    for b in bucket_bytes:
+        r = min(b / threshold, 1.0) if threshold else 0.0
+        hist.observe(r)
+        fill_sum += r
+    step_stats.add_fusion(n_buckets, fill_sum)
+
+
+def record_grad_reduction(nbytes: int, n_buckets: int) -> None:
+    """One executed gradient reduction (io_callback from the compiled
+    step — fires per real step, not per trace)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_grad_reduced_bytes_total",
+        "Gradient bytes moved by executed reductions").inc(nbytes)
+    registry.counter(
+        "hvd_grad_reductions_total", "Executed gradient reductions").inc()
+    step_stats.add_grad_bytes(nbytes)
+
+
+def record_timeline_activity(activity: str, seconds: float) -> None:
+    """Bridge: a closed timeline span (utils/timeline.py) lands in a
+    latency histogram keyed by its activity name."""
+    if not _enabled:
+        return
+    registry.histogram(
+        "hvd_timeline_activity_seconds",
+        "Host-side timeline phase durations, by activity", ("activity",),
+    ).labels(activity).observe(seconds)
+
+
+def record_elastic_event(kind: str) -> None:
+    """An elastic lifecycle transition (reset, hosts-updated, round,
+    blacklist, ...)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_elastic_events_total",
+        "Elastic lifecycle transitions, by event", ("event",),
+    ).labels(kind).inc()
+    step_stats.add_elastic_event(kind)
+
+
+def set_queue_depth(n: int) -> None:
+    """Pending tensors in the eager runtime's input table."""
+    if not _enabled:
+        return
+    registry.gauge(
+        "hvd_eager_queue_depth",
+        "Tensors enqueued and awaiting negotiation/execution").set(n)
+    step_stats.set_queue_depth(n)
+
+
+# ---------------------------------------------------------------------------
+# native runtime stats bridge (pull model)
+# ---------------------------------------------------------------------------
+
+_native_provider: Optional[Callable[[], dict]] = None
+
+
+def set_native_stats_provider(fn: Optional[Callable[[], dict]]) -> None:
+    """The eager runtime registers its cumulative-stats snapshot here
+    (ops/eager_runtime.py); gauges update on every scrape."""
+    global _native_provider
+    _native_provider = fn
+    if fn is not None:
+        registry.register_collector(_collect_native)
+
+
+def _native_stats_snapshot() -> Dict[str, float]:
+    fn = _native_provider
+    if fn is None:
+        return {}
+    try:
+        return {k: float(v) for k, v in fn().items()}
+    except Exception:
+        return {}
+
+
+_NATIVE_GAUGES = {
+    "cache_hits": ("hvd_cache_hits_total",
+                   "Response-cache hits (native runtime, cumulative)"),
+    "bytes_negotiated": ("hvd_bytes_negotiated_total",
+                         "Tensor bytes negotiated (cumulative)"),
+    "stall_warnings": ("hvd_stall_warnings_total",
+                       "Stall-inspector warnings (cumulative)"),
+    "queue_depth": ("hvd_eager_queue_depth",
+                    "Tensors enqueued and awaiting negotiation/execution"),
+    "cycles": ("hvd_coord_cycles_total",
+               "Coordinator negotiation cycles (rank 0)"),
+    "busy_cycles": ("hvd_coord_busy_cycles_total",
+                    "Coordinator cycles that produced responses (rank 0)"),
+    "wait_us": ("hvd_coord_wait_seconds_total",
+                "Coordinator wall time blocked on worker frames (rank 0)"),
+    "work_us": ("hvd_coord_work_seconds_total",
+                "Coordinator CPU work per cycle, summed (rank 0)"),
+    "bytes_rx": ("hvd_coord_bytes_rx_total",
+                 "Control-plane bytes received by the coordinator"),
+    "bytes_tx": ("hvd_coord_bytes_tx_total",
+                 "Control-plane bytes sent by the coordinator"),
+    "cache_hit_positions": ("hvd_coord_cache_hit_positions_total",
+                            "Cache-hit positions in coordinator cycles"),
+    "responses": ("hvd_coord_responses_total",
+                  "Responses emitted by the coordinator"),
+}
+
+
+def _collect_native() -> None:
+    if not _enabled:
+        return
+    stats = _native_stats_snapshot()
+    for key, (name, help) in _NATIVE_GAUGES.items():
+        if key in stats:
+            v = stats[key]
+            if key in ("wait_us", "work_us"):
+                v = v / 1e6
+            registry.gauge(name, help).set(v)
+
+
+# ---------------------------------------------------------------------------
+# standalone HTTP endpoint (per-worker; the rendezvous server mounts the
+# same scrape under /metrics — runner/http/http_server.py)
+# ---------------------------------------------------------------------------
+
+_http_server = None
+_http_thread = None
+
+
+def start_http_server(port: int = 0) -> int:
+    """Serve ``GET /metrics`` on a dedicated port; returns the bound
+    port. Idempotent per process."""
+    global _http_server, _http_thread
+    if _http_server is not None:
+        return _http_server.server_address[1]
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            if self.path.split("?", 1)[0].rstrip("/") in ("", "/metrics"):
+                ctype, body = exposition()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                body = b"not found"
+                self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        def log_message(self, *args):
+            pass
+
+    _http_server = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+    _http_thread = threading.Thread(
+        target=_http_server.serve_forever, daemon=True, name="hvd-metrics",
+    )
+    _http_thread.start()
+    return _http_server.server_address[1]
+
+
+def stop_http_server() -> None:
+    global _http_server, _http_thread
+    if _http_server is not None:
+        _http_server.shutdown()
+        _http_server.server_close()
+        _http_server = None
+        _http_thread = None
+
+
+def http_port() -> Optional[int]:
+    return _http_server.server_address[1] if _http_server else None
+
+
+# ---------------------------------------------------------------------------
+# lifecycle wiring (core/basics.py calls these)
+# ---------------------------------------------------------------------------
+
+def configure(knobs) -> None:
+    """Turn telemetry on per the knobs (HOROVOD_METRICS /
+    HOROVOD_TPU_METRICS_FILE / HOROVOD_METRICS_PORT). A knob-less world
+    leaves any manual ``enable()`` untouched."""
+    global _configured
+    want = bool(
+        getattr(knobs, "metrics_enabled", False)
+        or getattr(knobs, "metrics_file", "")
+        or getattr(knobs, "metrics_port", 0)
+    )
+    if not want:
+        return
+    _configured = True
+    enable()
+    if getattr(knobs, "metrics_file", ""):
+        step_stats.open_log(knobs.metrics_file)
+    if getattr(knobs, "metrics_port", 0):
+        start_http_server(knobs.metrics_port)
+
+
+def on_shutdown() -> None:
+    """hvd.shutdown(): flush/close the step log and endpoint; disable
+    only if configure() was what enabled us."""
+    global _configured
+    step_stats.close_log()
+    stop_http_server()
+    set_native_stats_provider(None)
+    if _configured:
+        _configured = False
+        disable()
+
+
+def reset() -> None:
+    """Test hook: clear every family, provider and accumulator and
+    return to the disabled state."""
+    global _configured
+    on_shutdown()
+    disable()
+    _configured = False
+    registry.clear()
+    step_stats.close_log()
+    step_stats.step = 0
+    step_stats._last_native = {}
+    step_stats._reset_interval()

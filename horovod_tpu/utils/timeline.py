@@ -27,6 +27,8 @@ import threading
 import time
 from typing import Optional
 
+from . import metrics as _metrics
+
 # Phase/activity names kept verbatim from the reference (common.h:79-113)
 # so downstream trace tooling written against Horovod timelines keeps
 # working.
@@ -63,6 +65,9 @@ class Timeline:
         self._thread: Optional[threading.Thread] = None
         self._active = False
         self._start_ns = time.perf_counter_ns()
+        # metrics bridge: open-span start stamps keyed (tensor, activity)
+        # so every closed phase can land in a latency histogram
+        self._span_starts: dict = {}
         if filename:
             self.start(filename)
 
@@ -116,9 +121,24 @@ class Timeline:
         self._q.put(ev)
 
     def activity_start(self, tensor: str, activity: str, args: Optional[dict] = None) -> None:
+        if _metrics.enabled():
+            # bound the open-span table: spans whose end never arrives
+            # (executor failures drop the handle before the E event;
+            # auto-named tensors never repeat their key) would otherwise
+            # accumulate forever — evict oldest-inserted when full
+            if len(self._span_starts) >= 8192:
+                for k in list(self._span_starts)[:1024]:
+                    self._span_starts.pop(k, None)
+            self._span_starts[(tensor, activity)] = time.perf_counter_ns()
         self.emit("B", activity, tensor, args)
 
     def activity_end(self, tensor: str, activity: str) -> None:
+        if _metrics.enabled():
+            t0 = self._span_starts.pop((tensor, activity), None)
+            if t0 is not None:
+                _metrics.record_timeline_activity(
+                    activity, (time.perf_counter_ns() - t0) / 1e9
+                )
         self.emit("E", activity, tensor)
 
     def instant(self, tensor: str, name: str, args: Optional[dict] = None) -> None:
@@ -163,11 +183,17 @@ class Timeline:
 
 def active_timeline() -> Optional["Timeline"]:
     """The framework's timeline when tracing is on, else None — the one
-    gate every event-emitting layer uses."""
+    gate every event-emitting layer uses. With metrics enabled the
+    timeline is returned even when no trace file is being written:
+    `emit` drops the events (no writer, no queue growth) but the span
+    start/end pairs still feed the phase-latency histograms
+    (utils/metrics.py record_timeline_activity)."""
     from ..core.state import global_state
 
     tl = global_state().timeline
-    return tl if tl is not None and tl.active else None
+    if tl is None:
+        return None
+    return tl if (tl.active or _metrics.enabled()) else None
 
 
 # -- jax profiler passthrough ----------------------------------------------

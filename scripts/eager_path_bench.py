@@ -31,6 +31,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from horovod_tpu.compat import shard_map  # noqa: E402
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
@@ -92,7 +94,7 @@ def main(argv=None):
         u, s = dopt.update(g, s, p)
         return optax.apply_updates(p, u), s, jax.lax.psum(l, "hvd").reshape(1)
 
-    js = jax.jit(jax.shard_map(
+    js = jax.jit(shard_map(
         spmd_step, mesh=mesh, in_specs=(P(), P(), P("hvd"), P("hvd")),
         out_specs=(P(), P(), P()), check_vma=False))
     shard = NamedSharding(mesh, P("hvd"))

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Summarize a per-step metrics JSONL (HOROVOD_TPU_METRICS_FILE).
+
+Each line of the input is one step record emitted by
+``horovod_tpu.utils.metrics.StepStats.end_step`` (see docs/metrics.md for
+the schema). This renders the run as a table: step-time percentiles,
+collective counts/bytes by op/dtype, fusion fill ratio, negotiation
+latency, cache hit rate and elastic events — the offline companion to
+the live ``GET /metrics`` endpoint, sitting alongside
+scripts/xplane_summary.py (device traces) and the timeline viewer.
+
+Usage:
+    python scripts/metrics_summary.py /tmp/run_metrics.jsonl [--last N]
+    python scripts/metrics_summary.py /tmp/run_metrics.jsonl --check
+
+``--check`` is a smoke gate: it exits nonzero (with a one-line reason)
+when the file is missing, empty, or any line is malformed / missing the
+required step fields — wire it after a test run to assert telemetry
+actually flowed.
+"""
+
+import argparse
+import json
+import sys
+
+REQUIRED_FIELDS = ("step", "step_time_s", "collectives")
+
+
+def load_records(path):
+    """Parse the JSONL; returns (records, errors) where errors is a list
+    of '<lineno>: <reason>' strings."""
+    records, errors = [], []
+    try:
+        fh = open(path)
+    except OSError as e:
+        return [], [f"cannot open {path}: {e}"]
+    with fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                errors.append(f"line {lineno}: invalid JSON ({e})")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"line {lineno}: not an object")
+                continue
+            missing = [f for f in REQUIRED_FIELDS if f not in rec]
+            if missing:
+                errors.append(
+                    f"line {lineno}: missing field(s) {missing}")
+                continue
+            records.append(rec)
+    return records, errors
+
+
+def percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def _human_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+
+
+def summarize(records):
+    times = sorted(r["step_time_s"] for r in records)
+    print(f"steps: {len(records)}  "
+          f"(#{records[0]['step']} .. #{records[-1]['step']})")
+    print("step time: "
+          f"mean {sum(times) / len(times) * 1e3:.2f} ms  "
+          f"p50 {percentile(times, 0.50) * 1e3:.2f} ms  "
+          f"p90 {percentile(times, 0.90) * 1e3:.2f} ms  "
+          f"max {times[-1] * 1e3:.2f} ms")
+
+    coll = {}
+    for r in records:
+        for key, v in r.get("collectives", {}).items():
+            ent = coll.setdefault(key, [0, 0])
+            ent[0] += v.get("count", 0)
+            ent[1] += v.get("bytes", 0)
+    if coll:
+        print("\ncollectives (op/dtype, whole run):")
+        width = max(len(k) for k in coll)
+        print(f"  {'op/dtype':<{width}}  {'count':>8}  {'bytes':>12}")
+        for key in sorted(coll):
+            n, b = coll[key]
+            print(f"  {key:<{width}}  {n:>8}  {_human_bytes(b):>12}")
+
+    neg_n = sum(r.get("negotiation", {}).get("count", 0) for r in records)
+    neg_s = sum(r.get("negotiation", {}).get("sum_s", 0.0) for r in records)
+    if neg_n:
+        print(f"\nnegotiation: {neg_n} tensors, "
+              f"mean {neg_s / neg_n * 1e6:.0f} us")
+
+    buckets = sum(r.get("fusion", {}).get("buckets", 0) for r in records)
+    if buckets:
+        fill = [r["fusion"]["fill_ratio_mean"] for r in records
+                if r.get("fusion", {}).get("buckets")]
+        print(f"fusion: {buckets} buckets over "
+              f"{sum(r['fusion']['plans'] for r in records if 'fusion' in r)}"
+              f" plans, mean fill {sum(fill) / len(fill):.2f}")
+
+    grad = sum(r.get("grad_bytes", 0) for r in records)
+    if grad:
+        print(f"gradient bytes reduced: {_human_bytes(grad)}")
+
+    hits = sum(r.get("native", {}).get("cache_hits", 0) for r in records)
+    n_coll = sum(v[0] for v in coll.values())
+    if hits or n_coll:
+        rate = min(hits / n_coll, 1.0) if n_coll else 0.0
+        print(f"response cache: {hits} hits ({rate:.0%} of collectives)")
+    stalls = sum(
+        r.get("native", {}).get("stall_warnings", 0) for r in records)
+    if stalls:
+        print(f"stall warnings: {stalls}")
+
+    elastic = [e for r in records for e in r.get("elastic_events", [])]
+    if elastic:
+        by_kind = {}
+        for e in elastic:
+            by_kind[e] = by_kind.get(e, 0) + 1
+        print("elastic events: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(by_kind.items())))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Summarize a horovod_tpu per-step metrics JSONL")
+    ap.add_argument("jsonl", help="metrics JSONL path "
+                    "(HOROVOD_TPU_METRICS_FILE of the run)")
+    ap.add_argument("--last", type=int, default=0,
+                    help="only summarize the last N steps")
+    ap.add_argument("--check", action="store_true",
+                    help="smoke gate: exit 1 on empty/malformed input, "
+                    "print nothing but the verdict")
+    args = ap.parse_args(argv)
+
+    records, errors = load_records(args.jsonl)
+
+    if args.check:
+        if errors:
+            print(f"metrics check FAILED: {errors[0]}"
+                  + (f" (+{len(errors) - 1} more)" if len(errors) > 1
+                     else ""))
+            return 1
+        if not records:
+            print(f"metrics check FAILED: no step records in {args.jsonl}")
+            return 1
+        print(f"metrics check OK: {len(records)} step records")
+        return 0
+
+    for e in errors:
+        print(f"warning: {e}", file=sys.stderr)
+    if not records:
+        print(f"no step records in {args.jsonl}", file=sys.stderr)
+        return 1
+    if args.last:
+        records = records[-args.last:]
+    summarize(records)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
+from horovod_tpu.compat import shard_map
 
 
 def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
@@ -107,7 +108,7 @@ def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
         return optax.apply_updates(p, upd), s, jax.lax.psum(
             l, "hvd").reshape(1)
 
-    js = jax.jit(jax.shard_map(
+    js = jax.jit(shard_map(
         step, mesh=mesh, in_specs=(P(), state_specs, P("hvd")),
         out_specs=(P(), state_specs, P()), check_vma=False))
     return js, params, state, toks_s

@@ -27,6 +27,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import InceptionV3, ResNet50, VGG16
+from horovod_tpu.compat import shard_map
 
 _MODELS = {
     "resnet50": (ResNet50, 224),
@@ -89,7 +90,7 @@ def main(argv=None):
         return p_, bs, s, jax.lax.psum(loss, "hvd").reshape(1) / n
 
     step = jax.jit(
-        jax.shard_map(step_fn, mesh=mesh,
+        shard_map(step_fn, mesh=mesh,
                       in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
                       out_specs=(P(), P(), P(), P()),
                       check_vma=False),

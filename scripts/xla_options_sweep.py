@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import InceptionV3, ResNet50, VGG16
+from horovod_tpu.compat import shard_map
 
 _MODELS = {
     "resnet50": (ResNet50, 224),
@@ -113,7 +114,7 @@ def main(argv=None):
     # trade codegen shape against live-HBM pressure, so the timed
     # program must have the benchmark's memory profile
     jitted = jax.jit(
-        jax.shard_map(step_fn, mesh=mesh,
+        shard_map(step_fn, mesh=mesh,
                       in_specs=(P(), P(), P(), P("hvd"), P("hvd")),
                       out_specs=(P(), P(), P(), P()),
                       check_vma=False),

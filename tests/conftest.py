@@ -22,6 +22,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute tests (real-model AOT compiles) excluded "
+        "from the tier-1 gate's -m 'not slow' run",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _fresh_hvd():
     """Each test gets a freshly-initialized world."""

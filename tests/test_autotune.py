@@ -17,6 +17,7 @@ import horovod_tpu as hvd
 from horovod_tpu.core.knobs import Knobs
 from horovod_tpu.core.state import global_state
 from horovod_tpu.ops.autotune import ParameterManager, SPMDStepTuner
+from horovod_tpu.compat import shard_map
 
 
 def _mlp_world():
@@ -53,7 +54,7 @@ def _make_factory(mesh, params, compile_log):
             del s2  # fixed state: candidates must be numerically comparable
             return optax.apply_updates(p, u), jax.lax.pmean(l, "hvd").reshape(1)
 
-        js = jax.jit(jax.shard_map(
+        js = jax.jit(shard_map(
             step, mesh=mesh,
             in_specs=(P(), P(), P("hvd"), P("hvd")),
             out_specs=(P(), P()), check_vma=False))

@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
@@ -116,7 +116,7 @@ def test_topology_op_family(hvd8):
                     hvd.process_set_included_op(
                         ps.process_set_id).reshape(1))
 
-        r, sr, inc = jax.jit(jax.shard_map(
+        r, sr, inc = jax.jit(shard_map(
             f, mesh=hvd.mesh(), in_specs=(),
             out_specs=(P("hvd"), P("hvd"), P("hvd")),
             check_vma=False))()

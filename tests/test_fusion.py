@@ -10,6 +10,8 @@ import numpy as np
 from horovod_tpu.ops.fusion import (
     _backward_availability_order,
     flatten_pytree_buckets,
+    pack_pytree_by_plan,
+    pytree_bucket_plan,
 )
 
 
@@ -80,6 +82,30 @@ def test_bucket_round_trip_both_orders():
         for a, b in zip(jax.tree_util.tree_leaves(tree),
                         jax.tree_util.tree_leaves(restored)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_python_float_leaf_groups_with_float32():
+    """Plan dtype grouping must match what pack_pytree_by_plan actually
+    packs: a python-float leaf is float64 to numpy but packs as float32
+    via jnp.asarray under default JAX config — np-based grouping split
+    it into a spurious mis-accounted bucket of its own (ADVICE.md #2)."""
+    tree = {
+        "w": jnp.asarray(np.arange(4, dtype=np.float32)),
+        "scale": 2.0,  # python float leaf
+    }
+    treedef, plans = pytree_bucket_plan(
+        tree, threshold_bytes=1 << 20, backward_order=False)
+    # one dtype group, one bucket — NOT a separate float64 bucket
+    assert len(plans) == 1, plans
+    assert sum(1 for _ in plans[0]) == 2
+    buckets, unflatten = pack_pytree_by_plan(tree, (treedef, plans))
+    assert len(buckets) == 1
+    assert buckets[0].dtype == jnp.float32
+    assert buckets[0].size == 5
+    restored = unflatten(buckets)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.arange(4, dtype=np.float32))
+    assert float(restored["scale"]) == 2.0
 
 
 def test_backward_order_changes_first_bucket():

@@ -1,0 +1,401 @@
+"""Live telemetry layer (utils/metrics.py + docs/metrics.md).
+
+Covers the registry semantics (counter/gauge/histogram, labels,
+Prometheus text rendering), the disabled no-op fast path (including the
+< 1 us/call overhead bound), the /metrics endpoint on both the
+standalone server and the rendezvous KV server, the timeline→histogram
+bridge, the per-step JSONL schema, exact counter accounting against
+collectives actually issued, and the metrics_summary CLI (table +
+--check smoke gate).
+"""
+
+import importlib.util
+import json
+import os
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _parse_prom(text):
+    """Prometheus text → {metric{labels}: float} (samples only)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, val = line.rsplit(" ", 1)
+        out[key] = float(val)
+    return out
+
+
+# ---------------------------------------------------------------- registry
+
+def test_counter_gauge_histogram_semantics():
+    metrics.enable()
+    reg = metrics.registry
+    c = reg.counter("t_requests_total", "help text", ("code",))
+    c.labels("200").inc()
+    c.labels("200").inc(2)
+    c.labels("500").inc()
+    g = reg.gauge("t_depth", "queue depth")
+    g.set(7)
+    h = reg.histogram("t_lat", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(99.0)
+
+    s = _parse_prom(reg.render())
+    assert s['t_requests_total{code="200"}'] == 3
+    assert s['t_requests_total{code="500"}'] == 1
+    assert s["t_depth"] == 7
+    assert s['t_lat_bucket{le="0.1"}'] == 1
+    assert s['t_lat_bucket{le="1"}'] == 2  # cumulative
+    assert s['t_lat_bucket{le="+Inf"}'] == 3
+    assert s["t_lat_count"] == 3
+    assert s["t_lat_sum"] == pytest.approx(99.55)
+
+
+def test_render_has_help_and_type_headers():
+    metrics.enable()
+    metrics.registry.counter("t_total", "my help").inc()
+    text = metrics.scrape()
+    assert "# HELP t_total my help" in text
+    assert "# TYPE t_total counter" in text
+
+
+def test_reregister_with_different_shape_rejected():
+    reg = metrics.registry
+    reg.counter("t_thing", "x", ("a",))
+    with pytest.raises(ValueError, match="re-registered"):
+        reg.gauge("t_thing", "x", ("a",))
+    with pytest.raises(ValueError, match="re-registered"):
+        reg.counter("t_thing", "x", ("b",))
+
+
+def test_registry_thread_safety():
+    import threading
+
+    metrics.enable()
+    c = metrics.registry.counter("t_mt_total", "", ("w",))
+
+    def work(i):
+        for _ in range(1000):
+            c.labels(str(i % 4)).inc()
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    total = sum(v for k, v in _parse_prom(
+        metrics.registry.render()).items() if k.startswith("t_mt_total"))
+    assert total == 8000
+
+
+# -------------------------------------------------------- disabled fast path
+
+def test_disabled_records_nothing():
+    assert not metrics.enabled()
+    metrics.record_collective("allreduce", "float32", 1024)
+    metrics.record_timeline_activity("ALLREDUCE", 0.1)
+    metrics.record_elastic_event("reset")
+    metrics.set_queue_depth(3)
+    assert metrics.scrape() == ""
+
+
+def test_disabled_overhead_under_1us_per_call():
+    """Acceptance bound: the no-op path (module flag check + return) must
+    cost < 1 us per call."""
+    assert not metrics.enabled()
+    n = 200_000
+    rec = metrics.record_collective
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rec("allreduce", "float32", 4096)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 1e-6, f"no-op record costs {per_call * 1e9:.0f} ns"
+
+
+# ---------------------------------------------------- collectives accounting
+
+def test_counters_match_collectives_issued(hvd8):
+    """/metrics counters equal exactly the number and total bytes of the
+    collectives this test issues on the eager path."""
+    metrics.enable()
+    before = _parse_prom(metrics.scrape())
+
+    x = jnp.ones((1024,), jnp.float32)  # 4096 B
+    for _ in range(5):
+        hvd.allreduce(x)
+    hvd.broadcast(jnp.zeros((16,), jnp.float32), root_rank=0)  # 64 B
+
+    after = _parse_prom(metrics.scrape())
+
+    def delta(key):
+        return after.get(key, 0) - before.get(key, 0)
+
+    ar = 'op="allreduce",dtype="float32"'
+    bc = 'op="broadcast",dtype="float32"'
+    assert delta("hvd_collectives_total{%s}" % ar) == 5
+    assert delta("hvd_collective_bytes_total{%s}" % ar) == 5 * 4096
+    assert delta("hvd_collectives_total{%s}" % bc) == 1
+    assert delta("hvd_collective_bytes_total{%s}" % bc) == 64
+
+
+def test_knob_enables_metrics(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "1")
+    hvd.init()
+    assert metrics.enabled()
+    hvd.allreduce(jnp.ones((8,), jnp.float32))
+    assert "hvd_collectives_total" in metrics.scrape()
+    hvd.shutdown()
+    assert not metrics.enabled()  # configure()-driven enable ends with it
+
+
+# ------------------------------------------------------- timeline bridge
+
+def test_timeline_spans_land_in_histograms():
+    from horovod_tpu.utils.timeline import Timeline
+
+    metrics.enable()
+    tl = Timeline(None)  # no trace file: events dropped, spans bridged
+    tl.activity_start("grad_1", "ALLREDUCE")
+    time.sleep(0.002)
+    tl.activity_end("grad_1", "ALLREDUCE")
+    tl.activity_start("grad_1", "NEGOTIATE_ALLREDUCE")
+    tl.activity_end("grad_1", "NEGOTIATE_ALLREDUCE")
+    s = _parse_prom(metrics.scrape())
+    assert s['hvd_timeline_activity_seconds_count{activity="ALLREDUCE"}'] == 1
+    assert s['hvd_timeline_activity_seconds_sum{activity="ALLREDUCE"}'] \
+        >= 0.002
+    key = 'hvd_timeline_activity_seconds_count{activity="NEGOTIATE_ALLREDUCE"}'
+    assert s[key] == 1
+
+
+def test_timeline_bridge_off_when_disabled():
+    from horovod_tpu.utils.timeline import Timeline
+
+    tl = Timeline(None)
+    tl.activity_start("t", "ALLREDUCE")
+    tl.activity_end("t", "ALLREDUCE")
+    assert "hvd_timeline_activity_seconds" not in metrics.scrape()
+
+
+def test_active_timeline_returned_for_metrics_without_trace(hvd8):
+    from horovod_tpu.utils.timeline import active_timeline
+
+    assert active_timeline() is None  # no trace file, metrics off
+    metrics.enable()
+    assert active_timeline() is not None  # bridge needs the spans
+
+
+# ------------------------------------------------------------ step JSONL
+
+def test_step_jsonl_schema(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    metrics.enable()
+    metrics.step_stats.open_log(path)
+    with metrics.step():
+        metrics.record_collective("allreduce", "float32", 4096)
+        metrics.record_collective("allreduce", "float32", 4096)
+        metrics.record_collective("allgather", "int32", 128)
+        metrics.record_negotiation_latency(0.001)
+        metrics.record_fusion_plan(10, 2, 1 << 20, [1 << 19, 1 << 18])
+        metrics.record_grad_reduction(1 << 20, 2)
+        metrics.record_elastic_event("hosts_updated")
+    with metrics.step():
+        pass
+    metrics.step_stats.close_log()
+
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    rec = lines[0]
+    assert rec["step"] == 1
+    assert rec["step_time_s"] >= 0
+    assert rec["collectives"]["allreduce/float32"] == {
+        "count": 2, "bytes": 8192}
+    assert rec["collectives"]["allgather/int32"] == {
+        "count": 1, "bytes": 128}
+    assert rec["negotiation"]["count"] == 1
+    assert rec["fusion"]["plans"] == 1
+    assert rec["fusion"]["buckets"] == 2
+    assert 0 < rec["fusion"]["fill_ratio_mean"] <= 1
+    assert rec["grad_bytes"] == 1 << 20
+    assert rec["elastic_events"] == ["hosts_updated"]
+    # second step starts from a clean interval
+    assert lines[1]["step"] == 2
+    assert lines[1]["collectives"] == {}
+    # step counters feed the registry too
+    s = _parse_prom(metrics.scrape())
+    assert s["hvd_steps_total"] == 2
+    assert s["hvd_step_seconds_count"] == 2
+
+
+def test_metrics_file_knob_writes_jsonl(tmp_path, monkeypatch):
+    path = str(tmp_path / "run.jsonl")
+    monkeypatch.setenv("HOROVOD_TPU_METRICS_FILE", path)
+    hvd.init()
+    assert metrics.enabled()
+    with hvd.metrics.step():
+        hvd.allreduce(jnp.ones((4,), jnp.float32))
+    hvd.shutdown()
+    recs = [json.loads(l) for l in open(path)]
+    assert recs and recs[0]["collectives"]["allreduce/float32"]["count"] == 1
+
+
+def test_canonical_metrics_file_env_wins(tmp_path, monkeypatch):
+    """HOROVOD_TPU_METRICS_FILE is the documented canonical name; it must
+    beat the HOROVOD_METRICS_FILE alias when both are set."""
+    from horovod_tpu.core.knobs import Knobs
+
+    canonical = str(tmp_path / "canonical.jsonl")
+    monkeypatch.setenv("HOROVOD_TPU_METRICS_FILE", canonical)
+    monkeypatch.setenv("HOROVOD_METRICS_FILE", str(tmp_path / "alias.jsonl"))
+    assert Knobs.from_env().metrics_file == canonical
+
+
+# --------------------------------------------------------- HTTP endpoints
+
+def test_standalone_metrics_endpoint():
+    metrics.enable()
+    metrics.registry.counter("t_scrape_total", "x").inc(3)
+    port = metrics.start_http_server(0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            assert r.status == 200
+            assert "version=0.0.4" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert _parse_prom(body)["t_scrape_total"] == 3
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=5)
+    finally:
+        metrics.stop_http_server()
+
+
+def test_rendezvous_server_mounts_metrics():
+    from horovod_tpu.runner.http.http_server import KVStoreServer
+
+    metrics.enable()
+    metrics.registry.counter("t_kv_total", "x").inc(7)
+    srv = KVStoreServer()
+    port = srv.start_server()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            assert r.status == 200
+            body = r.read().decode()
+        assert _parse_prom(body)["t_kv_total"] == 7
+        # the scope/key store still works next to the mount
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/sc/key", data=b"v", method="PUT")
+        urllib.request.urlopen(req, timeout=5)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/sc/key", timeout=5) as r:
+            assert r.read() == b"v"
+    finally:
+        srv.shutdown_server()
+
+
+# ------------------------------------------------------- native stats pull
+
+def test_native_stats_provider_feeds_gauges():
+    metrics.enable()
+    metrics.set_native_stats_provider(lambda: {
+        "cache_hits": 12, "bytes_negotiated": 4096, "stall_warnings": 1,
+        "queue_depth": 3, "cycles": 100, "wait_us": 2_000_000.0,
+    })
+    try:
+        s = _parse_prom(metrics.scrape())
+        assert s["hvd_cache_hits_total"] == 12
+        assert s["hvd_bytes_negotiated_total"] == 4096
+        assert s["hvd_stall_warnings_total"] == 1
+        assert s["hvd_eager_queue_depth"] == 3
+        assert s["hvd_coord_cycles_total"] == 100
+        assert s["hvd_coord_wait_seconds_total"] == 2.0  # us → s
+    finally:
+        metrics.set_native_stats_provider(None)
+
+
+# ------------------------------------------------------- metrics_summary
+
+def _summary_main():
+    spec = importlib.util.spec_from_file_location(
+        "metrics_summary",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "metrics_summary.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def _write_run(path, steps=3):
+    metrics.enable()
+    metrics.step_stats.open_log(path)
+    for _ in range(steps):
+        with metrics.step():
+            metrics.record_collective("allreduce", "float32", 4096)
+            metrics.record_negotiation_latency(0.0005)
+    metrics.step_stats.close_log()
+
+
+def test_metrics_summary_renders_table(tmp_path, capsys):
+    path = str(tmp_path / "run.jsonl")
+    _write_run(path)
+    assert _summary_main()([path]) == 0
+    out = capsys.readouterr().out
+    assert "steps: 3" in out
+    assert "allreduce/float32" in out
+    assert "12.0 KiB" in out  # 3 steps x 4096 B
+
+
+def test_metrics_summary_check_mode(tmp_path, capsys):
+    main = _summary_main()
+    good = str(tmp_path / "good.jsonl")
+    _write_run(good, steps=2)
+    assert main([good, "--check"]) == 0
+    assert "2 step records" in capsys.readouterr().out
+
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert main([empty, "--check"]) == 1
+
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write('{"step": 1}\nnot json\n')
+    assert main([bad, "--check"]) == 1
+    assert main([str(tmp_path / "missing.jsonl"), "--check"]) == 1
+
+
+# ------------------------------------------------------------ elastic
+
+def test_elastic_reset_records_event(hvd8):
+    from horovod_tpu.core.exceptions import HorovodInternalError
+
+    metrics.enable()
+    calls = {"n": 0}
+
+    @hvd.elastic.run
+    def train(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise HorovodInternalError("simulated failure")
+        return "done"
+
+    state = hvd.elastic.ObjectState(epoch=0)
+    assert train(state) == "done"
+    s = _parse_prom(metrics.scrape())
+    assert s['hvd_elastic_events_total{event="reset"}'] == 1
+    assert s['hvd_elastic_events_total{event="sync"}'] == 1

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
@@ -217,7 +217,7 @@ def test_single_rank_group_skips_reduction_machinery():
         return u
 
     out = jax.jit(
-        jax.shard_map(
+        shard_map(
             upd, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
             check_vma=False,
         )

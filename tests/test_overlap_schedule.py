@@ -37,6 +37,7 @@ _REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 import horovod_tpu as hvd
 from horovod_tpu.models import Transformer
 from horovod_tpu.models.transformer import TransformerConfig
+from horovod_tpu.compat import shard_map
 
 CFG = TransformerConfig(
     vocab_size=512, num_layers=4, num_heads=8, hidden_size=512,
@@ -62,7 +63,7 @@ def _build_step(mesh, fusion_threshold):
         return optax.apply_updates(p, upd), s, jax.lax.psum(
             l, "hvd").reshape(1)
 
-    js = jax.jit(jax.shard_map(
+    js = jax.jit(shard_map(
         step, mesh=mesh, in_specs=(P(), P(), P("hvd")),
         out_specs=(P(), P(), P()), check_vma=False))
     return js, params, state, toks
@@ -106,6 +107,7 @@ def _tpu_topology_mesh():
     return topologies.make_mesh(t, (8,), ("hvd",))
 
 
+@pytest.mark.slow  # BERT-Large AOT compile: multiple minutes of XLA time
 def test_tpu_schedule_overlap_window_on_real_bert():
     """Level 2 (TPU AOT, REAL model): the BERT-Large train step at the
     default 128MB fusion threshold with backward-availability bucket

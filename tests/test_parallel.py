@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from horovod_tpu.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
@@ -443,6 +443,36 @@ def test_1f1b_training_converges():
         params, state, loss = step(params, state, toks)
         first = float(loss) if first is None else first
     assert float(loss) < first * 0.5, (first, float(loss))
+
+
+def test_1f1b_fully_padded_microbatch():
+    """A microbatch whose targets are ALL ignore_index must contribute 0
+    to the summed valid-token denominator — not the phantom 1 that
+    causal_lm_loss's max(n, 1) clamp would add — or loss and gradients
+    diverge from the serial model (ADVICE.md #1)."""
+    from horovod_tpu.models.transformer import causal_lm_loss
+    from horovod_tpu.parallel.mesh import make_mesh
+    from horovod_tpu.parallel.pipeline import pipeline_lm_train_step_1f1b
+
+    cfg, model, toks, params = _tiny_lm(layers=4, B=8)
+    toks = np.array(toks)
+    # rows 6-7 form the LAST microbatch at M=4; padding every target
+    # position (toks[:, 1:]) makes its valid count exactly zero
+    toks[-2:, 1:] = -1
+    toks = jnp.asarray(toks)
+    mesh = make_mesh(pp=2, dp=4)
+
+    def loss_serial(p):
+        return causal_lm_loss(model.apply({"params": p}, toks), toks)[0]
+
+    l1, g1 = jax.value_and_grad(loss_serial)(params)
+    l2, g2 = jax.jit(lambda p, t: pipeline_lm_train_step_1f1b(
+        cfg, p, t, mesh, num_microbatches=4))(params, toks)
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=3e-3, atol=3e-4),
+        g1, g2)
 
 
 def test_1f1b_uneven_padding_across_microbatches():

@@ -10,6 +10,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu.compat import shard_map
 from horovod_tpu.ops.sparse import (
     IndexedSlices,
     dense_to_sparse,
@@ -47,7 +48,7 @@ def test_spmd_sparse_matches_dense(hvd8):
         return sparse_to_dense(red)
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             step, mesh=mesh, in_specs=(P("hvd"), P("hvd")),
             out_specs=P(), check_vma=False,
         )
@@ -138,7 +139,7 @@ def test_adasum_rejects_sparse(hvd8):
     import jax as _jax
     from jax.sharding import PartitionSpec as _P
 
-    fn = _jax.shard_map(
+    fn = shard_map(
         lambda: hvd.allreduce(
             {"e": IndexedSlices(jnp.asarray(vals), jnp.asarray(ids),
                                 (V, D))},

@@ -15,6 +15,7 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import horovod_tpu as hvd
+from horovod_tpu.compat import shard_map
 
 
 def _world():
@@ -47,7 +48,7 @@ def _run_steps(mesh, opt, state_specs, params, x, y, steps=3):
             l, "hvd").reshape(1)
 
     state = opt.init(params)
-    js = jax.jit(jax.shard_map(
+    js = jax.jit(shard_map(
         step, mesh=mesh,
         in_specs=(P(), state_specs, P("hvd"), P("hvd")),
         out_specs=(P(), state_specs, P()), check_vma=False))
@@ -140,7 +141,7 @@ def test_sharded_buckets_stay_separate_in_hlo():
         return optax.apply_updates(p, upd), s, jax.lax.pmean(
             l, "hvd").reshape(1)
 
-    js = jax.jit(jax.shard_map(
+    js = jax.jit(shard_map(
         step, mesh=mesh,
         in_specs=(P(), specs, P("hvd"), P("hvd")),
         out_specs=(P(), specs, P()), check_vma=False))
@@ -169,6 +170,18 @@ def test_single_rank_world_passthrough(monkeypatch):
     ref_upd, _ = optax.adam(0.01).update(g, ref, params)
     np.testing.assert_allclose(np.asarray(upd["w"]),
                                np.asarray(ref_upd["w"]), rtol=1e-6)
+
+
+def test_forgotten_sharded_state_specs_raises_clearly():
+    """Running inside shard_map WITHOUT sharded_state_specs hands every
+    device the full (world, k) state; the failure must name the missing
+    spec at the cause, not surface as a baffling broadcast/unflatten
+    shape error later (ADVICE.md #4)."""
+    mesh, params, x, y = _world()
+    zopt = hvd.ShardedOptimizer(optax.adam(0.05))
+    with pytest.raises(ValueError, match="sharded_state_specs"):
+        # P() replicates the state instead of slicing rows per device
+        _run_steps(mesh, zopt, P(), params, x, y, steps=1)
 
 
 def test_update_outside_mesh_raises():
