@@ -29,9 +29,23 @@ from typing import Any, Callable, Dict, NamedTuple, Optional
 
 from .optim.compression import Compression
 from .optim.distributed import DistributedOptimizer
+from .utils import faults, retry
 
 _SPEC_FILE = "horovod_tpu_model.json"
 _TREE_DIR = "tree"
+
+
+def _ckpt_io(point: str, fn: Callable, *args, **kwargs):
+    """Checkpoint I/O under the shared retry policy: a transiently
+    failing filesystem (GCS 5xx surfacing as OSError, NFS hiccup) backs
+    off and retries instead of losing the checkpoint; the
+    ``checkpoint.save`` / ``checkpoint.restore`` fault points exercise
+    exactly this path (tests/test_faults.py)."""
+    def _do():
+        faults.inject(point)
+        return fn(*args, **kwargs)
+
+    return retry.default_policy().call(_do, point=point)
 
 _COMPRESSION_NAMES = {
     Compression.none: "none",
@@ -112,8 +126,12 @@ def save_model(
         tree["opt_state"] = opt_state
     ckptr = _checkpointer()
     tree_path = os.path.join(path, _TREE_DIR)
-    ckptr.save(tree_path, tree, force=True)
-    ckptr.wait_until_finished()
+
+    def _save():
+        ckptr.save(tree_path, tree, force=True)
+        ckptr.wait_until_finished()
+
+    _ckpt_io("checkpoint.save", _save)
 
 
 def load_params(path: str):
@@ -126,7 +144,9 @@ def load_params(path: str):
     with open(os.path.join(path, _SPEC_FILE)) as f:
         spec = json.load(f)
     ckptr = _checkpointer()
-    raw = ckptr.restore(os.path.join(path, _TREE_DIR))
+    raw = _ckpt_io(
+        "checkpoint.restore", ckptr.restore, os.path.join(path, _TREE_DIR)
+    )
     import numpy as np
 
     params = jax.tree_util.tree_map(lambda x: np.asarray(x), raw["params"])
@@ -225,7 +245,9 @@ def load_model(
     template = {"params": params_tmpl}
     if spec.get("has_opt_state"):
         template["opt_state"] = jax.eval_shape(optimizer.init, params_tmpl)
-    restored = ckptr.restore(tree_path, template)
+    restored = _ckpt_io(
+        "checkpoint.restore", ckptr.restore, tree_path, template
+    )
     params = _to_host(restored["params"])
     opt_state = (
         _to_host(restored["opt_state"])

@@ -224,6 +224,20 @@ def init(
 
         metrics.configure(st.knobs)
 
+        # fault injection (utils/faults.py): the module already armed
+        # itself from the env at import (worker processes need that);
+        # an explicitly-knobbed spec re-compiles here so HVD_TPU_
+        # precedence matches every other knob
+        if st.knobs.fault_spec:
+            from ..utils import faults
+
+            faults.configure(st.knobs.fault_spec)
+
+        # shared control-plane retry policy, from the same snapshot
+        from ..utils import retry
+
+        retry.configure(st.knobs)
+
         if st.knobs.autotune and not st.knobs.native_eager:
             # compile-time bucket tuner for the SPMD path (single
             # controller — no cross-rank agreement needed). In native
@@ -276,6 +290,7 @@ def _start_native_eager(st) -> None:
         ),
         stall_warning_s=st.knobs.stall_warning_time_seconds,
         stall_shutdown_s=st.knobs.stall_shutdown_time_seconds,
+        stall_abort_s=st.knobs.stall_abort_time_seconds,
         autotune=st.knobs.autotune,
         autotune_warmup=st.knobs.autotune_warmup_samples,
         autotune_cycles_per_sample=st.knobs.autotune_steps_per_sample,

@@ -95,6 +95,11 @@ class Knobs:
     stall_check_enabled: bool = True
     stall_warning_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0  # 0 = never shut down
+    # negotiation watchdog (ops/eager_runtime.py): a collective wait
+    # making no progress for this long raises HorovodInternalError so
+    # the elastic run() wrapper restores-and-retries instead of hanging
+    # forever. 0 = disabled (waits are bounded only by their callers).
+    stall_abort_time_seconds: float = 0.0
 
     # --- timeline (timeline.h, operations.cc:1048) ---
     timeline_filename: str = ""
@@ -124,6 +129,23 @@ class Knobs:
     # --- elastic ---
     elastic_timeout_seconds: float = 600.0
     reset_limit: int = 0  # 0 = unlimited
+    # (the driver-side HOROVOD_ELASTIC_VANISH_GRACE / _SPAWN_JOIN
+    # windows live on ElasticSettings, not here — the elastic driver
+    # runs in the launcher process, which never builds a Knobs)
+    # SIGTERM/SIGINT preemption handler (elastic/preemption.py):
+    # commit state + emergency checkpoint + exit with the
+    # "host going away" code the driver does not blacklist
+    preemption_enabled: bool = True
+    emergency_checkpoint: str = ""  # rank-0 emergency snapshot path
+
+    # --- fault injection (utils/faults.py) ---
+    # canonical env HOROVOD_TPU_FAULT_SPEC; empty = disabled no-op
+    fault_spec: str = ""
+
+    # --- control-plane retry (utils/retry.py default policy) ---
+    retry_max_attempts: int = 5
+    retry_base_delay_seconds: float = 0.1
+    retry_max_delay_seconds: float = 2.0
 
     # --- process sets ---
     dynamic_process_sets: bool = False
@@ -174,6 +196,7 @@ class Knobs:
             stall_shutdown_time_seconds=_env_float(
                 "STALL_SHUTDOWN_TIME_SECONDS", 0.0
             ),
+            stall_abort_time_seconds=_env_float("STALL_ABORT_S", 0.0),
             timeline_filename=_env("TIMELINE", "") or "",
             timeline_mark_cycles=_env_bool("TIMELINE_MARK_CYCLES", False),
             autotune=_env_bool("AUTOTUNE", False),
@@ -189,6 +212,17 @@ class Knobs:
             hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),
             elastic_timeout_seconds=_env_float("ELASTIC_TIMEOUT", 600.0),
             reset_limit=_env_int("RESET_LIMIT", 0),
+            preemption_enabled=_env_bool("PREEMPTION", True),
+            emergency_checkpoint=_env("EMERGENCY_CHECKPOINT", "") or "",
+            # canonical name first so it wins when both are set
+            fault_spec=(
+                os.environ.get("HOROVOD_TPU_FAULT_SPEC", "")
+                or _env("FAULT_SPEC")
+                or ""
+            ),
+            retry_max_attempts=_env_int("RETRY_MAX_ATTEMPTS", 5),
+            retry_base_delay_seconds=_env_float("RETRY_BASE_DELAY", 0.1),
+            retry_max_delay_seconds=_env_float("RETRY_MAX_DELAY", 2.0),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
             native_eager=_env_bool("NATIVE", False),
             metrics_enabled=_env_bool("METRICS", False),

@@ -5,4 +5,6 @@ runner/elastic/ (driver, discovery, registration). Implemented in
 state.py / driver.py / discovery.py here.
 """
 
+from . import preemption  # noqa: F401
+from .preemption import PREEMPTED_EXIT_CODE  # noqa: F401
 from .state import ObjectState, State, TpuState, run  # noqa: F401

@@ -1,0 +1,208 @@
+"""Preemption-safe shutdown: SIGTERM/SIGINT → commit + emergency
+checkpoint + a distinct "host going away" exit code.
+
+TPU preemption (maintenance events, spot reclaim) delivers SIGTERM with
+a short grace window. Without a handler the worker dies mid-step: every
+step since the last manual ``state.commit()`` is lost, and the elastic
+driver blacklists the host — wrong twice over, because a preempted host
+was healthy and often comes back. This module closes both gaps:
+
+* the handler snapshots the elastic state (``state.save()`` — commit
+  minus the host-update interrupt, which must not fire inside a signal
+  handler),
+* rank 0 writes an *emergency checkpoint* — the committed snapshot
+  serialized to disk (``HOROVOD_EMERGENCY_CHECKPOINT`` or an explicit
+  path) so a fully-preempted job restarts from it instead of step 0,
+* the process exits with :data:`PREEMPTED_EXIT_CODE`, which the
+  elastic driver treats like a launcher abort: terminal for the round
+  barrier, but the host is NOT blacklisted (reference semantics: only
+  *failing* hosts are excluded, runner/elastic/driver.py).
+
+``@hvd.elastic.run`` installs the handler automatically (knob
+``HOROVOD_PREEMPTION``, default on); scripts outside the elastic
+wrapper call :func:`install` themselves.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import signal
+import threading
+import time
+from typing import Callable, Optional
+
+LOG = logging.getLogger("horovod_tpu.elastic")
+
+# Distinct from ordinary failures (1..~120) and shell signal codes
+# (128+N): the elastic driver maps this to ABORTED, not FAILURE.
+PREEMPTED_EXIT_CODE = 83
+
+_EMERGENCY_FORMAT = 1
+
+
+def emergency_save(state, path: str) -> str:
+    """Serialize the state's committed snapshot to ``path`` atomically.
+
+    The snapshot is host data by construction (ObjectState deep-copies,
+    TpuState device_get's), so a plain pickle is safe inside a signal
+    grace window — no orbax async machinery to flush, no device sync.
+    Returns the written path.
+    """
+    state.save()
+    payload = {
+        "format": _EMERGENCY_FORMAT,
+        "time_unix": time.time(),
+        "saved": state._saved,
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def emergency_restore(state, path: str) -> None:
+    """Load an emergency snapshot into ``state`` and restore it. The
+    snapshot's keys must be attributes the state already registered —
+    restarting with a differently-shaped state is a real error."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if payload.get("format") != _EMERGENCY_FORMAT:
+        raise ValueError(
+            f"unknown emergency checkpoint format in {path}: "
+            f"{payload.get('format')!r}"
+        )
+    saved = payload["saved"]
+    unknown = [k for k in saved if k not in state._known]
+    if unknown:
+        raise ValueError(
+            f"emergency checkpoint {path} carries unregistered state "
+            f"attributes {unknown}; registered: {state._known}"
+        )
+    state._saved = saved
+    state.restore()
+
+
+def _is_rank0() -> bool:
+    return int(os.environ.get(
+        "HOROVOD_RANK", os.environ.get("HVD_TPU_RANK", "0")) or 0) == 0
+
+
+class PreemptionHandler:
+    """Installable SIGTERM/SIGINT handler. One per process; re-install
+    just updates the state/path it commits."""
+
+    def __init__(self) -> None:
+        # RLock: the handler runs on the main thread and may interrupt
+        # install()/uninstall() mid-critical-section — a plain Lock
+        # would self-deadlock
+        self._lock = threading.RLock()
+        self._installed_signals: dict = {}
+        self._state = None
+        self._checkpoint_path: Optional[str] = None
+        self._on_preempt: Optional[Callable[[], None]] = None
+        self._exit: Callable[[int], None] = os._exit
+        self._fired = False
+
+    @property
+    def installed(self) -> bool:
+        return bool(self._installed_signals)
+
+    def install(
+        self,
+        state=None,
+        checkpoint_path: Optional[str] = None,
+        signals=(signal.SIGTERM,),
+        on_preempt: Optional[Callable[[], None]] = None,
+        exit_fn: Optional[Callable[[int], None]] = None,
+    ) -> bool:
+        """Arm the handler. Returns False when signal handlers cannot
+        be installed from this thread (signal.signal is main-thread
+        only) — callers degrade to unhandled-signal behavior."""
+        with self._lock:
+            self._state = state
+            self._checkpoint_path = checkpoint_path or None
+            self._on_preempt = on_preempt
+            if exit_fn is not None:
+                self._exit = exit_fn
+            for sig in signals:
+                if sig in self._installed_signals:
+                    continue
+                try:
+                    prev = signal.signal(sig, self._handle)
+                except ValueError:  # not the main thread
+                    return False
+                self._installed_signals[sig] = prev
+            self._fired = False
+            return True
+
+    def uninstall(self) -> None:
+        with self._lock:
+            for sig, prev in self._installed_signals.items():
+                try:
+                    signal.signal(sig, prev)
+                except ValueError:
+                    pass
+            self._installed_signals = {}
+            self._state = None
+            self._checkpoint_path = None
+            self._on_preempt = None
+            self._exit = os._exit
+            self._fired = False
+
+    # ------------------------------------------------------------ handler
+
+    def _handle(self, signum, frame) -> None:
+        # idempotent: the platform may deliver SIGTERM repeatedly
+        # during the grace window
+        with self._lock:
+            if self._fired:
+                return
+            self._fired = True
+            state = self._state
+            path = self._checkpoint_path
+            on_preempt = self._on_preempt
+            exit_fn = self._exit
+        LOG.warning(
+            "received signal %d: committing elastic state and exiting "
+            "with preemption code %d", signum, PREEMPTED_EXIT_CODE,
+        )
+        # NO metrics recording here: the handler interrupts the main
+        # thread, which may hold the registry/StepStats locks mid-
+        # record (they are not reentrant) — taking them again would
+        # deadlock away the whole grace window. The driver records
+        # worker_preempted when it sees the exit code.
+        try:
+            if state is not None:
+                if path and _is_rank0():
+                    emergency_save(state, path)  # save()s internally
+                else:
+                    state.save()
+            if on_preempt is not None:
+                on_preempt()
+        except Exception as e:
+            # the exit code must still say "preempted": a failed
+            # emergency write is worse logging, not a worker failure
+            LOG.error("preemption commit failed: %s", e)
+        exit_fn(PREEMPTED_EXIT_CODE)
+
+
+handler = PreemptionHandler()
+
+
+def install(state=None, checkpoint_path: Optional[str] = None,
+            **kwargs) -> bool:
+    """Arm the process-wide preemption handler (see
+    :class:`PreemptionHandler.install`)."""
+    return handler.install(state=state, checkpoint_path=checkpoint_path,
+                           **kwargs)
+
+
+def uninstall() -> None:
+    handler.uninstall()

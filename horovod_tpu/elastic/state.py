@@ -16,6 +16,7 @@ epoch so compiled collectives re-specialize to the new mesh.
 from __future__ import annotations
 
 import copy
+import os
 import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional
@@ -23,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 
 from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..utils import faults
 
 
 class _HostUpdateFlag:
@@ -52,6 +54,7 @@ class State:
 
     def __init__(self, **kwargs: Any) -> None:
         self._reset_callbacks: List[Callable] = []
+        self._commit_count = 0
 
     def register_reset_callbacks(self, callbacks: List[Callable]) -> None:
         self._reset_callbacks.extend(callbacks)
@@ -63,6 +66,16 @@ class State:
     def commit(self) -> None:
         """Snapshot state and surface pending host updates
         (common/elastic.py:60: save + check_host_updates)."""
+        # the in-worker chaos hook: `worker:kill:rank=R:step=N` dies at
+        # this rank's Nth commit — the deterministic mid-training
+        # worker death chaos tests are built on (utils/faults.py)
+        self._commit_count += 1
+        if faults.enabled():
+            faults.inject(
+                "worker",
+                rank=int(os.environ.get("HOROVOD_RANK", "0") or 0),
+                step=self._commit_count,
+            )
         self.save()
         self.check_host_updates()
 
@@ -190,7 +203,20 @@ def run(func: Callable) -> Callable:
         from ..core.state import global_state
         from ..utils import metrics
 
-        reset_limit = global_state().knobs.reset_limit
+        knobs = global_state().knobs
+        if knobs.preemption_enabled:
+            # preemption-safe shutdown (elastic/preemption.py): SIGTERM
+            # commits this state, rank 0 writes the emergency snapshot,
+            # and the exit code tells the driver not to blacklist.
+            # Installable only from the main thread — elsewhere we
+            # degrade to unhandled-signal behavior.
+            from . import preemption
+
+            preemption.install(
+                state=state,
+                checkpoint_path=knobs.emergency_checkpoint or None,
+            )
+        reset_limit = knobs.reset_limit
         resets = 0
         notify_needed = False
         while True:

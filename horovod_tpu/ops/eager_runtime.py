@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.exceptions import HorovodInternalError
+from ..utils import faults as _faults
 from ..utils import metrics as _metrics
 from .._native import (
     BATCHED,
@@ -198,6 +199,7 @@ class EagerRuntime:
         cache_capacity: int = 1024,
         stall_warning_s: float = 60.0,
         stall_shutdown_s: float = 0.0,
+        stall_abort_s: float = 0.0,
         autotune: bool = False,
         autotune_warmup: int = -1,
         autotune_cycles_per_sample: int = -1,
@@ -214,6 +216,11 @@ class EagerRuntime:
             autotune_bayes=autotune_bayes,
         )
         self._executor = executor or LoopbackExecutor(size, rank)
+        # negotiation watchdog (HOROVOD_STALL_ABORT_S): a collective
+        # wait with no observable progress for this long aborts with
+        # HorovodInternalError instead of hanging — the elastic run()
+        # wrapper's restore-and-retry needs a raise to catch. 0 = off.
+        self._stall_abort_s = float(stall_abort_s)
         self._lock = threading.Lock()
         self._inputs: Dict[str, np.ndarray] = {}
         self._results: Dict[int, np.ndarray] = {}
@@ -248,6 +255,15 @@ class EagerRuntime:
                 splits: Optional[List[int]] = None,
                 group: Optional[str] = None, group_size: int = 0,
                 process_set_id: int = 0) -> int:
+        # chaos hook: `collective:delay` simulates slow negotiation,
+        # `collective:error` a failed one — surfaced as the same
+        # HorovodInternalError a real negotiation failure raises so
+        # elastic recovery exercises its production path
+        if _faults.enabled():
+            try:
+                _faults.inject("collective", name=name, op=op)
+            except _faults.InjectedFault as e:
+                raise HorovodInternalError(str(e)) from e
         # device-resident jax arrays are enqueued as-is — negotiation
         # only needs shape/dtype, and the XLA executor consumes device
         # buffers directly (no host round trip; the reference keeps GPU
@@ -305,9 +321,7 @@ class EagerRuntime:
         process_sets.py:123 add_process_set — synchronized registration).
         """
         h = self._native.register_set(set_id, [int(r) for r in ranks])
-        state = self._native.wait(h, timeout_s)
-        while state in (0, BATCHED):
-            state = self._native.wait(h, timeout_s)
+        state = self._await_handle(h, timeout_s)
         self._native.release(h)
         if state != DONE:
             raise HorovodInternalError(
@@ -318,9 +332,7 @@ class EagerRuntime:
     def deregister_process_set(self, set_id: int,
                                timeout_s: float = 60.0) -> None:
         h = self._native.deregister_set(set_id)
-        state = self._native.wait(h, timeout_s)
-        while state in (0, BATCHED):
-            state = self._native.wait(h, timeout_s)
+        state = self._await_handle(h, timeout_s)
         self._native.release(h)
         if state != DONE:
             raise HorovodInternalError(
@@ -376,10 +388,8 @@ class EagerRuntime:
         # a join handle stays PENDING until every rank has joined
         # (controller.cc kJoin emits only on full coverage) — keep waiting
         # through PENDING timeouts like synchronize does; the stall
-        # inspector owns genuinely-stuck worlds
-        state = self._native.wait(h, timeout_s)
-        while state in (0, BATCHED):
-            state = self._native.wait(h, timeout_s)
+        # watchdog / inspector own genuinely-stuck worlds
+        state = self._await_handle(h, timeout_s)
         self._native.release(h)
         if state != DONE:
             raise HorovodInternalError(
@@ -413,13 +423,85 @@ class EagerRuntime:
     def poll(self, handle: int) -> bool:
         return self._native.poll(handle) in (DONE, FAILED)
 
-    def synchronize(self, handle: int, timeout_s: float = 60.0):
-        state = self._native.wait(handle, timeout_s)
+    # -- stall watchdog ----------------------------------------------------
+
+    def _progress_marker(self, handle: int) -> tuple:
+        """Cheap observable-progress fingerprint for a pending wait.
+        Deliberately excludes coordinator cycle counts — an idle
+        coordinator keeps cycling while a lost peer stalls the world,
+        and that must read as NO progress."""
+        stats = {}
+        try:
+            stats = self._native.stats()
+        except Exception:
+            pass
+        with self._lock:
+            n_results = len(self._results)
+        return (
+            self._native.poll(handle),
+            stats.get("responses", 0),
+            stats.get("bytes_negotiated", 0),
+            n_results,
+        )
+
+    def _abort_stalled(self, handle: int, waited_s: float) -> None:
+        """Convert a stalled negotiation into HorovodInternalError:
+        release the handle, close its bookkeeping/timeline span, raise
+        — the elastic run() wrapper restores committed state and
+        retries instead of hanging past every deadline."""
+        _metrics.record_stall_abort()
+        self._native.release(handle)
+        with self._lock:
+            name = self._handle_name.pop(handle, None)
+            op = self._handle_op.pop(handle, None)
+            self._handle_ts.pop(handle, None)
+            if name is not None:
+                self._inputs.pop(name, None)
+        tl = _timeline()
+        if tl is not None and name is not None and op in _OP_ACTIVITIES:
+            tl.activity_end(name, _OP_ACTIVITIES[op][0])
+            tl.instant(name, "STALL_ABORT")
+        raise HorovodInternalError(
+            f"collective stalled: handle {handle}"
+            + (f" ({name})" if name else "")
+            + f" made no progress for {waited_s:.1f}s "
+            "(HOROVOD_STALL_ABORT_S watchdog; a peer likely died — "
+            "elastic training will restore and retry)"
+        )
+
+    def _await_handle(self, handle: int, timeout_s: float,
+                      results_gate: bool = False) -> int:
+        """Block until the handle leaves PENDING/BATCHED (or, with
+        ``results_gate``, until its result lands), aborting via the
+        stall watchdog when enabled. Returns the last native state."""
+        abort_s = self._stall_abort_s
+        if abort_s <= 0:
+            slice_s = timeout_s
+            stall_at = None
+        else:
+            # short wait slices keep the watchdog responsive without
+            # busy-spinning; progress checks run only on this slow path
+            slice_s = max(min(timeout_s, abort_s / 4.0, 0.25), 0.01)
+            stall_at = time.monotonic() + abort_s
+        last_marker = None
+        state = self._native.wait(handle, slice_s)
         while state in (0, BATCHED):  # pending or awaiting executor
-            state = self._native.wait(handle, timeout_s)
-            with self._lock:
-                if handle in self._results:
-                    break
+            if results_gate:
+                with self._lock:
+                    if handle in self._results:
+                        return state
+            if stall_at is not None:
+                marker = self._progress_marker(handle)
+                if marker != last_marker:
+                    last_marker = marker
+                    stall_at = time.monotonic() + abort_s
+                elif time.monotonic() >= stall_at:
+                    self._abort_stalled(handle, abort_s)
+            state = self._native.wait(handle, slice_s)
+        return state
+
+    def synchronize(self, handle: int, timeout_s: float = 60.0):
+        self._await_handle(handle, timeout_s, results_gate=True)
         failed = self._native.poll(handle) == FAILED
         self._native.release(handle)
         if failed:

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ...utils import faults, retry
 from ..util.hosts import HostInfo
 
 # update classification (reference HostUpdateResult flags)
@@ -157,9 +158,30 @@ class HostManager:
             entry = self._blacklist.get(host)
             return entry.active if entry else False
 
+    def _poll_discovery(self) -> Dict[str, int]:
+        """One discovery poll under the shared retry policy: a
+        transiently-failing discovery script (busy cloud API, fork
+        failure) retries with backoff before the caller's own
+        warn-and-skip handling kicks in. The ``discovery.poll`` fault
+        point supports ``error`` (raise, exercising this retry) and
+        ``flap`` (one poll reports an empty host set — momentary
+        total-vanish chaos)."""
+        def _do() -> Dict[str, int]:
+            if faults.inject("discovery.poll") == "flap":
+                return {}
+            return self._discovery.find_available_hosts_and_slots()
+
+        return retry.default_policy().call(
+            _do,
+            point="discovery.poll",
+            retryable=lambda e: isinstance(
+                e, (OSError, subprocess.SubprocessError)
+            ),
+        )
+
     def update_available_hosts(self) -> int:
         """Poll discovery once; returns NO_UPDATE/ADDED/REMOVED/MIXED."""
-        discovered = self._discovery.find_available_hosts_and_slots()
+        discovered = self._poll_discovery()
         with self._lock:
             usable = {
                 h: s

@@ -21,10 +21,14 @@ changes through WorkerNotificationClient so they can commit early.
 from __future__ import annotations
 
 import logging
+import signal
 import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ...elastic.preemption import PREEMPTED_EXIT_CODE
+from ...utils import faults, retry
+from ...utils import metrics as _metrics
 from ..exec_run import launch_slots
 from ..http.http_server import RendezvousServer
 from ..util.hosts import SlotInfo, get_host_assignments
@@ -66,6 +70,10 @@ class ElasticDriver:
         self._assignments: List[SlotInfo] = []
 
         self._shutdown = threading.Event()
+        self._notify_addr: Optional[str] = None
+        self._notify_retry = retry.RetryPolicy(
+            max_attempts=2, base_delay_s=0.05, max_delay_s=0.2
+        )
         self._barrier_states: Optional[Dict[str, str]] = None
         self._barrier_event = threading.Event()
         self._notify_timestamp = 0
@@ -96,8 +104,10 @@ class ElasticDriver:
         timeout_s = (
             timeout_s if timeout_s is not None else self._settings.timeout_s
         )
-        deadline = time.time() + timeout_s
-        while time.time() < deadline and not self._shutdown.is_set():
+        # monotonic deadline: a wall-clock step (NTP, date -s) must not
+        # expire — or extend — the wait
+        deadline = retry.Deadline(timeout_s)
+        while not deadline.expired() and not self._shutdown.is_set():
             n = self._host_manager.current_hosts.count_available_slots()
             if n >= min_np:
                 return n
@@ -141,7 +151,14 @@ class ElasticDriver:
         self._assignments = assignments
         self._registry.reset(len(assignments))
         self._barrier_event.clear()
-        self._rendezvous.init(assignments)
+        def _init_rendezvous():
+            faults.inject("rendezvous.init", round=self._rendezvous.round)
+            self._rendezvous.init(assignments)
+
+        retry.default_policy().call(
+            _init_rendezvous, point="rendezvous.init"
+        )
+        _metrics.record_elastic_event("round")
 
         spawn_done = threading.Event()
 
@@ -165,19 +182,23 @@ class ElasticDriver:
         # so the barrier can complete (reference driver.py:304 handles
         # this via worker exit; a hung ssh never exits)
         vanished_since: Dict[str, float] = {}
+        grace = self._settings.host_vanish_grace_s
         while not self._barrier_event.wait(timeout=1.0):
             if self._shutdown.is_set():
                 break
             live = self._host_manager.current_hosts.available_hosts
-            now = time.time()
+            now = time.monotonic()  # step-immune vanish accounting
             for slot in assignments:
                 if slot.hostname in live:
                     vanished_since.pop(slot.hostname, None)
-                elif now - vanished_since.setdefault(slot.hostname, now) > 5.0:
+                elif (
+                    now - vanished_since.setdefault(slot.hostname, now)
+                    > grace
+                ):
                     self._registry.record_failure(
                         slot.hostname, slot.local_rank
                     )
-        spawn_done.wait(timeout=30)
+        spawn_done.wait(timeout=self._settings.spawn_join_timeout_s)
         # barrier may never have fired if shutdown interrupted the round —
         # an empty dict means "no successful round", never a crash in run()
         states = self._barrier_states or {}
@@ -186,6 +207,7 @@ class ElasticDriver:
                 if state == FAILURE:
                     host = key.rsplit(":", 1)[0]
                     self._host_manager.blacklist(host)
+                    _metrics.record_elastic_event("blacklist")
                     LOG.warning("blacklisting failed host %s", host)
             self._host_manager.update_available_hosts()
         return states
@@ -198,6 +220,11 @@ class ElasticDriver:
         def exec_and_record(command, env, slot, events):
             self._registry.record_ready(slot.hostname, slot.local_rank)
             try:
+                # chaos hook: a driver.exec error rule makes this slot's
+                # exec fail without a process ever spawning (dead ssh)
+                faults.inject(
+                    "driver.exec", host=slot.hostname, rank=slot.rank
+                )
                 if inner is not None:
                     code = inner(command, env, slot, events)
                 else:
@@ -215,6 +242,18 @@ class ElasticDriver:
                 code = 1
             if code == 0:
                 self._registry.record_success(slot.hostname, slot.local_rank)
+            elif code == PREEMPTED_EXIT_CODE:
+                # Preempted: the worker's SIGTERM handler committed its
+                # state (+ emergency checkpoint) and exited with the
+                # "host going away" code. Terminal for the barrier, but
+                # the host was healthy — blacklisting it would shrink
+                # the next round for no reason (elastic/preemption.py).
+                _metrics.record_elastic_event("worker_preempted")
+                LOG.warning(
+                    "rank %d on %s preempted; host stays eligible",
+                    slot.rank, slot.hostname,
+                )
+                self._registry.record_aborted(slot.hostname, slot.local_rank)
             elif (
                 code < 0 and events and any(e.is_set() for e in events)
             ):
@@ -226,6 +265,18 @@ class ElasticDriver:
                 # A worker that exited nonzero on its own (code > 0) is a
                 # real FAILURE even if the event fired meanwhile — two
                 # simultaneous crashes must both blacklist.
+                self._registry.record_aborted(slot.hostname, slot.local_rank)
+            elif code == -signal.SIGTERM:
+                # SIGTERM from outside the launcher (no abort event):
+                # the platform is reclaiming the host and the worker had
+                # no handler installed. Same preemption semantics — the
+                # host goes away through no fault of its own.
+                _metrics.record_elastic_event("worker_preempted")
+                LOG.warning(
+                    "rank %d on %s killed by external SIGTERM; treating "
+                    "as preemption, host stays eligible",
+                    slot.rank, slot.hostname,
+                )
                 self._registry.record_aborted(slot.hostname, slot.local_rank)
             else:
                 self._registry.record_failure(slot.hostname, slot.local_rank)
@@ -266,21 +317,59 @@ class ElasticDriver:
                 self._notify_workers_host_changes(result)
             self._shutdown.wait(self._settings.discovery_interval_s)
 
+    def _notification_addr(self) -> str:
+        """The local address for worker-client lookups: the pinned
+        --network-interface NIC when one was given (so notifications
+        bind the same plane the data path was pinned to), else the most
+        routable local address. Cached — the NIC set is fixed for the
+        driver's lifetime."""
+        if self._notify_addr is not None:
+            return self._notify_addr
+        if not self._nics:
+            self._notify_addr = get_local_host_addresses()[-1]
+            return self._notify_addr
+        try:
+            from ..driver.probe import interface_addresses
+
+            by_iface = interface_addresses(self._nics)
+            for nic in self._nics:
+                if nic in by_iface:
+                    self._notify_addr = by_iface[nic]
+                    return self._notify_addr
+        except Exception as e:
+            LOG.warning(
+                "could not resolve --network-interface %s for worker "
+                "notifications (%s); using default address for this "
+                "round", self._nics, e,
+            )
+        # do NOT cache the fallback: a NIC still coming up must win the
+        # next attempt, or the pin would be silently lost for the run
+        return get_local_host_addresses()[-1]
+
     def _notify_workers_host_changes(self, update_result: int) -> None:
         """Push HostsUpdatedRequest to every registered worker
         (reference driver.py:210)."""
         self._notify_timestamp += 1
-        addrs = get_local_host_addresses()
+        addr = self._notification_addr()
         port = self._rendezvous.port
         key = self._env[ENV_SECRET].encode()
+        timestamp = self._notify_timestamp
         for slot in self._assignments:
-            try:
-                client = get_worker_client(
-                    addrs[-1], port, slot.rank, key
-                )
+            def _notify(slot=slot):
+                faults.inject("worker.notify", rank=slot.rank)
+                client = get_worker_client(addr, port, slot.rank, key)
                 if client is not None:
-                    client.notify_hosts_updated(
-                        self._notify_timestamp, update_result
-                    )
+                    client.notify_hosts_updated(timestamp, update_result)
+
+            try:
+                # one quick retry, not the full backoff ladder: dead
+                # workers are EXPECTED here (that is often the very
+                # change being notified) and this loop runs on the
+                # discovery thread — a truly-gone worker stays a DEBUG
+                # line after one cheap re-attempt
+                self._notify_retry.call(
+                    _notify, point="worker.notify",
+                    retryable=lambda e: isinstance(e, (OSError, EOFError)),
+                )
             except Exception as e:
                 LOG.debug("notify rank %d failed: %s", slot.rank, e)

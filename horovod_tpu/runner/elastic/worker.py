@@ -19,6 +19,7 @@ import json
 import os
 from typing import List, Optional, Tuple
 
+from ...utils import faults
 from ..http import http_client
 from ..util.network import AckResponse, BasicClient, BasicService
 from ..util.secret import ENV_SECRET, secret_from_env
@@ -80,6 +81,9 @@ class WorkerNotificationManager:
         rank = os.environ.get("HVD_TPU_RANK", "0")
         port = int(os.environ["HVD_TPU_RENDEZVOUS_PORT"])
         payload = json.dumps(self._service.addresses()).encode()
+        # the PUT itself retries transport failures (http_client); the
+        # fault point lets chaos specs fail registration specifically
+        faults.inject("worker.register", rank=rank)
         http_client.put(
             rendezvous_addr, port, WORKERS_SCOPE, f"rank_{rank}", payload
         )

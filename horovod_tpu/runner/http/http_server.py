@@ -14,6 +14,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from ...utils import faults
 from ..util.hosts import SlotInfo
 
 RENDEZVOUS_SCOPE = "rendezvous"
@@ -27,6 +28,17 @@ class _KVHandler(BaseHTTPRequestHandler):
         if len(parts) != 2 or not parts[0] or not parts[1]:
             return None
         return parts[0], parts[1]
+
+    def _injected_503(self) -> bool:
+        """Server-side fault point: an ``http.server`` error rule turns
+        this request into a 503 — the retryable-status path clients
+        must survive (their 5xx-retry discipline, http_client.py)."""
+        try:
+            faults.inject("http.server", method=self.command)
+        except faults.InjectedFault:
+            self._reply(503, b"injected fault")
+            return True
+        return False
 
     def do_GET(self):
         if self.path.split("?", 1)[0].rstrip("/") == "/metrics":
@@ -46,6 +58,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self._injected_503():
+            return
         sk = self._split()
         store = self.server.store  # type: ignore[attr-defined]
         if sk is None:
@@ -59,6 +73,8 @@ class _KVHandler(BaseHTTPRequestHandler):
             self._reply(200, value)
 
     def do_PUT(self):
+        if self._injected_503():
+            return
         sk = self._split()
         if sk is None:
             self._reply(400, b"bad path")
@@ -70,6 +86,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self._reply(200, b"ok")
 
     def do_DELETE(self):
+        if self._injected_503():
+            return
         sk = self._split()
         if sk is None:
             self._reply(400, b"bad path")

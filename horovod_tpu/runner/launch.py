@@ -102,6 +102,42 @@ def parse_args(argv: Optional[List[str]] = None):
                    dest="stall_warning_time_seconds", type=float)
     p.add_argument("--stall-shutdown-time-seconds",
                    dest="stall_shutdown_time_seconds", type=float)
+    p.add_argument("--stall-abort-seconds", dest="stall_abort_s",
+                   type=float,
+                   help="Negotiation watchdog: a collective making no "
+                        "progress for this long raises "
+                        "HorovodInternalError so elastic training "
+                        "restores and retries (0 = off).")
+
+    # fault tolerance / chaos (docs/faults.md)
+    p.add_argument("--fault-spec", dest="fault_spec",
+                   help="Fault-injection spec for workers, e.g. "
+                        "'http.put:error:0.3:seed=7' (docs/faults.md).")
+    p.add_argument("--retry-max-attempts", dest="retry_max_attempts",
+                   type=int,
+                   help="Control-plane retry attempts (default 5).")
+    p.add_argument("--retry-base-delay", dest="retry_base_delay",
+                   type=float,
+                   help="First control-plane backoff in seconds "
+                        "(default 0.1).")
+    p.add_argument("--retry-max-delay", dest="retry_max_delay",
+                   type=float,
+                   help="Control-plane backoff cap in seconds "
+                        "(default 2.0).")
+    p.add_argument("--vanish-grace", dest="vanish_grace", type=float,
+                   help="Seconds a host may drop out of discovery "
+                        "before its worker is counted failed "
+                        "(default 5).")
+    p.add_argument("--spawn-join", dest="spawn_join", type=float,
+                   help="Post-round spawn-thread join budget in "
+                        "seconds (default 30).")
+    p.add_argument("--no-preemption", dest="preemption",
+                   action="store_const", const="0", default=None,
+                   help="Disable the SIGTERM preemption handler in "
+                        "workers (elastic/preemption.py).")
+    p.add_argument("--emergency-checkpoint", dest="emergency_checkpoint",
+                   help="Rank-0 emergency snapshot path written on "
+                        "preemption (SIGTERM).")
     p.add_argument("--log-level", dest="log_level",
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])
@@ -188,6 +224,9 @@ def _run_elastic(args) -> int:
         reset_limit=args.reset_limit or 0,
         cooldown_range=tuple(args.cooldown_range)
         if args.cooldown_range else None,
+        # None falls back to the HOROVOD_ELASTIC_* env knobs
+        host_vanish_grace_s=args.vanish_grace,
+        spawn_join_timeout_s=args.spawn_join,
     )
     discovery = HostDiscoveryScript(
         args.host_discovery_script, args.slots

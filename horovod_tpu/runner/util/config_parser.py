@@ -29,6 +29,17 @@ ARG_TO_ENV = {
     "stall_check_disable": "HOROVOD_STALL_CHECK_DISABLE",
     "stall_warning_time_seconds": "HOROVOD_STALL_CHECK_TIME_SECONDS",
     "stall_shutdown_time_seconds": "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
+    "stall_abort_s": "HOROVOD_STALL_ABORT_S",
+    "fault_spec": "HOROVOD_TPU_FAULT_SPEC",
+    "retry_max_attempts": "HOROVOD_RETRY_MAX_ATTEMPTS",
+    "retry_base_delay": "HOROVOD_RETRY_BASE_DELAY",
+    "retry_max_delay": "HOROVOD_RETRY_MAX_DELAY",
+    "vanish_grace": "HOROVOD_ELASTIC_VANISH_GRACE",
+    "spawn_join": "HOROVOD_ELASTIC_SPAWN_JOIN",
+    # --no-preemption stores the literal "0" (env_from_args skips
+    # boolean False, so a store_false flag could never reach the env)
+    "preemption": "HOROVOD_PREEMPTION",
+    "emergency_checkpoint": "HOROVOD_EMERGENCY_CHECKPOINT",
     "log_level": "HOROVOD_LOG_LEVEL",
     "mesh": "HOROVOD_MESH",
 }

@@ -369,6 +369,8 @@ class StepStats:
         self.grad_bytes = 0
         self.queue_depth = 0
         self.elastic_events: List[str] = []
+        self.retries: Dict[str, int] = {}       # point -> count
+        self.retry_giveups: Dict[str, int] = {}
 
     # -- accumulation hooks (called by the module record_* functions) ------
 
@@ -396,6 +398,16 @@ class StepStats:
     def add_elastic_event(self, kind: str) -> None:
         with self._lock:
             self.elastic_events.append(kind)
+
+    def add_retry(self, point: str) -> None:
+        with self._lock:
+            self.retries[point] = self.retries.get(point, 0) + 1
+
+    def add_retry_giveup(self, point: str) -> None:
+        with self._lock:
+            self.retry_giveups[point] = (
+                self.retry_giveups.get(point, 0) + 1
+            )
 
     def set_queue_depth(self, n: int) -> None:
         self.queue_depth = int(n)
@@ -453,6 +465,10 @@ class StepStats:
                 "queue_depth": self.queue_depth,
                 "elastic_events": list(self.elastic_events),
             }
+            if self.retries:
+                record["retries"] = dict(self.retries)
+            if self.retry_giveups:
+                record["retry_giveups"] = dict(self.retry_giveups)
             if native:
                 delta = {
                     k: native[k] - self._last_native.get(k, 0.0)
@@ -605,6 +621,52 @@ def record_timeline_activity(activity: str, seconds: float) -> None:
         "hvd_timeline_activity_seconds",
         "Host-side timeline phase durations, by activity", ("activity",),
     ).labels(activity).observe(seconds)
+
+
+def record_retry(point: str) -> None:
+    """One backed-off retry of a control-plane call (utils/retry.py),
+    labeled by call point (http.put, checkpoint.save, ...)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_retries_total",
+        "Control-plane retries, by call point", ("point",),
+    ).labels(point).inc()
+    step_stats.add_retry(point)
+
+
+def record_retry_giveup(point: str) -> None:
+    """A retried call that exhausted its attempts/deadline and
+    re-raised."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_retry_giveups_total",
+        "Control-plane retry give-ups, by call point", ("point",),
+    ).labels(point).inc()
+    step_stats.add_retry_giveup(point)
+
+
+def record_fault(point: str, action: str) -> None:
+    """One injected fault fired (utils/faults.py), by injection point
+    and action — lets chaos runs prove the faults actually happened."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_faults_injected_total",
+        "Injected faults fired, by point and action",
+        ("point", "action"),
+    ).labels(point, action).inc()
+
+
+def record_stall_abort() -> None:
+    """A stalled collective converted into HorovodInternalError by the
+    negotiation watchdog (HOROVOD_STALL_ABORT_S)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_stall_aborts_total",
+        "Collectives aborted by the stall watchdog").inc()
 
 
 def record_elastic_event(kind: str) -> None:

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Chaos smoke gate: one loopback elastic job under a canned fault spec.
+
+Sits next to ``scripts/metrics_summary.py --check`` in the repo's check
+scripts: where that gate asserts telemetry *flowed*, this one asserts
+recovery *works*. It runs a real ElasticDriver round on this machine
+(fake hostnames exec'd locally, the mocked-ssh pattern of
+tests/test_elastic_e2e.py) with the fault-injection framework armed:
+
+* ``worker:kill:host=hostB:step=2`` — a deterministic mid-run worker
+  death the driver must absorb (blacklist hostB, respawn on hostC,
+  converge within the reset limit);
+* ``http.put:error:0.3:seed=7`` + ``http.get:error:0.2:seed=3`` — a
+  30%/20% error rate on every KV-store call, which the shared
+  RetryPolicy must absorb with zero give-ups and zero worker deaths;
+* ``discovery.poll:flap:after=8:times=1`` (driver-side) — one empty
+  discovery poll the vanish-grace window must ride out.
+
+Exits 0 and prints a retry-counter summary on success; exits 1 with the
+first failed assertion otherwise.
+
+Usage:
+    python scripts/chaos_check.py [--rounds-budget N] [--verbose]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# The chaos worker: registers its assignment, then runs STEPS commit
+# cycles of KV-store traffic under the injected error rate. No training
+# framework needed — this gate is about the control plane. The fault
+# spec's kill rule fires inside faults.inject on hostB's 2nd step.
+_WORKER_SRC = textwrap.dedent("""
+    import json, os, sys
+
+    from horovod_tpu.utils import faults, metrics
+    from horovod_tpu.runner.http import http_client
+
+    metrics.enable()
+    rank = os.environ["HOROVOD_RANK"]
+    host = os.environ["CHAOS_HOST"]
+    workdir = os.environ["CHAOS_DIR"]
+    addr = "127.0.0.1"
+    port = int(os.environ["HVD_TPU_RENDEZVOUS_PORT"])
+
+    with open(os.path.join(workdir, "assignments.log"), "a") as f:
+        f.write(f"{host} {rank}\\n")
+
+    STEPS = 5
+    for step in range(1, STEPS + 1):
+        faults.inject("worker", rank=rank, step=step, host=host)
+        key = f"{host}_r{rank}_s{step}"
+        http_client.put(addr, port, "chaos", key, b"x")
+        assert http_client.get(addr, port, "chaos", key) == b"x"
+
+    snap = metrics.registry.snapshot()
+    out = {
+        "retries": snap.get("hvd_retries_total", {}),
+        "giveups": snap.get("hvd_retry_giveups_total", {}),
+        "faults": snap.get("hvd_faults_injected_total", {}),
+    }
+    path = os.path.join(workdir, f"retries_{host}_{rank}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(out, f)
+    os.replace(path + ".tmp", path)
+    print(f"chaos worker {host} rank {rank}: completed", flush=True)
+""")
+
+FAULT_SPEC = (
+    "worker:kill:host=hostB:step=2;"
+    "http.put:error:0.3:seed=7;"
+    "http.get:error:0.2:seed=3"
+)
+DRIVER_FAULT_SPEC = "discovery.poll:flap:after=8:times=1"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rounds-budget", type=int, default=4,
+                    help="elastic reset limit the run must fit in")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from horovod_tpu.runner.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.settings import ElasticSettings
+    from horovod_tpu.runner.util import safe_shell_exec
+    from horovod_tpu.utils import faults
+
+    workdir = tempfile.mkdtemp(prefix="hvd_chaos_")
+    worker_path = os.path.join(workdir, "chaos_worker.py")
+    with open(worker_path, "w") as f:
+        f.write(_WORKER_SRC)
+
+    env = {
+        k: v for k, v in os.environ.items() if k != "PYTHONPATH"
+    }
+    env.update({
+        "PYTHONPATH": _REPO,
+        "JAX_PLATFORMS": "cpu",
+        "CHAOS_DIR": workdir,
+        "HOROVOD_TPU_FAULT_SPEC": FAULT_SPEC,
+        "HOROVOD_RETRY_BASE_DELAY": "0.02",
+        "HOROVOD_RETRY_MAX_DELAY": "0.2",
+    })
+
+    def exec_fn(command, wenv, slot, events):
+        # fake hostnames exec locally (the mocked-ssh pattern); the KV
+        # store binds 0.0.0.0 so loopback always reaches it
+        wenv = dict(wenv)
+        wenv["CHAOS_HOST"] = slot.hostname
+        return safe_shell_exec.execute(
+            command, env=wenv, prefix=f"{slot.hostname}:{slot.rank}"
+            if args.verbose else None, events=events,
+        )
+
+    settings = ElasticSettings(
+        min_np=2, max_np=2, timeout_s=60.0, discovery_interval_s=0.2,
+        reset_limit=args.rounds_budget,
+    )
+    driver = ElasticDriver(
+        HostManager(FixedHosts({"hostA": 1, "hostB": 1, "hostC": 1})),
+        settings,
+        [sys.executable, worker_path],
+        env,
+        exec_fn=exec_fn,
+    )
+    faults.configure(DRIVER_FAULT_SPEC)
+    try:
+        rc = driver.run()
+    finally:
+        faults.reset()
+
+    failures = []
+    if rc != 0:
+        failures.append(f"elastic job exited {rc} (wanted 0)")
+    if driver._resets > args.rounds_budget:
+        failures.append(
+            f"took {driver._resets} resets (budget {args.rounds_budget})"
+        )
+    if not driver._host_manager.is_blacklisted("hostB"):
+        failures.append("killed hostB was not blacklisted")
+    for healthy in ("hostA", "hostC"):
+        if driver._host_manager.is_blacklisted(healthy):
+            failures.append(f"healthy {healthy} was blacklisted")
+
+    retries, giveups, fault_fires = {}, 0, 0
+    reports = [
+        p for p in os.listdir(workdir) if p.startswith("retries_")
+    ]
+    if not reports:
+        failures.append("no surviving worker published retry accounting")
+    for name in reports:
+        with open(os.path.join(workdir, name)) as f:
+            rep = json.load(f)
+        for point, n in rep["retries"].items():
+            retries[point] = retries.get(point, 0) + n
+        giveups += sum(rep["giveups"].values())
+        fault_fires += sum(
+            n for k, n in rep["faults"].items() if k.startswith("http.")
+        )
+    if reports and fault_fires == 0:
+        failures.append("HTTP fault rules never fired (dead chaos?)")
+    if reports and not retries:
+        failures.append("injected HTTP errors produced zero retries")
+    if giveups:
+        failures.append(f"{giveups} retry give-ups (wanted 0)")
+
+    total = int(sum(retries.values()))
+    print(f"chaos summary: resets={driver._resets} "
+          f"injected_http_faults={int(fault_fires)} "
+          f"retries={total} giveups={int(giveups)}")
+    for point in sorted(retries):
+        print(f"  retries[{point}] = {int(retries[point])}")
+
+    if failures:
+        for msg in failures:
+            print(f"chaos check FAILED: {msg}")
+        return 1
+    print("chaos check OK: worker kill + discovery flap + 30% HTTP "
+          "errors recovered")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

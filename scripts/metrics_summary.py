@@ -130,6 +130,19 @@ def summarize(records):
         print("elastic events: " + ", ".join(
             f"{k}={v}" for k, v in sorted(by_kind.items())))
 
+    retries, giveups = {}, {}
+    for r in records:
+        for point, n in r.get("retries", {}).items():
+            retries[point] = retries.get(point, 0) + n
+        for point, n in r.get("retry_giveups", {}).items():
+            giveups[point] = giveups.get(point, 0) + n
+    if retries or giveups:
+        print("control-plane retries: " + ", ".join(
+            f"{p}={int(n)}" for p, n in sorted(retries.items())))
+        if giveups:
+            print("retry GIVE-UPS: " + ", ".join(
+                f"{p}={int(n)}" for p, n in sorted(giveups.items())))
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser(

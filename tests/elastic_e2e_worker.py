@@ -37,6 +37,7 @@ def atomic_write(path, obj):
 def main():
     import horovod_tpu as hvd
     from horovod_tpu.data.sampler import ElasticSampler
+    from horovod_tpu.utils import faults, metrics
 
     hvd.init()
     rank = int(os.environ["HOROVOD_RANK"])
@@ -46,6 +47,19 @@ def main():
     state_path = os.path.join(workdir, "state.json")
     log_path = os.path.join(workdir, "processed.log")
     marker = os.path.join(workdir, "killed_once")
+
+    # chaos variant (test_elastic_chaos): per-commit KV-store heartbeats
+    # under an injected HTTP error rate, registration with the driver's
+    # notification service, and a fault-spec-driven worker kill — the
+    # base test keeps its hand-rolled os._exit fault below
+    chaos = os.environ.get("ELASTIC_E2E_CHAOS") == "1"
+    kv_addr = os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+    kv_port = int(os.environ.get("HVD_TPU_RENDEZVOUS_PORT", "0") or 0)
+    if chaos:
+        metrics.enable()
+        from horovod_tpu.runner.elastic.worker import notification_manager
+
+        notification_manager.init()
 
     with open(os.path.join(workdir, "assignments.log"), "a") as f:
         f.write(f"{host} {rank} {size}\n")
@@ -85,6 +99,18 @@ def main():
                     f"{','.join(str(i) for i in batch)}\n"
                 )
             commits += 1
+            # fault-spec kill point: `worker:kill:host=hostB:step=N`
+            # dies here deterministically (no-op when no spec is set)
+            faults.inject("worker", rank=rank, step=commits, host=host)
+            if chaos and kv_addr:
+                from horovod_tpu.runner.http import http_client
+
+                # KV heartbeat through the injected HTTP error rate —
+                # must complete via retries, never kill the worker
+                http_client.put(
+                    kv_addr, kv_port, "heartbeat", f"{host}_{rank}",
+                    str(commits).encode(),
+                )
             # recovery-time metric (reference elastic_common.py:34
             # measures the same spirit): hostC only exists in the
             # post-death world, so its first committed batch closes the
@@ -100,7 +126,8 @@ def main():
                 except FileExistsError:
                     pass
             if (
-                rank == 1
+                not chaos  # chaos variant kills via the fault spec
+                and rank == 1
                 and epoch == 0
                 and commits == 3
                 and not os.path.exists(marker)
@@ -116,6 +143,18 @@ def main():
                 state_path,
                 {"epoch": epoch + 1, "sampler": sampler.state_dict()},
             )
+    if chaos:
+        # surviving workers publish their retry accounting so the test
+        # can assert the injected HTTP errors were absorbed by retries
+        snap = metrics.registry.snapshot()
+        atomic_write(
+            os.path.join(workdir, f"retries_{host}_{rank}.json"),
+            {
+                "retries": snap.get("hvd_retries_total", {}),
+                "giveups": snap.get("hvd_retry_giveups_total", {}),
+                "faults": snap.get("hvd_faults_injected_total", {}),
+            },
+        )
     hvd.shutdown()
     print(f"worker {host} rank {rank}: completed", flush=True)
 

@@ -147,3 +147,99 @@ def test_elastic_end_to_end(tmp_path):
         assert replayed <= 2 * BATCH * 2, (
             f"epoch {epoch} replayed too much: {replayed}"
         )
+
+
+@pytest.mark.slow
+def test_elastic_chaos(tmp_path):
+    """Chaos variant: the worker death comes from the fault-injection
+    framework (`worker:kill:host=hostB:step=4`) instead of hand-rolled
+    os._exit, every worker's per-commit KV heartbeat runs under a ~25%
+    injected HTTP error rate (must be absorbed by retries — zero worker
+    deaths from HTTP), and the driver's own discovery poll flaps once.
+    Asserts convergence within reset_limit, the killed host
+    blacklisted, full sample coverage, and retries > 0 with zero
+    give-ups on the surviving workers."""
+    import json
+
+    from horovod_tpu.utils import faults
+
+    script = _make_discovery_script(tmp_path)
+    env = _worker_env(tmp_path)
+    env["ELASTIC_E2E_CHAOS"] = "1"
+    env["HOROVOD_METRICS"] = "1"
+    env["HOROVOD_TPU_FAULT_SPEC"] = (
+        "worker:kill:host=hostB:step=4;"
+        "http.put:error:0.25:seed=7;"
+        "http.get:error:0.15:seed=3"
+    )
+    env["HOROVOD_RETRY_BASE_DELAY"] = "0.02"
+    env["HOROVOD_RETRY_MAX_DELAY"] = "0.2"
+
+    def _chaos_exec(command, wenv, slot, events):
+        wenv = dict(wenv)
+        # fake hostnames never resolve: pin every control-plane address
+        # the worker dials to loopback (KV store included — the chaos
+        # heartbeats go through it)
+        wenv["HVD_TPU_RENDEZVOUS_ADDR"] = "127.0.0.1"
+        return _local_exec(command, wenv, slot, events)
+
+    settings = ElasticSettings(
+        min_np=2, max_np=2, timeout_s=120.0, discovery_interval_s=0.2,
+        reset_limit=4,
+    )
+    driver = ElasticDriver(
+        HostManager(HostDiscoveryScript(script)),
+        settings,
+        [sys.executable, _WORKER],
+        env,
+        exec_fn=_chaos_exec,
+    )
+    # driver-side chaos: one flapped discovery poll mid-run (all hosts
+    # momentarily vanish — must not fail any worker: the vanish grace
+    # window absorbs it)
+    faults.configure("discovery.poll:flap:after=10:times=1")
+    try:
+        rc = driver.run()
+    finally:
+        faults.reset()
+    assert rc == 0, "chaos run did not converge"
+    assert driver._resets <= settings.reset_limit
+
+    # the injected kill really happened, and only on hostB
+    rounds = [
+        line.split()
+        for line in (tmp_path / "assignments.log").read_text().splitlines()
+    ]
+    b_rounds = [r for h, r, s in rounds if h == "hostB"]
+    assert len(b_rounds) == 1, "killed hostB must not be relaunched"
+    assert driver._host_manager.is_blacklisted("hostB")
+    assert not driver._host_manager.is_blacklisted("hostA")
+    assert any(h == "hostC" for h, r, s in rounds), "hostC never joined"
+
+    # full sample coverage despite kill + flap + HTTP chaos
+    per_epoch = defaultdict(list)
+    for line in (tmp_path / "processed.log").read_text().splitlines():
+        epoch, host, rank, idxs = line.split()
+        per_epoch[int(epoch)].extend(int(i) for i in idxs.split(","))
+    for epoch in range(EPOCHS):
+        missing = set(range(DATASET)) - set(per_epoch[epoch])
+        assert not missing, f"epoch {epoch} lost samples: {sorted(missing)}"
+
+    # surviving workers absorbed the injected HTTP errors via retries:
+    # some retries, zero give-ups, faults actually fired
+    reports = list(tmp_path.glob("retries_*.json"))
+    assert reports, "no surviving worker published retry accounting"
+    retries = giveups = fault_fires = 0
+    for p in reports:
+        rep = json.loads(p.read_text())
+        retries += sum(rep["retries"].values())
+        giveups += sum(rep["giveups"].values())
+        fault_fires += sum(
+            v for k, v in rep["faults"].items()
+            if k.startswith("http.")
+        )
+    assert fault_fires > 0, "HTTP fault rules never fired"
+    assert retries > 0, "injected HTTP errors produced no retries"
+    assert giveups == 0, f"{giveups} retry give-ups killed control calls"
+    print(f"METRIC chaos_http_retries={retries} giveups={giveups} "
+          f"injected={fault_fires}", flush=True)
