@@ -58,7 +58,13 @@ def _spread(stats):
 def _eager_path_block():
     """Eager data-plane vs SPMD ratio (VERDICT r5 #3), measured in a
     subprocess so the native runtime initializes cleanly and its device
-    buffers die with the process. See scripts/eager_path_bench.py."""
+    buffers die with the process. The grouped-vs-ungrouped eager A/B
+    runs inside that ONE process (scripts/eager_path_bench.py measures
+    per-tensor, grouped, and the RTT probe back-to-back on the same
+    runtime), and both numbers land in this block as eager_step_ms /
+    eager_grouped_step_ms — cross-process drift can no longer fake a
+    grouping win; docs/benchmarks.md quotes whatever this artifact
+    records."""
     import subprocess
 
     env = dict(os.environ)

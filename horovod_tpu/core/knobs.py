@@ -177,6 +177,19 @@ class Knobs:
     # flat data-parallel axis over all devices.
     mesh_spec: str = ""
 
+    # --- inference serving (serving/) ---
+    # padded batch-size buckets the engine AOT-compiles; requests are
+    # coalesced into the smallest covering bucket (docs/serving.md)
+    serving_buckets: str = "1,4,16,64"
+    # dynamic-batching window: how long the batcher holds the first
+    # request of a batch open for co-arrivals
+    serving_max_wait_ms: float = 5.0
+    # bounded admission queue (pending examples); beyond it submit
+    # rejects instead of building unbounded latency
+    serving_queue_limit: int = 256
+    # default per-request deadline (queue wait + execution)
+    serving_request_timeout_seconds: float = 30.0
+
     @staticmethod
     def from_env() -> "Knobs":
         return Knobs(
@@ -236,4 +249,11 @@ class Knobs:
             log_level=_env("LOG_LEVEL", "WARNING") or "WARNING",
             log_hide_timestamp=_env_bool("LOG_HIDE_TIME", False),
             mesh_spec=_env("MESH", "") or "",
+            serving_buckets=_env("SERVING_BUCKETS", "1,4,16,64")
+            or "1,4,16,64",
+            serving_max_wait_ms=_env_float("SERVING_MAX_WAIT_MS", 5.0),
+            serving_queue_limit=_env_int("SERVING_QUEUE_LIMIT", 256),
+            serving_request_timeout_seconds=_env_float(
+                "SERVING_REQUEST_TIMEOUT", 30.0
+            ),
         )

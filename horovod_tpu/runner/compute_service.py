@@ -45,12 +45,12 @@ class ShutdownRequest:
 class ComputeService(BasicService):
     """Driver-side registry (reference compute_service.py:97)."""
 
-    def __init__(self, key: bytes):
+    def __init__(self, key: bytes, port: int = 0):
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._workers: Dict[str, Dict[int, str]] = {}
         self._shutdown = False
-        super().__init__(SERVICE_NAME, key)
+        super().__init__(SERVICE_NAME, key, port=port)
 
     def _handle(self, req, client_address):
         if isinstance(req, RegisterWorkerRequest):

@@ -81,7 +81,8 @@ class BasicService:
     response object (reference network.py:102).
     """
 
-    def __init__(self, name: str, key: bytes, nics: Optional[List[str]] = None):
+    def __init__(self, name: str, key: bytes,
+                 nics: Optional[List[str]] = None, port: int = 0):
         self._name = name
         self._wire = Wire(key)
         service = self
@@ -103,7 +104,10 @@ class BasicService:
             daemon_threads = True
             allow_reuse_address = True
 
-        self._server = _Server(("0.0.0.0", 0), _Handler)
+        # port 0 (the default) = ephemeral, the launcher-internal case;
+        # a fixed port serves standalone registries workers are told
+        # about by address (e.g. the serving-replica registry)
+        self._server = _Server(("0.0.0.0", port), _Handler)
         self._port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,

@@ -1,0 +1,44 @@
+"""TPU-native inference serving: the first non-training workload.
+
+The training stack's spine — orbax checkpoints (checkpoint.py), mesh +
+sharding rules (parallel/), the authenticated control plane (runner/),
+live metrics (utils/metrics.py), fault injection (utils/faults.py) and
+preemption-safe shutdown (elastic/preemption.py) — is exactly what a
+serving tier needs; this package adds the one genuinely new piece
+(dynamic batching over bucketed AOT executables) and composes the rest:
+
+* :class:`~horovod_tpu.serving.engine.InferenceEngine` — checkpoint
+  restore + padded batch-size buckets AOT-compiled per
+  ``HOROVOD_SERVING_BUCKETS``, cached by (bucket, dtype);
+* :class:`~horovod_tpu.serving.batcher.DynamicBatcher` — bounded
+  admission, deadline-aware coalescing into the smallest covering
+  bucket;
+* :class:`~horovod_tpu.serving.server.ServingServer` — POST
+  /v1/predict + /healthz + /metrics over the per-job shared secret;
+* :class:`~horovod_tpu.serving.replica_set.ReplicaSet` — least-loaded
+  multi-replica dispatch with transparent failover and SIGTERM
+  drain-then-exit (exit code 83).
+
+See docs/serving.md for architecture, knobs and the load-generator
+recipe (scripts/serving_loadgen.py).
+"""
+
+from .batcher import (  # noqa: F401
+    Draining,
+    DynamicBatcher,
+    QueueFull,
+    RequestTimeout,
+)
+from .engine import (  # noqa: F401
+    InferenceEngine,
+    SERVING_META_KEY,
+    build_apply_fn,
+    parse_buckets,
+)
+from .replica_set import (  # noqa: F401
+    SERVING_KIND,
+    ReplicaSet,
+    predict_remote,
+    serve_replica,
+)
+from .server import AUTH_HEADER, ServingServer, sign_body  # noqa: F401

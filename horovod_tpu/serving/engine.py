@@ -1,0 +1,287 @@
+"""Inference engine: checkpoint restore + bucketed AOT execution.
+
+The training side already owns everything an inference tier needs
+except one piece: a way to run *variable-size* request batches through
+*fixed-shape* compiled programs. XLA recompiles on every new input
+shape, and a recompile mid-request is a multi-second latency cliff, so
+the engine AOT-compiles a small ladder of padded batch-size buckets up
+front (``HOROVOD_SERVING_BUCKETS``, default ``1,4,16,64`` — the same
+pad-to-bucket idea the fusion planner applies to gradient tensors) and
+serves every request from the smallest covering bucket. Executables are
+cached by ``(bucket, input dtype)``; parameters come back from the
+orbax checkpoint layer (``checkpoint.load_params``) and are placed per
+the ``parallel/`` sharding rules when a mesh is given.
+
+The ``serving.replica_exec`` fault point fires before every executed
+batch, so the chaos tooling (utils/faults.py) can kill or error a
+replica mid-request and prove the dispatch tier's retry path works
+(tests/test_serving.py, docs/faults.md).
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import faults, metrics
+
+#: metadata key ``save_model``/``save_params`` users set so a replica
+#: process can rebuild the apply_fn from the checkpoint alone
+#: (see :func:`build_apply_fn`).
+SERVING_META_KEY = "serving"
+
+
+def serving_knobs():
+    """The serving_* knob source: the live global Knobs when
+    ``hvd.init()`` ran in this process (so programmatic
+    ``Knobs(serving_...=...)`` works like every other knob), else a
+    fresh env parse — serving replica processes never init the
+    training world."""
+    from ..core.state import global_state
+
+    gs = global_state()
+    if gs.initialized:
+        return gs.knobs
+    from ..core.knobs import Knobs
+
+    return Knobs.from_env()
+
+
+def parse_buckets(spec: Optional[str] = None) -> Tuple[int, ...]:
+    """``HOROVOD_SERVING_BUCKETS`` ("1,4,16,64") → sorted unique ints."""
+    if spec is None:
+        spec = serving_knobs().serving_buckets or "1,4,16,64"
+    out = sorted({int(b) for b in str(spec).replace(";", ",").split(",")
+                  if str(b).strip()})
+    if not out or out[0] < 1:
+        raise ValueError(f"invalid serving bucket spec {spec!r}")
+    return tuple(out)
+
+
+def build_apply_fn(metadata: Dict[str, Any]) -> Callable:
+    """Rebuild ``apply_fn(params, x)`` from checkpoint metadata.
+
+    The ``serving`` metadata block names the model the checkpoint was
+    trained with, so a replica process needs nothing but the checkpoint
+    path — the serving analog of ``load_model`` rebuilding the optimizer
+    from its saved spec:
+
+    * ``{"model": "mlp", "features": [128, 64, 10]}`` — the built-in
+      MLP family (models/mlp.py);
+    * ``{"model": "pkg.mod:factory", "kwargs": {...}}`` — an import
+      path to a factory returning ``apply_fn``.
+    """
+    m = dict(metadata.get(SERVING_META_KEY, {}))
+    name = m.get("model", "")
+    if name == "mlp":
+        from ..models.mlp import MLP
+
+        mod = MLP(features=tuple(m.get("features", (128, 64, 10))))
+        return lambda p, x: mod.apply({"params": p}, x)
+    if ":" in name:
+        mod_name, _, attr = name.partition(":")
+        factory = getattr(importlib.import_module(mod_name), attr)
+        return factory(**m.get("kwargs", {}))
+    raise ValueError(
+        f"checkpoint metadata has no rebuildable serving model "
+        f"(metadata[{SERVING_META_KEY!r}] = {m!r}); pass apply_fn "
+        "explicitly or save metadata={'serving': {'model': ...}}"
+    )
+
+
+class InferenceEngine:
+    """Run padded request batches through AOT-compiled bucket programs.
+
+    ``apply_fn(params, x)`` is the pure forward pass; ``params`` are
+    host or device arrays (typically from ``checkpoint.load_params``).
+    With a ``mesh``, parameters are placed by the ``parallel/`` rules
+    (default: every leaf replicated — the data-parallel serving layout,
+    where throughput comes from more replicas, not sharded weights) and
+    inputs/outputs are mesh-committed; without one, plain single-device
+    jit.
+    """
+
+    #: executables kept per engine; beyond this the least-recently-used
+    #: program is dropped (shape/dtype-diverse traffic must not grow
+    #: the cache for the process lifetime)
+    MAX_CACHED_EXECUTABLES = 32
+
+    def __init__(
+        self,
+        apply_fn: Callable,
+        params: Any,
+        *,
+        buckets: Optional[Sequence[int]] = None,
+        mesh=None,
+        sharding_rules=None,
+        default_dtype: str = "float32",
+        feature_shape: Optional[Sequence[int]] = None,
+    ):
+        import jax
+
+        self._apply = apply_fn
+        self._buckets = (tuple(sorted(set(int(b) for b in buckets)))
+                         if buckets else parse_buckets())
+        self._mesh = mesh
+        self._default_dtype = default_dtype
+        # the declared per-example shape contract (checkpoint
+        # metadata input_shape): requests violating it are CLIENT
+        # errors (ValueError → 400), not model crashes — a flax
+        # shape error would surface as a 500 and read as replica
+        # death to the dispatch tier
+        self._feature_shape = (tuple(int(d) for d in feature_shape)
+                               if feature_shape else None)
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # execution is serialized (one accelerator per replica);
+        # compilation has its OWN lock so a cold shape's multi-second
+        # AOT compile never stalls warm-bucket traffic
+        self._lock = threading.Lock()
+        self._compile_lock = threading.Lock()
+        self._in_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.sharding import make_param_shardings
+
+            shardings = make_param_shardings(params, mesh, sharding_rules)
+            params = jax.tree_util.tree_map(
+                jax.device_put, params, shardings)
+            # requests are replicated over the mesh: bucket sizes (1, 4,
+            # ...) rarely divide the data axes, and per-replica
+            # throughput is the batcher's job, not the mesh's
+            self._in_sharding = NamedSharding(mesh, P())
+        else:
+            params = jax.device_put(params)
+        self._params = params
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        path: str,
+        apply_fn: Optional[Callable] = None,
+        **kwargs,
+    ) -> "InferenceEngine":
+        """Restore params from an orbax checkpoint (checkpoint.py) and
+        build the engine; ``apply_fn`` defaults to the model named in
+        the checkpoint's ``serving`` metadata block."""
+        from ..checkpoint import load_params
+
+        params, metadata = load_params(path)
+        if apply_fn is None:
+            apply_fn = build_apply_fn(metadata)
+        meta = metadata.get(SERVING_META_KEY, {})
+        kwargs.setdefault("default_dtype", meta.get("dtype", "float32"))
+        kwargs.setdefault("feature_shape", meta.get("input_shape"))
+        eng = cls(apply_fn, params, **kwargs)
+        eng.metadata = metadata
+        return eng
+
+    # -- bucket machinery ---------------------------------------------------
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._buckets
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest compiled bucket covering ``n`` examples (callers
+        split batches larger than the top bucket — see __call__)."""
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    @staticmethod
+    def _canonical_dtype(dtype) -> str:
+        """The dtype jax will actually compile for: without x64, a
+        float64 request lowers to the SAME program as float32 — keying
+        the cache on the raw request dtype would compile and cache
+        duplicates."""
+        import jax
+
+        return str(jax.dtypes.canonicalize_dtype(np.dtype(dtype)))
+
+    def _executable(self, bucket: int, feature_shape: Tuple[int, ...],
+                    dtype: str):
+        import jax
+
+        # feature shape is part of the compiled program's identity: a
+        # (4, 8) executable cannot serve (4, 16) inputs, so a workload
+        # mixing example shapes compiles one program per shape instead
+        # of poisoning the bucket's cache slot with whichever came
+        # first
+        key = (bucket, tuple(feature_shape), dtype)
+        with self._compile_lock:
+            ex = self._cache.get(key)
+            if ex is not None:
+                self._cache.move_to_end(key)
+                return ex
+            t0 = time.perf_counter()
+            x_s = jax.ShapeDtypeStruct((bucket,) + tuple(feature_shape),
+                                       np.dtype(dtype))
+            if self._in_sharding is not None:
+                jitted = jax.jit(
+                    self._apply, in_shardings=(None, self._in_sharding))
+            else:
+                jitted = jax.jit(self._apply)
+            ex = jitted.lower(self._params, x_s).compile()
+            self._cache[key] = ex
+            while len(self._cache) > self.MAX_CACHED_EXECUTABLES:
+                self._cache.popitem(last=False)
+            metrics.record_serving_compile(
+                bucket, time.perf_counter() - t0)
+            return ex
+
+    def warmup(self, feature_shape: Sequence[int],
+               dtype: Optional[str] = None) -> None:
+        """AOT-compile every bucket for one example shape up front, so
+        the first real request of each size pays no compile."""
+        dtype = self._canonical_dtype(dtype or self._default_dtype)
+        for b in self._buckets:
+            self._executable(b, tuple(feature_shape), dtype)
+
+    # -- execution ----------------------------------------------------------
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        """Predict on ``x`` ([n, ...features]): pad to the covering
+        bucket, execute, slice the padding back off. Batches above the
+        top bucket run as multiple top-bucket chunks."""
+        import jax
+
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] < 1:
+            raise ValueError(f"predict needs [n, ...] input, got {x.shape}")
+        if (self._feature_shape is not None
+                and tuple(x.shape[1:]) != self._feature_shape):
+            raise ValueError(
+                f"example shape {tuple(x.shape[1:])} does not match the "
+                f"model's declared input_shape {self._feature_shape}")
+        n = x.shape[0]
+        top = self._buckets[-1]
+        if n > top:
+            return np.concatenate(
+                [self(x[i:i + top]) for i in range(0, n, top)], axis=0)
+        bucket = self.bucket_for(n)
+        dtype = self._canonical_dtype(x.dtype)
+        if str(x.dtype) != dtype:
+            x = x.astype(dtype)
+        # compile (if cold) OUTSIDE the execution lock — a new shape's
+        # multi-second AOT must not stall warm traffic
+        ex = self._executable(bucket, x.shape[1:], dtype)
+        with self._lock:
+            faults.inject("serving.replica_exec", bucket=bucket)
+            if bucket != n:
+                pad = np.zeros((bucket - n,) + x.shape[1:], x.dtype)
+                xb = np.concatenate([x, pad], axis=0)
+            else:
+                xb = x
+            xb = jax.numpy.asarray(xb)
+            if self._in_sharding is not None:
+                xb = jax.device_put(xb, self._in_sharding)
+            out = ex(self._params, xb)
+        metrics.record_serving_batch(bucket, n)
+        return np.asarray(out)[:n]

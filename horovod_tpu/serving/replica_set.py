@@ -1,0 +1,420 @@
+"""Multi-replica data-parallel dispatch for the serving tier.
+
+One replica = one process owning its accelerator(s), running
+engine + batcher behind the HTTP front end (server.py). This module is
+everything around them:
+
+* **registration** — replicas announce ``(kind="serving", index,
+  host:port)`` through the launcher's authenticated registry
+  (``runner/compute_service.py``), exactly as data-service compute
+  workers do; the front door waits for N replicas the same way
+  trainers wait for data workers;
+* **routing** — :class:`ReplicaSet` tracks per-replica in-flight
+  counts locally and routes each request to the least-loaded live
+  replica;
+* **failover** — a replica that dies mid-request (connection error or
+  5xx) is ejected and the request retried on another replica under the
+  shared :class:`~horovod_tpu.utils.retry.RetryPolicy`
+  (``serving.dispatch`` retry point) — the client never sees the
+  death. When every replica is ejected the set forgives them all once
+  and re-probes, so a restarted replica rejoins without a control
+  plane round-trip;
+* **drain-then-exit** — ``python -m horovod_tpu.serving.replica_set``
+  installs the preemption handler (elastic/preemption.py): SIGTERM
+  stops admission, flushes the batcher and in-flight HTTP requests,
+  then exits with ``PREEMPTED_EXIT_CODE`` (83) so the launcher knows
+  the host went away healthy.
+
+Fault points: ``serving.dispatch`` fires before every routed attempt
+(front door), ``serving.replica_exec`` before every executed batch
+(replica, engine.py) — see docs/faults.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils import faults, metrics, retry
+from .batcher import RequestTimeout
+from .server import AUTH_HEADER, ServingServer, sign_body
+
+SERVING_KIND = "serving"
+
+
+def _build_body(x: np.ndarray,
+                timeout_s: Optional[float] = None) -> bytes:
+    """Serialize one predict request ONCE — the dispatch tier reuses
+    these bytes across failover attempts instead of re-running
+    tolist/dumps/HMAC on every retry."""
+    x = np.asarray(x)
+    body_obj = {"inputs": x.tolist(), "dtype": str(x.dtype)}
+    if timeout_s:
+        body_obj["timeout_ms"] = int(timeout_s * 1e3)
+    return json.dumps(body_obj).encode()
+
+
+def _post_body(addr: str, body: bytes, sock_timeout: float,
+               key: Optional[bytes] = None) -> np.ndarray:
+    req = urllib.request.Request(
+        f"http://{addr}/v1/predict", data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    if key is not None:
+        req.add_header(AUTH_HEADER, sign_body(key, body))
+    with urllib.request.urlopen(req, timeout=sock_timeout) as resp:
+        payload = json.loads(resp.read())
+    return np.asarray(payload["outputs"],
+                      dtype=np.dtype(payload.get("dtype", "float32")))
+
+
+def predict_remote(
+    addr: str,
+    x: np.ndarray,
+    timeout_s: Optional[float] = None,
+    key: Optional[bytes] = None,
+) -> np.ndarray:
+    """One POST /v1/predict against ``host:port`` (no retries — that's
+    the ReplicaSet's job). Raises urllib.error.HTTPError / OSError."""
+    return _post_body(addr, _build_body(x, timeout_s),
+                      (timeout_s or 30.0) + 5.0, key=key)
+
+
+def _dispatch_retryable(exc: BaseException) -> bool:
+    """5xx (replica dying/draining) and 429 (that replica saturated)
+    retry on another replica; other HTTP codes are client errors and
+    propagate. Transport failures retry — except the dispatch tier's
+    own deadline marker, which says the request budget is SPENT."""
+    if isinstance(exc, RequestTimeout):
+        return False
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code == 429 or (exc.code >= 500 and exc.code != 504)
+    return isinstance(exc, (OSError, EOFError))
+
+
+def _ejects_replica(exc: BaseException) -> bool:
+    """Failures that mean the REPLICA is gone (eject it from dispatch),
+    vs merely busy. A 429 is backpressure from a healthy replica — the
+    request retries elsewhere but the replica stays in rotation;
+    ejecting it would durably cut capacity exactly when load is
+    highest."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return exc.code >= 500 and exc.code != 504
+    return isinstance(exc, (OSError, EOFError))
+
+
+class ReplicaSet:
+    """Least-loaded dispatch with transparent failover.
+
+    ``replicas`` maps index -> "host:port" (usually the
+    ComputeService's WorkersResponse). Thread-safe: the front end calls
+    ``predict`` from concurrent request threads.
+    """
+
+    def __init__(
+        self,
+        replicas: Dict[int, str],
+        *,
+        key: Optional[bytes] = None,
+        policy: Optional[retry.RetryPolicy] = None,
+        default_timeout_s: float = 30.0,
+    ):
+        if not replicas:
+            raise ValueError("ReplicaSet needs at least one replica")
+        self._replicas = dict(replicas)
+        self._key = key
+        # failover must outlast losing every replica but one: give the
+        # policy enough attempts to walk the whole set and then some
+        self._policy = policy or retry.RetryPolicy(
+            max_attempts=max(len(replicas) + 2, 4),
+            base_delay_s=0.05, max_delay_s=0.5,
+        )
+        self._default_timeout_s = default_timeout_s
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, int] = {i: 0 for i in replicas}
+        self._dead: Dict[int, str] = {}
+        self._rr = 0
+
+    # -- bookkeeping --------------------------------------------------------
+
+    @property
+    def replicas(self) -> Dict[int, str]:
+        return dict(self._replicas)
+
+    @property
+    def dead(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._dead)
+
+    def _pick(self) -> Tuple[int, str]:
+        with self._lock:
+            live = [i for i in self._replicas if i not in self._dead]
+            if not live:
+                # total eclipse: forgive everyone once instead of
+                # locking the front door shut — a restarted replica
+                # answers, a still-dead one re-ejects on its next miss
+                self._dead.clear()
+                live = list(self._replicas)
+            self._rr += 1
+            idx = min(live, key=lambda i: (self._inflight[i],
+                                           (i + self._rr) % len(live)))
+            self._inflight[idx] += 1
+            n = self._inflight[idx]
+        metrics.set_serving_inflight(n, replica=str(idx))
+        return idx, self._replicas[idx]
+
+    def _release(self, idx: int) -> None:
+        with self._lock:
+            self._inflight[idx] -= 1
+            n = self._inflight[idx]
+        metrics.set_serving_inflight(n, replica=str(idx))
+
+    def _mark_dead(self, idx: int, why: BaseException) -> None:
+        with self._lock:
+            already = idx in self._dead
+            self._dead[idx] = f"{type(why).__name__}: {why}"
+        if not already:
+            metrics.record_serving_failover(str(idx))
+
+    def revive(self, idx: Optional[int] = None) -> None:
+        """Forgive one replica (or all) — e.g. after an external
+        health check saw it come back."""
+        with self._lock:
+            if idx is None:
+                self._dead.clear()
+            else:
+                self._dead.pop(idx, None)
+
+    # -- dispatch -----------------------------------------------------------
+
+    def predict(self, x: np.ndarray,
+                timeout_s: Optional[float] = None) -> np.ndarray:
+        """Route one request; retried across replicas on failure so a
+        replica death is invisible to the caller."""
+        if timeout_s is None:
+            timeout_s = self._default_timeout_s
+        # serialize once; every failover attempt reuses the bytes
+        body = _build_body(x, timeout_s)
+        deadline = retry.Deadline(timeout_s)
+
+        def _attempt() -> np.ndarray:
+            if deadline.expired():
+                # stop the retry ladder once the request's own budget
+                # is gone — more attempts only delay the 504 the
+                # client has already paid for
+                raise RequestTimeout(
+                    f"request budget {timeout_s}s exhausted during "
+                    f"dispatch/failover")
+            idx, addr = self._pick()
+            try:
+                faults.inject("serving.dispatch", replica=idx)
+                remaining = max(deadline.remaining(), 0.5)
+                # a replica that accepts the connection but never
+                # answers must not swallow the whole request budget:
+                # with peers available, cap each attempt at half the
+                # remaining deadline so the socket timeout leaves room
+                # for at least one failover
+                att = (remaining / 2.0 if len(self._replicas) > 1
+                       else remaining)
+                att = max(att, 0.5)
+                return _post_body(addr, body, att + 1.0, key=self._key)
+            except BaseException as e:
+                if _ejects_replica(e):
+                    self._mark_dead(idx, e)
+                raise
+            finally:
+                self._release(idx)
+
+        return self._policy.call(
+            _attempt, point="serving.dispatch",
+            retryable=_dispatch_retryable,
+        )
+
+    def __call__(self, x: np.ndarray,
+                 timeout_s: Optional[float] = None) -> np.ndarray:
+        return self.predict(x, timeout_s)
+
+
+# ---------------------------------------------------------------------------
+# process entry points: one replica, or the front door
+# ---------------------------------------------------------------------------
+
+def _secret_or_none() -> Optional[bytes]:
+    from ..runner.util import secret
+
+    try:
+        return secret.secret_from_env()
+    except RuntimeError:
+        return None
+
+
+def _install_drain_handler(server: ServingServer, batcher,
+                           drain_timeout_s: float) -> None:
+    from ..elastic import preemption
+
+    def _drain():
+        server.draining = True          # stop admission first
+        if batcher is not None:
+            batcher.close(drain=True, timeout_s=drain_timeout_s)
+        server.drain(timeout_s=drain_timeout_s)
+        # settle: in-flight handlers decrement before their response
+        # write; give those last writes a beat before os._exit
+        time.sleep(0.25)
+
+    preemption.install(on_preempt=_drain)
+
+
+def _register(register: str, index: int, port: int,
+              key: Optional[bytes]) -> None:
+    from ..runner.compute_service import ComputeClient
+    from ..runner.util.network import routable_host_address
+
+    if key is None:
+        raise RuntimeError(
+            "--register needs the per-job secret in the environment "
+            "(HVD_TPU_SECRET_KEY) — the registry authenticates")
+    host, _, p = register.rpartition(":")
+    client = ComputeClient([(host, int(p))], key)
+    client.register_worker(
+        SERVING_KIND, index, f"{routable_host_address()}:{port}")
+
+
+def serve_replica(argv=None) -> int:
+    """``python -m horovod_tpu.serving.replica_set --checkpoint ...``:
+    restore, AOT-warm the buckets, serve until SIGTERM drains us."""
+    ap = argparse.ArgumentParser(
+        description="horovod_tpu serving replica / front door")
+    ap.add_argument("--checkpoint", default="",
+                    help="orbax checkpoint dir (save_model/save_params)")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--index", type=int, default=0)
+    ap.add_argument("--register", default="",
+                    help="host:port of the ComputeService registry")
+    ap.add_argument("--buckets", default="",
+                    help="override HOROVOD_SERVING_BUCKETS")
+    ap.add_argument("--max-wait-ms", type=float, default=None)
+    ap.add_argument("--queue-limit", type=int, default=None)
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="compile buckets lazily on first use")
+    ap.add_argument("--drain-timeout", type=float, default=30.0)
+    ap.add_argument("--front-door", action="store_true",
+                    help="serve as the dispatch tier instead of a "
+                         "replica (needs --register + --wait-replicas "
+                         "or --replicas)")
+    ap.add_argument("--registry", action="store_true",
+                    help="run the standalone ComputeService registry "
+                         "replicas/front door --register against "
+                         "(binds --port)")
+    ap.add_argument("--wait-replicas", type=int, default=0,
+                    help="front door: replicas to wait for in the "
+                         "registry before serving")
+    ap.add_argument("--wait-timeout", type=float, default=300.0,
+                    help="front door: seconds to wait for "
+                         "--wait-replicas registrations (replicas "
+                         "register only after checkpoint restore + "
+                         "bucket AOT warmup)")
+    ap.add_argument("--replicas", default="",
+                    help="front door: comma list of host:port "
+                         "(skips the registry)")
+    args = ap.parse_args(argv)
+
+    metrics.enable()  # serving is an observability-first workload
+    faults.configure()  # arm HOROVOD_TPU_FAULT_SPEC if the env set one
+    key = _secret_or_none()
+
+    if args.registry:
+        from ..runner.compute_service import ComputeService
+
+        if key is None:
+            raise RuntimeError("--registry needs HVD_TPU_SECRET_KEY")
+        svc = ComputeService(key, port=args.port)
+        print(f"SERVING_REGISTRY_READY index=0 port={svc.port}",
+              flush=True)
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            svc.shutdown()
+            return 0
+
+    batcher = None
+    if args.front_door:
+        if args.replicas:
+            replicas = {i: a for i, a in
+                        enumerate(args.replicas.split(","))}
+        elif args.register and args.wait_replicas:
+            from ..runner.compute_service import ComputeClient
+
+            host, _, p = args.register.rpartition(":")
+            if key is None:
+                raise RuntimeError("--register needs HVD_TPU_SECRET_KEY")
+            client = ComputeClient([(host, int(p))], key)
+            replicas = client.wait_for_workers(
+                SERVING_KIND, args.wait_replicas,
+                timeout_s=args.wait_timeout)
+            if len(replicas) < args.wait_replicas:
+                # the registry returns whatever registered on timeout;
+                # silently serving at partial capacity despite
+                # --wait-replicas N would hide a broken replica fleet
+                raise RuntimeError(
+                    f"only {len(replicas)}/{args.wait_replicas} "
+                    f"serving replicas registered within "
+                    f"{args.wait_timeout}s")
+        else:
+            raise RuntimeError(
+                "front door needs --replicas or --register + "
+                "--wait-replicas")
+        rs = ReplicaSet(replicas, key=key)
+        server = ServingServer(
+            rs.predict, port=args.port, key=key,
+            health_extra=lambda: {"replicas": rs.replicas,
+                                  "dead": rs.dead})
+        role = "front-door"
+    else:
+        from .batcher import DynamicBatcher
+        from .engine import InferenceEngine, SERVING_META_KEY, parse_buckets
+
+        if not args.checkpoint:
+            ap.error("--checkpoint is required for a replica")
+        engine = InferenceEngine.from_checkpoint(
+            args.checkpoint,
+            buckets=parse_buckets(args.buckets) if args.buckets else None,
+        )
+        meta = getattr(engine, "metadata", {}).get(SERVING_META_KEY, {})
+        if not args.no_warmup and meta.get("input_shape"):
+            engine.warmup(tuple(meta["input_shape"]),
+                          meta.get("dtype", "float32"))
+        batcher = DynamicBatcher(
+            engine, max_batch=engine.buckets[-1],
+            max_wait_ms=args.max_wait_ms, queue_limit=args.queue_limit,
+        ).start()
+        server = ServingServer(
+            batcher.__call__, port=args.port, key=key,
+            health_extra=lambda: {"buckets": list(engine.buckets),
+                                  "queued": batcher.pending},
+        )
+        role = "replica"
+
+    port = server.start()
+    if args.register and not args.front_door:
+        _register(args.register, args.index, port, key)
+    _install_drain_handler(server, batcher, args.drain_timeout)
+    print(f"SERVING_{role.upper().replace('-', '_')}_READY "
+          f"index={args.index} port={port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(serve_replica() or 0)

@@ -1,0 +1,251 @@
+"""HTTP front end for inference: POST /v1/predict, /healthz, /metrics.
+
+Same transport family as the control plane: a threaded stdlib HTTP
+server in the mold of ``runner/http/http_server.py`` (per-request
+threads, silent logging, Content-Length replies, the shared
+``utils.metrics.exposition()`` mount for ``GET /metrics``), carrying
+the launcher's per-job shared secret (``runner/util/secret.py``) as
+request authentication: when a key is set, every predict body must be
+accompanied by ``X-Hvd-Auth: hex(hmac_sha256(key, body))`` — the HTTP
+twin of the HMAC framing every TCP control-plane message already has
+(``runner/util/network.py``). Probe routes (``/healthz``, ``/metrics``)
+stay unauthenticated, k8s-style.
+
+Protocol::
+
+    POST /v1/predict
+    {"inputs": [[...], ...], "dtype": "float32", "timeout_ms": 2000}
+    -> 200 {"outputs": [[...], ...], "n": 2}
+       401 bad/missing auth        413 oversized body
+       429 admission queue full    503 draining / injected failure
+       504 request deadline expired
+
+The same class fronts a single replica (predict_fn = the batcher) and
+the multi-replica dispatch tier (predict_fn = ReplicaSet.predict) — the
+wire surface is identical either way, which is what lets the load
+generator and the chaos tooling drive both.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import threading
+import time
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..utils import metrics
+from .batcher import Draining, QueueFull, RequestTimeout
+
+AUTH_HEADER = "X-Hvd-Auth"
+MAX_BODY_BYTES = 64 << 20  # one request can't swallow the heap
+
+
+def sign_body(key: bytes, body: bytes) -> str:
+    """The predict-request auth token: hex HMAC-SHA256 over the raw
+    body with the per-job secret (client side of the check above)."""
+    return hmac.new(key, body, hashlib.sha256).hexdigest()
+
+
+class _ServingHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- helpers ------------------------------------------------------------
+
+    def _reply(self, code: int, body: bytes,
+               ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            # tell HTTP/1.1 keep-alive clients the stream ends here
+            # (set on paths that left request bytes unread, e.g. 413)
+            self.send_header("Connection", "close")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, obj: Dict) -> None:
+        self._reply(code, json.dumps(obj).encode())
+
+    def log_message(self, *args):  # silence per-request logging
+        pass
+
+    # -- routes -------------------------------------------------------------
+
+    def do_GET(self):
+        srv: "ServingServer" = self.server.serving  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/metrics":
+            ctype, body = metrics.exposition()
+            self._reply(200, body, ctype)
+        elif path == "/healthz":
+            self._reply_json(200 if not srv.draining else 503,
+                             srv.health())
+        else:
+            self._reply_json(404, {"error": "not found"})
+
+    def do_POST(self):
+        srv: "ServingServer" = self.server.serving  # type: ignore[attr-defined]
+        if self.path.split("?", 1)[0].rstrip("/") != "/v1/predict":
+            self._reply_json(404, {"error": "not found"})
+            return
+        t0 = time.perf_counter()
+        # count ourselves in-flight BEFORE touching the body: body
+        # read + parse of a large request takes real time, and drain()
+        # must not report empty (and let SIGTERM os._exit) while a
+        # request is mid-read — the whole handling INCLUDING the
+        # response write sits inside the in-flight window
+        srv._inflight_delta(+1)
+        code, resp = 500, {"error": "internal"}
+        try:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if length > MAX_BODY_BYTES:
+                    # the oversized body is NOT read: close the
+                    # connection so a keep-alive client can't have its
+                    # next request parsed out of the unconsumed bytes
+                    self.close_connection = True
+                    code, resp = 413, {"error": "body too large"}
+                    return
+                body = self.rfile.read(length)
+                if srv.key is not None:
+                    token = self.headers.get(AUTH_HEADER, "")
+                    if not hmac.compare_digest(
+                            token, sign_body(srv.key, body)):
+                        code, resp = 401, {"error": "bad auth"}
+                        return
+                if srv.draining:
+                    code, resp = 503, {"error": "draining"}
+                    return
+                try:
+                    req = json.loads(body)
+                    x = np.asarray(
+                        req["inputs"],
+                        dtype=np.dtype(req.get("dtype", "float32")))
+                    timeout_s = (float(req["timeout_ms"]) / 1e3
+                                 if req.get("timeout_ms") else None)
+                except (ValueError, KeyError, TypeError) as e:
+                    code, resp = 400, {"error": f"bad request: {e}"}
+                    return
+                y = np.asarray(srv.predict_fn(x, timeout_s))
+                code, resp = 200, {"outputs": y.tolist(),
+                                   "dtype": str(y.dtype),
+                                   "n": int(y.shape[0])}
+            except QueueFull as e:
+                code, resp = 429, {"error": str(e)}
+            except (RequestTimeout, TimeoutError) as e:
+                code, resp = 504, {"error": str(e)}
+            except Draining as e:
+                code, resp = 503, {"error": str(e)}
+            except urllib.error.HTTPError as e:
+                # front-door role: an upstream replica's verdict (a
+                # 400 the dispatch tier rightly refused to retry, an
+                # exhausted-retry 429/503) passes through with its own
+                # status — a client error or backpressure must not be
+                # re-reported as a front-door 500
+                code, resp = e.code, {"error": f"replica: {e}"}
+            except ValueError as e:
+                # batcher.submit/engine reject malformed inputs
+                # (empty batch, bad shape) with ValueError — that is
+                # the CLIENT's error; a 500 here would read as replica
+                # death to the dispatch tier and eject a healthy
+                # replica
+                code, resp = 400, {"error": f"bad request: {e}"}
+            except ConnectionError as e:
+                # includes faults.InjectedFault — a chaos rule at
+                # serving.admit / serving.replica_exec surfaces as a
+                # retryable 503, the same class a dying replica
+                # produces
+                code, resp = 503, {"error": f"transient: {e}"}
+            except Exception as e:  # noqa: BLE001 — must answer
+                code, resp = 500, {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            try:
+                self._finish(code, resp, t0)
+            finally:
+                srv._inflight_delta(-1)
+
+    def _finish(self, code: int, resp: Dict, t0: float) -> None:
+        metrics.record_serving_request(time.perf_counter() - t0, code)
+        self._reply_json(code, resp)
+
+
+class ServingServer:
+    """Threaded HTTP server around a ``predict_fn(x, timeout_s)``.
+
+    ``key`` enables the shared-secret auth (pass
+    ``secret.secret_from_env()`` in launcher-spawned replicas).
+    ``drain()`` implements the preemption contract: stop admission,
+    wait for in-flight requests to flush, return — the SIGTERM handler
+    (elastic/preemption.py) calls it before exiting 83.
+    """
+
+    def __init__(
+        self,
+        predict_fn: Callable[[np.ndarray, Optional[float]], np.ndarray],
+        *,
+        port: int = 0,
+        key: Optional[bytes] = None,
+        health_extra: Optional[Callable[[], Dict]] = None,
+    ):
+        self.predict_fn = predict_fn
+        self.key = key
+        self.draining = False
+        self._health_extra = health_extra
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port),
+                                          _ServingHandler)
+        self._httpd.serving = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="hvd-serving-http")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        self._thread.start()
+        return self.port
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def health(self) -> Dict:
+        h = {"status": "draining" if self.draining else "ok",
+             "inflight": self._inflight}
+        if self._health_extra is not None:
+            try:
+                h.update(self._health_extra())
+            except Exception:
+                pass
+        return h
+
+    def _inflight_delta(self, d: int) -> None:
+        with self._inflight_lock:
+            self._inflight += d
+            n = self._inflight
+        metrics.set_serving_inflight(n)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admitting predicts and wait for in-flight ones to
+        finish; True when the server emptied within the budget."""
+        self.draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                if self._inflight == 0:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def shutdown(self) -> None:
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+        self._httpd.server_close()

@@ -5,8 +5,9 @@ runner/elastic/driver.py rounds, blacklisting) existed without any way
 to *prove* it works under failure. This module is the chaos layer: a
 spec string — ``HOROVOD_TPU_FAULT_SPEC`` — compiles into rules that
 fire at named injection points threaded through the HTTP client/server,
-elastic discovery, worker exec, eager-runtime negotiation, and
-checkpoint I/O.
+elastic discovery, worker exec, eager-runtime negotiation, checkpoint
+I/O, and the serving path (admission, replica dispatch, engine
+execution — ``serving.*``, docs/serving.md).
 
 Spec grammar (entries separated by ``;`` or ``,``; fields by ``:``)::
 

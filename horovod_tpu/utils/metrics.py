@@ -692,6 +692,95 @@ def set_queue_depth(n: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# inference-serving record functions (serving/ — engine, batcher,
+# server, replica dispatch). Same discipline as the training sites:
+# every function starts with the disabled fast path.
+# ---------------------------------------------------------------------------
+
+def record_serving_request(seconds: float, code: int) -> None:
+    """One completed front-end request (server.py), by HTTP status."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_requests_total",
+        "Serving requests completed, by HTTP status", ("code",),
+    ).labels(str(code)).inc()
+    registry.histogram(
+        "hvd_serving_request_seconds",
+        "End-to-end serving request latency, by HTTP status", ("code",),
+    ).labels(str(code)).observe(seconds)
+
+
+def record_serving_queue_wait(seconds: float) -> None:
+    """Admission-to-dispatch wait of one request in the dynamic
+    batcher's queue."""
+    if not _enabled:
+        return
+    registry.histogram(
+        "hvd_serving_queue_wait_seconds",
+        "Request wait in the dynamic-batching queue",
+    ).observe(seconds)
+
+
+def record_serving_batch(bucket: int, n_real: int) -> None:
+    """One executed inference batch: the chosen padded bucket and how
+    many real examples it carried (the rest is padding waste)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_batches_total",
+        "Inference batches executed, by padded bucket", ("bucket",),
+    ).labels(str(bucket)).inc()
+    registry.counter(
+        "hvd_serving_examples_total",
+        "Real examples served through executed batches").inc(n_real)
+    registry.counter(
+        "hvd_serving_padding_examples_total",
+        "Padding examples added to reach the bucket size",
+    ).inc(max(bucket - n_real, 0))
+    registry.histogram(
+        "hvd_serving_batch_fill_ratio",
+        "Real examples / padded bucket size per executed batch",
+        buckets=RATIO_BUCKETS,
+    ).observe(n_real / bucket if bucket else 0.0)
+
+
+def record_serving_compile(bucket: int, seconds: float) -> None:
+    """One bucket executable AOT-compiled by the inference engine."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_compiles_total",
+        "Bucket executables AOT-compiled, by bucket", ("bucket",),
+    ).labels(str(bucket)).inc()
+    registry.histogram(
+        "hvd_serving_compile_seconds",
+        "AOT compile wall time per bucket executable",
+    ).observe(seconds)
+
+
+def set_serving_inflight(n: int, replica: str = "") -> None:
+    """Requests currently executing, per replica ('' = this process)."""
+    if not _enabled:
+        return
+    registry.gauge(
+        "hvd_serving_inflight",
+        "In-flight serving requests, by replica", ("replica",),
+    ).labels(replica).set(n)
+
+
+def record_serving_failover(replica: str) -> None:
+    """A replica dropped from dispatch after a failed request (the
+    request itself is retried on another replica)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_failovers_total",
+        "Replicas ejected from dispatch after a failure", ("replica",),
+    ).labels(replica).inc()
+
+
+# ---------------------------------------------------------------------------
 # native runtime stats bridge (pull model)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Load generator for the serving tier (serving/) → SERVING_r{N}.json.
+
+Drives ``POST /v1/predict`` on a front door (or a single replica) in
+either loop discipline and emits ONE JSON line the driver can record,
+in the same shape bench.py uses:
+
+* **closed loop** (default): ``--concurrency`` workers each keep one
+  request outstanding — measures saturated throughput + latency;
+* **open loop**: requests arrive at ``--rate`` req/s regardless of
+  completions (the millions-of-users shape: arrivals don't wait for
+  the server), so queueing delay shows up in the tail instead of
+  being absorbed by backpressure.
+
+Request sizes are drawn uniformly from ``--examples lo:hi`` with a
+seeded RNG — deterministic traffic, same idiom as the fault
+framework's seeded rules. ``--scrape`` URLs (each replica's /metrics)
+are read after the run and the serving histograms folded into the
+artifact: batch fill ratio, padding waste, queue-wait quantiles.
+
+``--check`` is the smoke gate (metrics_summary.py --check /
+chaos_check.py idiom): exit 1 with a one-line reason unless every
+request succeeded, the latency percentiles are nonzero, and — when
+replicas were scraped — batches actually coalesced (nonzero fill
+ratio). tests/test_serving.py wires it into the loopback e2e.
+
+Usage:
+    python scripts/serving_loadgen.py --url http://127.0.0.1:8500 \\
+        --requests 200 --concurrency 8 --input-shape 8 \\
+        --scrape http://127.0.0.1:8601/metrics --out SERVING_r01.json
+    python scripts/serving_loadgen.py --url ... --mode open --rate 50 \\
+        --duration 5 --check
+"""
+
+import argparse
+import hashlib
+import hmac
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+AUTH_HEADER = "X-Hvd-Auth"
+
+
+def percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def _predict_url(base):
+    base = base.rstrip("/")
+    return base if base.endswith("/v1/predict") else base + "/v1/predict"
+
+
+class _Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies = []
+        self.errors = []
+        self.examples = 0
+
+    def ok(self, seconds, n):
+        with self.lock:
+            self.latencies.append(seconds)
+            self.examples += n
+
+    def fail(self, why):
+        with self.lock:
+            self.errors.append(why)
+
+
+def _one_request(url, key, rng_seed, shape, n_examples, dtype,
+                 timeout_ms, stats):
+    rng = np.random.RandomState(rng_seed)
+    x = rng.randn(n_examples, *shape).astype(dtype)
+    body_obj = {"inputs": x.tolist(), "dtype": dtype}
+    if timeout_ms:
+        body_obj["timeout_ms"] = int(timeout_ms)
+    body = json.dumps(body_obj).encode()
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    if key:
+        req.add_header(
+            AUTH_HEADER, hmac.new(key, body, hashlib.sha256).hexdigest())
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(
+                req, timeout=(timeout_ms or 30000) / 1e3 + 5.0) as resp:
+            payload = json.loads(resp.read())
+        if payload.get("n") != n_examples:
+            stats.fail(f"short response: {payload.get('n')} of "
+                       f"{n_examples} examples")
+            return
+        stats.ok(time.perf_counter() - t0, n_examples)
+    except urllib.error.HTTPError as e:
+        stats.fail(f"HTTP {e.code}: {e.read()[:120]!r}")
+    except Exception as e:  # noqa: BLE001 — every failure is a data point
+        stats.fail(f"{type(e).__name__}: {e}")
+
+
+def _scrape(url):
+    """Pull the serving families out of one Prometheus exposition."""
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            text = resp.read().decode()
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+    vals = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        if not name.startswith("hvd_serving_"):
+            continue
+        try:
+            v = float(line.rsplit(" ", 1)[1])
+        except ValueError:
+            continue
+        vals[name] = vals.get(name, 0.0) + v
+    return vals
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="serving load generator + smoke gate")
+    ap.add_argument("--url", required=True,
+                    help="front door base URL (or full /v1/predict)")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--requests", type=int, default=100,
+                    help="closed loop: total requests")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="open loop: arrivals per second")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="open loop: seconds of traffic")
+    ap.add_argument("--input-shape", default="8",
+                    help="comma dims of ONE example, e.g. 28,28,1")
+    ap.add_argument("--examples", default="1:4",
+                    help="examples per request, 'n' or 'lo:hi' uniform")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--timeout-ms", type=int, default=10000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--secret-env", default="HVD_TPU_SECRET_KEY",
+                    help="env var holding the per-job secret ('' = no "
+                         "auth header)")
+    ap.add_argument("--scrape", action="append", default=[],
+                    help="replica /metrics URL(s) to fold into the "
+                         "artifact (repeatable)")
+    ap.add_argument("--out", default="", help="also write the JSON here")
+    ap.add_argument("--check", action="store_true",
+                    help="smoke gate: nonzero exit unless traffic "
+                         "succeeded and batching metrics are live")
+    args = ap.parse_args(argv)
+
+    url = _predict_url(args.url)
+    key = (os.environ.get(args.secret_env, "").encode()
+           if args.secret_env else b"") or None
+    shape = tuple(int(d) for d in args.input_shape.split(",") if d)
+    if ":" in args.examples:
+        lo, hi = (int(v) for v in args.examples.split(":"))
+    else:
+        lo = hi = int(args.examples)
+    size_rng = np.random.RandomState(args.seed)
+
+    stats = _Stats()
+    t_start = time.perf_counter()
+    if args.mode == "closed":
+        plan = [(args.seed + 1 + i,
+                 int(size_rng.randint(lo, hi + 1)))
+                for i in range(args.requests)]
+        cursor = {"i": 0}
+        cursor_lock = threading.Lock()
+
+        def worker():
+            while True:
+                with cursor_lock:
+                    if cursor["i"] >= len(plan):
+                        return
+                    seed, n = plan[cursor["i"]]
+                    cursor["i"] += 1
+                _one_request(url, key, seed, shape, n, args.dtype,
+                             args.timeout_ms, stats)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(args.concurrency, 1))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:
+        # open loop: fire-and-track at the arrival rate; each request
+        # gets its own thread so a slow server cannot slow arrivals
+        interval = 1.0 / max(args.rate, 1e-6)
+        threads = []
+        i = 0
+        t_end = time.perf_counter() + args.duration
+        next_t = time.perf_counter()
+        while time.perf_counter() < t_end:
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.01))
+                continue
+            n = int(size_rng.randint(lo, hi + 1))
+            t = threading.Thread(
+                target=_one_request,
+                args=(url, key, args.seed + 1 + i, shape, n,
+                      args.dtype, args.timeout_ms, stats),
+                daemon=True)
+            t.start()
+            threads.append(t)
+            i += 1
+            next_t += interval
+        for t in threads:
+            t.join(timeout=(args.timeout_ms / 1e3) + 10.0)
+    wall_s = time.perf_counter() - t_start
+
+    lat = sorted(stats.latencies)
+    n_ok, n_err = len(lat), len(stats.errors)
+    scraped = {}
+    for surl in args.scrape:
+        one = _scrape(surl)
+        for k, v in one.items():
+            if isinstance(v, float):
+                scraped[k] = scraped.get(k, 0.0) + v
+        scraped.setdefault("_sources", []).append(surl)
+    fill_sum = scraped.get("hvd_serving_batch_fill_ratio_sum", 0.0)
+    fill_count = scraped.get("hvd_serving_batch_fill_ratio_count", 0.0)
+    real = scraped.get("hvd_serving_examples_total", 0.0)
+    pad = scraped.get("hvd_serving_padding_examples_total", 0.0)
+
+    report = {
+        "metric": "serving_throughput_rps",
+        "value": round(n_ok / wall_s, 2) if wall_s else 0.0,
+        "unit": "requests/sec",
+        "mode": args.mode,
+        "requests_ok": n_ok,
+        "requests_failed": n_err,
+        "examples_served": stats.examples,
+        "concurrency": (args.concurrency if args.mode == "closed"
+                        else None),
+        "arrival_rate_rps": (args.rate if args.mode == "open" else None),
+        "wall_s": round(wall_s, 3),
+        "latency_ms": {
+            "p50": round(percentile(lat, 0.50) * 1e3, 3),
+            "p95": round(percentile(lat, 0.95) * 1e3, 3),
+            "p99": round(percentile(lat, 0.99) * 1e3, 3),
+            "mean": round(sum(lat) / n_ok * 1e3, 3) if n_ok else 0.0,
+            "max": round(lat[-1] * 1e3, 3) if lat else 0.0,
+        },
+        "batch_fill_ratio_mean": (
+            round(fill_sum / fill_count, 4) if fill_count else None),
+        "padding_waste_frac": (
+            round(pad / (real + pad), 4) if (real + pad) else None),
+        "errors_sample": stats.errors[:5],
+        "scrape": scraped or None,
+    }
+    print(json.dumps(report))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+
+    if args.check:
+        failures = []
+        if n_ok == 0:
+            failures.append("no successful requests")
+        if n_err:
+            failures.append(
+                f"{n_err} failed requests (first: {stats.errors[0]})")
+        if n_ok and not all(
+                report["latency_ms"][q] > 0 for q in ("p50", "p95", "p99")):
+            failures.append("latency percentiles not all nonzero")
+        if args.scrape:
+            if not fill_count:
+                failures.append(
+                    "no hvd_serving_batch_fill_ratio samples scraped "
+                    "(batching dead or metrics off)")
+            elif fill_sum <= 0:
+                failures.append("batch fill ratio sum is zero")
+        for msg in failures:
+            print(f"serving check FAILED: {msg}")
+        if failures:
+            return 1
+        print(f"serving check OK: {n_ok} requests, "
+              f"p50 {report['latency_ms']['p50']} ms, "
+              f"fill {report['batch_fill_ratio_mean']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -147,3 +147,40 @@ def test_tpu_schedule_overlap_window_on_real_bert():
     assert a["bucket_all_reduces_in_optimized_hlo"] >= 2, a
     assert a["overlap_window_frac"] >= 0.25, a
     assert a["overlappable_frac"] >= 0.85, a
+
+
+@pytest.mark.slow  # GPT-2-medium AOT compile: minutes of XLA time
+def test_tpu_schedule_overlap_window_on_gpt2_medium():
+    """Level 2 for the causal half of the transformer pair. GPT-2's
+    window is measurably WORSE than BERT's (0.1701 vs 0.2559,
+    OVERLAP_r05.json — the tied-embedding gradient closes at the very
+    end of backward, so the embedding bucket gates more of the chain)
+    and sits below the 0.25 floor asserted above. Until the bucket
+    sweep recovers it, this asserts a regression floor at the measured
+    0.17 level so the window can't silently collapse further (VERDICT
+    r5 weak #2) — tightening it to 0.25 is the open perf item, not a
+    test change.
+    """
+    try:
+        mesh = _tpu_topology_mesh()
+    except Exception as e:  # no TPU client in this environment
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    import sys
+
+    sys.path.insert(0, str(_REPO_ROOT))
+    from scripts.overlap_check import analyze, build_step
+
+    hvd.shutdown()
+    hvd.init(mesh=mesh)
+    try:
+        js, params, state, toks_s = build_step(
+            "gpt2-medium", mesh, 8, 128, 0)
+        txt = js.lower(params, state, toks_s).compile().as_text()
+    finally:
+        hvd.shutdown()
+    a = analyze(txt)
+    assert a["scheduled"]
+    assert a["bucket_all_reduces_in_optimized_hlo"] >= 2, a
+    # measured 0.1701 / 0.8918 (OVERLAP_r05.json, v5e:2x4 and 16x16)
+    assert a["overlap_window_frac"] >= 0.17, a
+    assert a["overlappable_frac"] >= 0.85, a

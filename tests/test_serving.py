@@ -1,0 +1,540 @@
+"""Serving subsystem: engine buckets, dynamic batching, HTTP front
+end, multi-replica dispatch — and the loopback e2e the subsystem ships
+against: a 2-replica serving set over the MLP model restored from a
+real orbax checkpoint, driven by scripts/serving_loadgen.py --check,
+surviving an injected replica death with zero client-visible failures,
+and draining in-flight requests on SIGTERM before exiting 83.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO_ROOT))
+
+from horovod_tpu import checkpoint  # noqa: E402
+from horovod_tpu.runner.compute_service import ComputeService  # noqa: E402
+from horovod_tpu.runner.util.secret import make_secret_key  # noqa: E402
+from horovod_tpu.serving import (  # noqa: E402
+    DynamicBatcher,
+    InferenceEngine,
+    QueueFull,
+    ReplicaSet,
+    RequestTimeout,
+    ServingServer,
+    parse_buckets,
+    predict_remote,
+)
+IN_DIM = 8
+FEATURES = (16, 8, 4)
+
+
+def _mlp():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.mlp import MLP
+
+    mod = MLP(features=FEATURES)
+    params = mod.init(jax.random.PRNGKey(0),
+                      jnp.ones((2, IN_DIM)))["params"]
+    return mod, params
+
+
+def _make_checkpoint(tmp_path) -> str:
+    mod, params = _mlp()
+    path = str(tmp_path / "serving_ckpt")
+    checkpoint.save_model(
+        path, params,
+        metadata={"serving": {"model": "mlp",
+                              "features": list(FEATURES),
+                              "input_shape": [IN_DIM],
+                              "dtype": "float32"}})
+    return path
+
+
+def _direct_forward(x):
+    mod, params = _mlp()
+    return np.asarray(mod.apply({"params": params}, np.asarray(x)))
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+def test_parse_buckets_and_covering_choice():
+    assert parse_buckets("1,4,16,64") == (1, 4, 16, 64)
+    assert parse_buckets("16;4,4") == (4, 16)
+    with pytest.raises(ValueError):
+        parse_buckets("0,4")
+
+    mod, params = _mlp()
+    eng = InferenceEngine(
+        lambda p, x: mod.apply({"params": p}, x), params,
+        buckets=(1, 4, 16))
+    assert [eng.bucket_for(n) for n in (1, 2, 4, 5, 16)] == [
+        1, 4, 4, 16, 16]
+    assert eng.bucket_for(40) == 16  # above top: __call__ chunks
+
+
+def test_engine_from_checkpoint_matches_direct_forward(tmp_path):
+    ck = _make_checkpoint(tmp_path)
+    eng = InferenceEngine.from_checkpoint(ck, buckets=(1, 4, 8))
+    eng.warmup((IN_DIM,))
+    rng = np.random.RandomState(0)
+    for n in (1, 3, 8, 20):  # padded, exact, and chunked-above-top
+        x = rng.randn(n, IN_DIM).astype(np.float32)
+        np.testing.assert_allclose(
+            eng(x), _direct_forward(x), rtol=1e-5, atol=1e-5)
+    # executables cached by (bucket, feature shape, dtype): the four
+    # sizes above all share one shape and hit buckets 1/4/8 only
+    assert {k[0] for k in eng._cache} == {1, 4, 8}
+    assert all(k[1] == (IN_DIM,) for k in eng._cache)
+    # a float64 request canonicalizes to the float32 program instead
+    # of compiling a duplicate executable
+    n_before = len(eng._cache)
+    y64 = eng(rng.randn(2, IN_DIM))  # float64 input
+    assert y64.dtype == np.float32
+    assert len(eng._cache) == n_before
+    # the checkpoint's declared input_shape is a contract: violating
+    # it is a clean client error, not a flax shape crash (which would
+    # read as replica death to the dispatch tier)
+    with pytest.raises(ValueError, match="declared input_shape"):
+        eng(rng.randn(2, IN_DIM + 1).astype(np.float32))
+
+
+def test_batcher_rejects_request_larger_than_queue_capacity():
+    """A request the queue can never hold is a client error (400-class
+    ValueError), not retryable 429 backpressure."""
+    bat = DynamicBatcher(lambda x: x, max_batch=4, max_wait_ms=0.0,
+                         queue_limit=8).start()
+    try:
+        with pytest.raises(ValueError, match="admission capacity"):
+            bat.submit(np.zeros((9, 2), np.float32))
+    finally:
+        bat.close()
+
+
+def test_engine_on_mesh_replicated(hvd8):
+    """Mesh path: params placed per parallel/ sharding rules (catch-all
+    = replicated), I/O mesh-committed, numerics unchanged."""
+    from horovod_tpu.parallel.mesh import make_mesh
+
+    mod, params = _mlp()
+    mesh = make_mesh()
+    eng = InferenceEngine(
+        lambda p, x: mod.apply({"params": p}, x), params,
+        buckets=(1, 4), mesh=mesh)
+    x = np.random.RandomState(1).randn(3, IN_DIM).astype(np.float32)
+    np.testing.assert_allclose(
+        eng(x), _direct_forward(x), rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# batcher
+# ---------------------------------------------------------------------------
+
+def test_batcher_coalesces_concurrent_requests():
+    batches = []
+
+    def run(x):
+        batches.append(x.shape[0])
+        return x * 2.0
+
+    bat = DynamicBatcher(run, max_batch=16, max_wait_ms=150.0,
+                         queue_limit=64).start()
+    try:
+        futs = [bat.submit(np.full((2, 3), float(i)), timeout_s=5.0)
+                for i in range(4)]
+        outs = [f.result(5.0) for f in futs]
+        for i, y in enumerate(outs):
+            np.testing.assert_allclose(y, np.full((2, 3), 2.0 * i))
+        # all four 2-example requests coalesced into one 8-example run
+        assert batches == [8]
+    finally:
+        bat.close()
+
+
+def test_batcher_queue_full_and_draining():
+    release = threading.Event()
+
+    def run(x):
+        release.wait(5.0)
+        return x
+
+    bat = DynamicBatcher(run, max_batch=4, max_wait_ms=0.0,
+                         queue_limit=4).start()
+    try:
+        first = bat.submit(np.zeros((4, 2)), timeout_s=5.0)
+        time.sleep(0.05)  # worker picked it up and is blocked in run()
+        bat.submit(np.zeros((3, 2)), timeout_s=5.0)
+        with pytest.raises(QueueFull):
+            bat.submit(np.zeros((2, 2)), timeout_s=5.0)
+        release.set()
+        first.result(5.0)
+    finally:
+        bat.close()
+    from horovod_tpu.serving import Draining
+
+    with pytest.raises(Draining):
+        bat.submit(np.zeros((1, 2)))
+
+
+def test_batcher_expired_request_times_out_without_wasting_a_slot():
+    executed = []
+
+    def run(x):
+        executed.append(x.shape[0])
+        time.sleep(0.15)
+        return x
+
+    bat = DynamicBatcher(run, max_batch=4, max_wait_ms=0.0,
+                         queue_limit=16).start()
+    try:
+        a = bat.submit(np.zeros((1, 2)), timeout_s=5.0)
+        time.sleep(0.05)  # a is executing (sleeping in run)
+        b = bat.submit(np.zeros((1, 2)), timeout_s=0.01)  # expires queued
+        a.result(5.0)
+        with pytest.raises(RequestTimeout):
+            b.result(5.0)
+        time.sleep(0.1)
+        assert executed == [1]  # b never reached the model
+    finally:
+        bat.close()
+
+
+def test_batcher_isolates_incompatible_shapes():
+    """A request with a different example shape coalesces into its OWN
+    batch — it can fail alone, but never fails or upcasts the
+    homogeneous requests sharing its window."""
+    batches = []
+
+    def run(x):
+        batches.append((x.shape, str(x.dtype)))
+        return x
+
+    bat = DynamicBatcher(run, max_batch=16, max_wait_ms=150.0,
+                         queue_limit=64).start()
+    try:
+        a = bat.submit(np.zeros((2, 4), np.float32), timeout_s=5.0)
+        odd = bat.submit(np.zeros((1, 9), np.float32), timeout_s=5.0)
+        b = bat.submit(np.zeros((3, 4), np.float32), timeout_s=5.0)
+        wide = bat.submit(np.zeros((1, 4), np.float64), timeout_s=5.0)
+        for f, shape in ((a, (2, 4)), (odd, (1, 9)), (b, (3, 4)),
+                         (wide, (1, 4))):
+            assert f.result(5.0).shape == shape
+        assert sorted(batches) == [
+            ((1, 4), "float64"), ((1, 9), "float32"),
+            ((5, 4), "float32")], batches
+    finally:
+        bat.close()
+
+
+# ---------------------------------------------------------------------------
+# server + replica set (in-process)
+# ---------------------------------------------------------------------------
+
+def test_server_auth_health_and_metrics_mount():
+    key = b"per-job-secret"
+    srv = ServingServer(lambda x, t: x + 1.0, key=key)
+    port = srv.start()
+    addr = f"127.0.0.1:{port}"
+    try:
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(
+            predict_remote(addr, x, 5.0, key=key), x + 1.0)
+        # wrong auth -> 401, never reaches predict_fn
+        body = json.dumps({"inputs": x.tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://{addr}/v1/predict", data=body, method="POST",
+            headers={"X-Hvd-Auth": "0" * 64})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5.0)
+        assert ei.value.code == 401
+        # probe routes stay open
+        with urllib.request.urlopen(
+                f"http://{addr}/healthz", timeout=5.0) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        with urllib.request.urlopen(
+                f"http://{addr}/metrics", timeout=5.0) as r:
+            assert r.status == 200
+        # draining -> 503 for predicts, healthz says so
+        srv.draining = True
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            predict_remote(addr, x, 5.0, key=key)
+        assert ei.value.code == 503
+    finally:
+        srv.shutdown()
+
+
+def test_replica_set_least_loaded_failover_and_revival():
+    good = ServingServer(lambda x, t: x * 3.0)
+    bad = ServingServer(lambda x, t: (_ for _ in ()).throw(
+        ConnectionError("replica dying")))
+    gp, bp = good.start(), bad.start()
+    rs = ReplicaSet({0: f"127.0.0.1:{gp}", 1: f"127.0.0.1:{bp}"})
+    try:
+        x = np.ones((2, 2), np.float32)
+        # drive enough requests that the least-loaded router must try
+        # replica 1 at least once; every one succeeds anyway
+        for _ in range(6):
+            np.testing.assert_allclose(rs.predict(x, 5.0), x * 3.0)
+        assert 1 in rs.dead  # ejected after its 503
+        assert 0 not in rs.dead
+        rs.revive(1)
+        assert 1 not in rs.dead
+    finally:
+        good.shutdown()
+        bad.shutdown()
+
+
+def test_replica_set_429_retries_elsewhere_without_ejecting():
+    """Backpressure (429) from a saturated replica reroutes the
+    request but keeps the replica in rotation — only death-shaped
+    failures (transport, 5xx) eject."""
+    from horovod_tpu.serving import QueueFull
+
+    good = ServingServer(lambda x, t: x + 7.0)
+    busy = ServingServer(lambda x, t: (_ for _ in ()).throw(
+        QueueFull("admission queue at capacity")))
+    gp, bp = good.start(), busy.start()
+    rs = ReplicaSet({0: f"127.0.0.1:{gp}", 1: f"127.0.0.1:{bp}"})
+    try:
+        x = np.zeros((1, 2), np.float32)
+        for _ in range(6):
+            np.testing.assert_allclose(rs.predict(x, 5.0), x + 7.0)
+        assert rs.dead == {}, rs.dead
+    finally:
+        good.shutdown()
+        busy.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# loopback e2e (subprocess replicas, the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(ckpt, index, svc_port, secret_str, tmp_path,
+                   extra_env=None, extra_args=()):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": str(_REPO_ROOT),
+        "HVD_TPU_SECRET_KEY": secret_str,
+        # single CPU device is plenty for a replica and compiles faster
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    })
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.serving.replica_set",
+         "--checkpoint", ckpt, "--index", str(index),
+         "--register", f"127.0.0.1:{svc_port}",
+         "--buckets", "1,4,8", *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(tmp_path),
+    )
+    return proc
+
+
+def _await_ready(proc, timeout_s=120.0):
+    """Read stdout until the READY line; returns the bound port."""
+    out_lines = []
+    result = {}
+
+    def reader():
+        for line in proc.stdout:
+            out_lines.append(line)
+            if "SERVING_REPLICA_READY" in line:
+                result["port"] = int(line.rsplit("port=", 1)[1])
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "port" not in result:
+        proc.kill()
+        raise AssertionError(
+            "replica never became ready; output:\n" + "".join(out_lines))
+    return result["port"]
+
+
+def _drain_stdout(proc):
+    t = threading.Thread(
+        target=lambda: proc.stdout.read(), daemon=True)
+    t.start()
+    return t
+
+
+@pytest.fixture
+def serving_pair(tmp_path):
+    """ComputeService + 2 registered replica subprocesses; replica 1
+    carries a fault rule that kills its executor after 2 batches."""
+    secret = make_secret_key()
+    svc = ComputeService(secret)
+    ckpt = _make_checkpoint(tmp_path)
+    procs = []
+    try:
+        procs.append(_spawn_replica(
+            ckpt, 0, svc.port, secret.decode(), tmp_path))
+        procs.append(_spawn_replica(
+            ckpt, 1, svc.port, secret.decode(), tmp_path,
+            extra_env={"HOROVOD_TPU_FAULT_SPEC":
+                       "serving.replica_exec:error:after=2"}))
+        ports = [_await_ready(p) for p in procs]
+        for p in procs:
+            _drain_stdout(p)
+        yield {"secret": secret, "service": svc, "ports": ports,
+               "procs": procs, "ckpt": ckpt, "tmp": tmp_path}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        svc.shutdown()
+
+
+def test_malformed_input_is_400_through_the_stack_and_never_ejects():
+    """A client error (empty batch) must come back 400 — not 500 —
+    through replica AND front door, and must not read as replica death
+    to the dispatch tier."""
+    bat = DynamicBatcher(lambda x: x, max_batch=4, max_wait_ms=0.0,
+                         queue_limit=16).start()
+    replica = ServingServer(bat.__call__)
+    rp = replica.start()
+    rs = ReplicaSet({0: f"127.0.0.1:{rp}"})
+    front = ServingServer(rs.predict)
+    fp = front.start()
+    try:
+        body = json.dumps({"inputs": []}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fp}/v1/predict", data=body,
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10.0)
+        assert ei.value.code == 400, ei.value.code
+        assert rs.dead == {}, rs.dead
+        x = np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(
+            predict_remote(f"127.0.0.1:{fp}", x, 5.0), x)
+    finally:
+        front.shutdown()
+        replica.shutdown()
+        bat.close()
+
+
+def test_serving_e2e_failover_correctness_and_loadgen(serving_pair):
+    """Acceptance (a), (b), (d): every response matches the direct
+    forward pass while replica 1's executor is fault-killed mid-run,
+    and the loadgen --check artifact carries real latency + batching
+    metrics."""
+    secret = serving_pair["secret"]
+    ports = serving_pair["ports"]
+    # the front door discovers replicas through the authenticated
+    # registry, exactly like data-service trainers do
+    workers = serving_pair["service"]._workers.get("serving", {})
+    assert sorted(workers) == [0, 1], workers
+    rs = ReplicaSet(workers, key=secret)
+    front = ServingServer(rs.predict, key=secret)
+    fport = front.start()
+    try:
+        # (a)+(b): 24 sequential requests; replica 1 dies after 2
+        # executed batches, the set fails over, zero client failures
+        rng = np.random.RandomState(7)
+        for i in range(24):
+            n = int(rng.randint(1, 5))
+            x = rng.randn(n, IN_DIM).astype(np.float32)
+            y = predict_remote(f"127.0.0.1:{fport}", x, 10.0, key=secret)
+            np.testing.assert_allclose(
+                y, _direct_forward(x), rtol=1e-4, atol=1e-4)
+        assert 1 in rs.dead, (
+            "fault-injected replica 1 was never ejected — the fault "
+            f"rule did not fire (dead={rs.dead})")
+        assert serving_pair["procs"][0].poll() is None
+
+        # (d): the shipped load generator's smoke gate over the same
+        # front door, scraping both replicas' /metrics
+        artifact = serving_pair["tmp"] / "SERVING_e2e.json"
+        env = dict(os.environ)
+        env["HVD_TPU_SECRET_KEY"] = secret.decode()
+        cmd = [
+            sys.executable, str(_REPO_ROOT / "scripts/serving_loadgen.py"),
+            "--url", f"http://127.0.0.1:{fport}",
+            "--requests", "40", "--concurrency", "4",
+            "--input-shape", str(IN_DIM), "--examples", "1:4",
+            "--seed", "3", "--out", str(artifact), "--check",
+            "--scrape", f"http://127.0.0.1:{ports[0]}/metrics",
+            "--scrape", f"http://127.0.0.1:{ports[1]}/metrics",
+        ]
+        res = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=180, env=env)
+        assert res.returncode == 0, (
+            f"loadgen --check failed:\n{res.stdout}\n{res.stderr}")
+        rep = json.loads(artifact.read_text())
+        assert rep["requests_failed"] == 0
+        assert rep["requests_ok"] == 40
+        for q in ("p50", "p95", "p99"):
+            assert rep["latency_ms"][q] > 0, rep["latency_ms"]
+        assert rep["batch_fill_ratio_mean"] > 0
+        assert rep["padding_waste_frac"] is not None
+    finally:
+        front.shutdown()
+
+
+def test_serving_e2e_sigterm_drains_inflight_then_exits_83(tmp_path):
+    """Acceptance (c): SIGTERM while a request sits in the batching
+    window → the response still arrives, then the process exits with
+    the preemption code (83), which the elastic driver maps to ABORTED
+    (no blacklist)."""
+    secret = make_secret_key()
+    svc = ComputeService(secret)
+    ckpt = _make_checkpoint(tmp_path)
+    # a wide co-arrival window so the in-flight request is guaranteed
+    # to still be queued when the signal lands
+    proc = _spawn_replica(ckpt, 0, svc.port, secret.decode(), tmp_path,
+                          extra_args=("--max-wait-ms", "3000"))
+    try:
+        port = _await_ready(proc)
+        _drain_stdout(proc)
+        x = np.random.RandomState(5).randn(2, IN_DIM).astype(np.float32)
+        got = {}
+
+        def requester():
+            try:
+                got["y"] = predict_remote(
+                    f"127.0.0.1:{port}", x, 20.0, key=secret)
+            except Exception as e:  # noqa: BLE001
+                got["error"] = e
+
+        t = threading.Thread(target=requester, daemon=True)
+        t.start()
+        time.sleep(0.4)  # request admitted, sitting in the 3s window
+        assert proc.poll() is None
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=30)
+        assert "error" not in got, f"drained request failed: {got}"
+        np.testing.assert_allclose(
+            got["y"], _direct_forward(x), rtol=1e-4, atol=1e-4)
+        rc = proc.wait(timeout=30)
+        from horovod_tpu.elastic.preemption import PREEMPTED_EXIT_CODE
+
+        assert rc == PREEMPTED_EXIT_CODE, rc
+        # post-drain: the server refuses new work rather than hanging
+        with pytest.raises((urllib.error.URLError, ConnectionError,
+                            OSError)):
+            predict_remote(f"127.0.0.1:{port}", x, 2.0, key=secret)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        svc.shutdown()
