@@ -144,6 +144,7 @@ def load() -> ctypes.CDLL:
     lib.hvd_native_last_error.restype = ctypes.c_char_p
     lib.hvd_native_stall_warnings.restype = ctypes.c_longlong
     lib.hvd_native_cache_hits.restype = ctypes.c_longlong
+    lib.hvd_native_pending_joins.restype = ctypes.c_int
     lib.hvd_native_bytes_negotiated.restype = ctypes.c_longlong
     lib.hvd_native_coordinator_port.restype = ctypes.c_int
     _lib = lib
@@ -383,6 +384,12 @@ class NativeRuntime:
 
     def cache_hits(self) -> int:
         return self._lib.hvd_native_cache_hits()
+
+    def pending_joins(self) -> int:
+        """Ranks whose join still awaits full coverage (broadcast in
+        every negotiation cycle's ResponseList) — the plan cache's
+        fall-back trigger for a peer that stopped contributing."""
+        return self._lib.hvd_native_pending_joins()
 
     def bytes_negotiated(self) -> int:
         return self._lib.hvd_native_bytes_negotiated()

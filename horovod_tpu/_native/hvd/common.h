@@ -185,6 +185,11 @@ struct ResponseList {
   std::vector<uint64_t> agreed_invalid_bits;
   bool shutdown = false;
   int32_t join_count = 0;
+  // ranks whose kJoin is pending (not yet full coverage), broadcast
+  // every cycle: the Python plan cache checks this before dispatching a
+  // bypassed step so peers of a joining rank fall back to negotiation
+  // (the joiner's zero-contribution semantics only exist there)
+  int32_t pending_joins = 0;
   // Control-plane autotune (reference parameter_manager.cc:528, which
   // broadcasts the winning parameters): the coordinator owns the search
   // and ships the currently-applied values with every cycle, so all

@@ -630,6 +630,11 @@ ResponseList TcpController::CoordinatorCycle(const RequestList& own) {
     ready.push_back(j);
     joined_ranks_.clear();
   }
+  // joins still awaiting coverage, broadcast every cycle: peers running
+  // the bypassed plan cache must fall back to negotiation so the
+  // joiner's zero-contribution semantics can apply (ResponseList
+  // pending_joins → hvd_native_pending_joins)
+  rl.pending_joins = static_cast<int32_t>(joined_ranks_.size());
   for (auto& skv : sets_) {
     SetState& set = skv.second;
     if (set.barrier_ranks.empty()) continue;

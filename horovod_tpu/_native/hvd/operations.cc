@@ -89,6 +89,10 @@ struct Global {
   std::mutex join_mu;
   std::vector<int64_t> join_handles;
   std::atomic<bool> join_requested{false};
+  // ranks whose join awaits coverage, mirrored from each cycle's
+  // ResponseList: the Python plan cache polls this before dispatching a
+  // negotiation-bypassed step (see controller.cc pending_joins)
+  std::atomic<int> pending_joins{0};
 
   // a request held aside because it cache-hit, awaiting global agreement;
   // age counts cycles without agreement — past kMaxHitParkCycles the
@@ -257,6 +261,7 @@ bool RunLoopOnce() {
   own.shutdown = g->shutdown.load();
 
   ResponseList rl = g->controller->RunCycle(own);
+  g->pending_joins.store(rl.pending_joins);
 
   // coordinator-distributed autotune values: every rank applies the same
   // cycle time in the same cycle (threshold is applied inside the
@@ -843,6 +848,13 @@ long long hvd_native_stall_warnings() {
 }
 
 long long hvd_native_cache_hits() { return g ? g->cache_hits.load() : 0; }
+
+// Ranks whose join is still awaiting full coverage (coordinator state,
+// broadcast in every cycle's ResponseList). The eager fast path checks
+// this before dispatching a cached-plan step: a pending join means a
+// peer stopped contributing, and only negotiation's zero-contribution
+// join semantics can reconcile the world.
+int hvd_native_pending_joins() { return g ? g->pending_joins.load() : 0; }
 
 long long hvd_native_bytes_negotiated() {
   return g ? g->bytes_negotiated.load() : 0;

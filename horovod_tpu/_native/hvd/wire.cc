@@ -8,7 +8,9 @@ namespace hvd {
 // (ADVICE r4 #5). Bump whenever any serialized layout changes.
 //   v1: round-4 layout + ResponseList.tuned_bayes
 static constexpr uint8_t kWireMagic = 0xB5;
-static constexpr uint8_t kWireVersion = 1;
+// bump on ANY frame-layout change (v2: ResponseList.pending_joins) so a
+// mixed-build world fails the version gate loudly instead of misparsing
+static constexpr uint8_t kWireVersion = 2;
 
 static void WriteRequest(Writer* w, const Request& r) {
   w->I32(r.rank);
@@ -127,6 +129,7 @@ std::vector<uint8_t> SerializeResponseList(const ResponseList& rl) {
   w.U8(kWireVersion);
   w.U8(rl.shutdown ? 1 : 0);
   w.I32(rl.join_count);
+  w.I32(rl.pending_joins);
   w.Vec(rl.agreed_invalid_bits);
   w.F64(rl.tuned_cycle_ms);
   w.I64(rl.tuned_threshold);
@@ -146,6 +149,7 @@ bool DeserializeResponseList(const uint8_t* data, size_t len,
   if (r.U8() != kWireMagic || r.U8() != kWireVersion) return false;
   rl->shutdown = r.U8() != 0;
   rl->join_count = r.I32();
+  rl->pending_joins = r.I32();
   rl->agreed_invalid_bits = r.Vec<uint64_t>();
   rl->tuned_cycle_ms = r.F64();
   rl->tuned_threshold = r.I64();

@@ -295,6 +295,8 @@ def _start_native_eager(st) -> None:
         autotune_warmup=st.knobs.autotune_warmup_samples,
         autotune_cycles_per_sample=st.knobs.autotune_steps_per_sample,
         autotune_bayes=st.knobs.autotune_bayes,
+        fast_path=st.knobs.eager_fast_path,
+        fast_path_warmup=st.knobs.eager_fast_path_warmup,
     )
 
 

@@ -156,6 +156,14 @@ class Knobs:
     # architecture (operations.cc:401). Off by default: single-controller
     # eager semantics don't need negotiation.
     native_eager: bool = False
+    # Steady-state plan cache (HOROVOD_EAGER_FAST_PATH): after
+    # eager_fast_path_warmup identical enqueue sequences the runtime
+    # freezes the negotiated fusion buckets + controller order into an
+    # ExecutionPlan and subsequent steps skip the coordinator round
+    # trip entirely; any sequence deviation falls back to full
+    # negotiation (docs/eager.md). 0 reproduces pre-cache behavior.
+    eager_fast_path: bool = True
+    eager_fast_path_warmup: int = 3
 
     # --- metrics / telemetry (utils/metrics.py) ---
     # live counters/gauges/histograms + /metrics endpoint; off by default
@@ -238,6 +246,8 @@ class Knobs:
             retry_max_delay_seconds=_env_float("RETRY_MAX_DELAY", 2.0),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
             native_eager=_env_bool("NATIVE", False),
+            eager_fast_path=_env_bool("EAGER_FAST_PATH", True),
+            eager_fast_path_warmup=_env_int("EAGER_FAST_PATH_WARMUP", 3),
             metrics_enabled=_env_bool("METRICS", False),
             # canonical name first so it wins when both are set
             metrics_file=(

@@ -5,9 +5,10 @@ runner/elastic/driver.py rounds, blacklisting) existed without any way
 to *prove* it works under failure. This module is the chaos layer: a
 spec string — ``HOROVOD_TPU_FAULT_SPEC`` — compiles into rules that
 fire at named injection points threaded through the HTTP client/server,
-elastic discovery, worker exec, eager-runtime negotiation, checkpoint
-I/O, and the serving path (admission, replica dispatch, engine
-execution — ``serving.*``, docs/serving.md).
+elastic discovery, worker exec, eager-runtime negotiation
+(``collective``) and plan-cache activation (``eager.fast_path``,
+docs/eager.md), checkpoint I/O, and the serving path (admission,
+replica dispatch, engine execution — ``serving.*``, docs/serving.md).
 
 Spec grammar (entries separated by ``;`` or ``,``; fields by ``:``)::
 

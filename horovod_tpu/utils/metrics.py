@@ -815,6 +815,27 @@ _NATIVE_GAUGES = {
                        "Stall-inspector warnings (cumulative)"),
     "queue_depth": ("hvd_eager_queue_depth",
                     "Tensors enqueued and awaiting negotiation/execution"),
+    "fast_path_hits": (
+        "hvd_eager_fast_path_hits_total",
+        "Eager collectives that bypassed negotiation via the "
+        "steady-state plan cache (cumulative)"),
+    "fast_path_steps": (
+        "hvd_eager_fast_path_steps_total",
+        "Whole steps executed off a cached plan (cumulative)"),
+    "fast_path_activations": (
+        "hvd_eager_fast_path_activations_total",
+        "Plans frozen after steady-state warmup (cumulative)"),
+    "fast_path_invalidations": (
+        "hvd_eager_fast_path_invalidations_total",
+        "Cached plans dropped (deviation/churn/fault, cumulative)"),
+    "fast_path_active": (
+        "hvd_eager_fast_path_active",
+        "1 while a frozen plan is live, 0 otherwise"),
+    "negotiation_bypassed_bytes": (
+        "hvd_eager_negotiation_bypassed_bytes_total",
+        "Tensor bytes whose negotiation the plan cache skipped "
+        "(cumulative; the fast-path analog of "
+        "hvd_bytes_negotiated_total)"),
     "cycles": ("hvd_coord_cycles_total",
                "Coordinator negotiation cycles (rank 0)"),
     "busy_cycles": ("hvd_coord_busy_cycles_total",

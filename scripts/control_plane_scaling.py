@@ -1,20 +1,24 @@
 #!/usr/bin/env python
 """Native control-plane weak-scaling microbench → SCALING_r{N}.json.
 
-Measures the eager negotiation plane's per-step overhead as the world
-grows (1/2/4/8 processes on this host): each rank enqueues a fixed set
-of small gradients per step, the coordinator negotiates + fuses, the
-LoopbackExecutor applies them (so data-plane time is negligible and the
-number isolates the CONTROL plane — TCP round trips, controller cycle,
-response-cache path). Reports per-step negotiation latency
-(median/p95 over steps) and the response-cache hit rate per world size.
+Measures the eager control plane's per-step overhead as the world grows
+(1/2/4/8 processes on this host): each rank submits a fixed set of
+small gradients per step through the full EagerRuntime (enqueue →
+negotiate/plan-cache → LoopbackExecutor → synchronize; data-plane time
+is negligible, so the number isolates the CONTROL plane). Reports
+per-step latency (median/p95 over steps), the response-cache hit rate,
+and the steady-state plan-cache stats per world size.
 
-This is the per-step cost the reference's background loop pays
-(operations.cc:722 RunLoopOnce); at 256 chips the control plane must
-stay off the critical path, so its growth rate with world size is the
-early-warning signal (SURVEY.md §6 scaling evidence).
+With the plan cache on (default, HOROVOD_EAGER_FAST_PATH), the
+steady-state step stops negotiating at all — per-step latency becomes
+world-size independent, which is the whole point: at 256 chips the
+control plane must stay off the critical path. Run with
+``--no-fast-path`` to reproduce the negotiated-only rows of
+SCALING_r05 and earlier (per-step negotiation tripled 1→4 procs there
+even at a 98.6% response-cache hit rate).
 
-Usage: python scripts/control_plane_scaling.py [--out SCALING_r04.json]
+Usage: python scripts/control_plane_scaling.py [--out SCALING_r06.json]
+       [--no-fast-path]
 """
 
 import argparse
@@ -39,38 +43,39 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _worker(rank, size, port, q):
-    from horovod_tpu import _native
+def _worker(rank, size, port, fast_path, q):
+    import numpy as np
 
-    rt = _native.NativeRuntime()
-    rt.init(rank, size, "127.0.0.1", port, cycle_ms=1.0,
-            cache_capacity=1024, stall_warning_s=60.0)
+    from horovod_tpu.ops.eager_runtime import EagerRuntime
+
+    rt = EagerRuntime(rank, size, "127.0.0.1", port, cycle_ms=1.0,
+                      cache_capacity=1024, stall_warning_s=60.0,
+                      fast_path=fast_path)
     try:
+        x = np.ones((64,), np.float32)
         lat = []
+        steady_bytes = []
         for step in range(STEPS + WARMUP):
+            b0 = rt.bytes_negotiated()
             t0 = time.perf_counter()
             hs = [
-                rt.enqueue(f"g{i}", _native.OP_ALLREDUCE, "float32",
-                           [64])
+                rt.allreduce_async(f"g{i}", x)
                 for i in range(TENSORS_PER_STEP)
             ]
-            deadline = time.time() + 20
-            done = set()
-            while len(done) < len(hs) and time.time() < deadline:
-                b = rt.next_batch(timeout_s=0.2)
-                if b is not None:
-                    rt.batch_done(b, ok=True)
-                for h in hs:
-                    if h not in done and rt.poll(h) in (_native.DONE, _native.FAILED):
-                        done.add(h)
+            for h in hs:
+                rt.synchronize(h, timeout_s=30.0)
             if step >= WARMUP:
                 lat.append(time.perf_counter() - t0)
+                steady_bytes.append(rt.bytes_negotiated() - b0)
         q.put((rank, "ok", {
             "latencies": lat,
             "cache_hits": rt.cache_hits(),
             "bytes_negotiated": rt.bytes_negotiated(),
+            "steady_bytes_per_step": (
+                sum(steady_bytes) / max(len(steady_bytes), 1)),
+            "fast_path": rt.fast_path_stats(),
             # rank 0 only: coordinator CPU vs wait attribution
-            "coord": rt.coord_cycle_stats(),
+            "coord": rt._native.coord_cycle_stats(),
         }))
     except Exception as e:
         q.put((rank, "err", repr(e)))
@@ -78,11 +83,11 @@ def _worker(rank, size, port, q):
         rt.shutdown()
 
 
-def run_world(size):
+def run_world(size, fast_path=True):
     port = _free_port()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
-    procs = [ctx.Process(target=_worker, args=(r, size, port, q))
+    procs = [ctx.Process(target=_worker, args=(r, size, port, fast_path, q))
              for r in range(size)]
     for p in procs:
         p.start()
@@ -121,6 +126,7 @@ def run_world(size):
         "cache_hit_positions": int(coord["cache_hit_positions"]),
         "responses": int(coord["responses"]),
     }
+    fp = results[0][1]["fast_path"]
     return {
         "world": size,
         "steps": STEPS,
@@ -131,24 +137,34 @@ def run_world(size):
             "mean": round(1e3 * statistics.mean(lat), 3),
         },
         "cache_hit_rate": round(hits / total_requests, 4),
+        "steady_bytes_negotiated_per_step": round(
+            max(p["steady_bytes_per_step"]
+                for _, (_, p) in results.items()), 1),
+        "fast_path": {k: fp[k] for k in
+                      ("enabled", "active", "hits", "steps",
+                       "invalidations")},
         "coordinator": coord_row,
     }
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="SCALING_r04.json")
+    ap.add_argument("--out", default="SCALING_r06.json")
     ap.add_argument("--worlds", default="1,2,4,8")
+    ap.add_argument("--no-fast-path", action="store_true",
+                    help="negotiate every step (pre-plan-cache rows, "
+                         "SCALING_r05 methodology)")
     args = ap.parse_args(argv)
     rows = []
     for size in [int(s) for s in args.worlds.split(",")]:
-        row = run_world(size)
+        row = run_world(size, fast_path=not args.no_fast_path)
         rows.append(row)
         print(json.dumps(row), flush=True)
     base = rows[0]["negotiation_ms_per_step"]["median"] or 1e-9
     report = {
         "what": "native eager control-plane weak scaling (LoopbackExecutor "
-                "isolates negotiation cost; single host, spawn procs)",
+                "isolates control-plane cost; single host, spawn procs; "
+                "fast_path=%s)" % (not args.no_fast_path),
         "rows": rows,
         "median_growth_vs_1proc": [
             round(r["negotiation_ms_per_step"]["median"] / base, 2)

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Eager fast-path smoke gate: loopback world-2, hit rate + bitwise parity.
+
+Sits next to ``scripts/metrics_summary.py --check`` and
+``scripts/chaos_check.py`` in the repo's check scripts: where those
+gates assert telemetry flowed and recovery works, this one asserts the
+steady-state plan cache (docs/eager.md) is actually engaging AND is
+invisible to numerics:
+
+* two EagerRuntime processes (LoopbackExecutor, rank-different submit
+  orders) run a training-shaped loop; after warmup the fast-path hit
+  rate must exceed 0.9 and steady-state per-step ``bytes_negotiated``
+  must be 0 on every rank;
+* every rank replays the same inputs with the fast path toggled OFF
+  (full negotiation) and the results must be **bitwise identical** to
+  the fast-path results — the HOROVOD_EAGER_FAST_PATH=0 parity contract.
+
+Exits 0 and prints a JSON summary on success; exits 1 with the first
+failed assertion otherwise.
+
+Usage:
+    python scripts/eager_fastpath_check.py [--check] [--steps N]
+    (--check is accepted for symmetry with the other gates; the gate
+    runs either way)
+"""
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+TENSORS_PER_STEP = 8
+WARMUP_K = 3
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _worker(rank, size, port, steps, q):
+    import numpy as np
+
+    from horovod_tpu.ops.eager_runtime import EagerRuntime
+
+    rt = EagerRuntime(rank, size, "127.0.0.1", port, cycle_ms=1.0,
+                      fast_path=True, fast_path_warmup=WARMUP_K)
+    try:
+        names = [f"g{i}" for i in range(TENSORS_PER_STEP)]
+        # rank-different submit order: the hazard negotiation exists to
+        # remove, and the one the plan's frozen controller order absorbs
+        order = names if rank % 2 == 0 else list(reversed(names))
+        rng = np.random.RandomState(1234)  # same inputs on every rank
+        inputs = [
+            [rng.randn(64).astype(np.float32) for _ in names]
+            for _ in range(steps)
+        ]
+
+        def run_pass():
+            outs, steady_bytes = [], []
+            for step in range(steps):
+                b0 = rt.bytes_negotiated()
+                hs = {
+                    n: rt.allreduce_async(n, inputs[step][names.index(n)])
+                    for n in order
+                }
+                outs.append([
+                    np.asarray(rt.synchronize(hs[n], timeout_s=30.0))
+                    for n in names
+                ])
+                if step >= WARMUP_K + 4:
+                    steady_bytes.append(rt.bytes_negotiated() - b0)
+            return outs, steady_bytes
+
+        fast_out, fast_steady = run_pass()
+        s_fast = rt.fast_path_stats()
+
+        rt.set_fast_path(False)
+        slow_out, _ = run_pass()
+        rt.set_fast_path(True)
+
+        bitwise = all(
+            np.array_equal(a, b)
+            for so, fo in zip(slow_out, fast_out)
+            for a, b in zip(so, fo)
+        )
+        hit_rate = s_fast["hits"] / float(steps * TENSORS_PER_STEP)
+        q.put((rank, "ok", {
+            "hit_rate": round(hit_rate, 4),
+            "bitwise_identical": bool(bitwise),
+            "steady_bytes_per_step": fast_steady,
+            "fast_path": {k: s_fast[k] for k in
+                          ("active", "hits", "steps", "activations",
+                           "invalidations", "bypassed_bytes")},
+        }))
+    except Exception as e:
+        q.put((rank, "err", repr(e)))
+    finally:
+        rt.shutdown()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="run the smoke gate (default behavior)")
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--world", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker, args=(r, args.world, port,
+                                          args.steps, q))
+        for r in range(args.world)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(args.world):
+            rank, status, payload = q.get(timeout=180)
+            if status != "ok":
+                print(f"FAIL: rank {rank}: {payload}")
+                return 1
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+
+    failures = []
+    for rank, r in sorted(results.items()):
+        if r["hit_rate"] <= 0.9:
+            failures.append(
+                f"rank {rank}: hit_rate {r['hit_rate']} <= 0.9")
+        if not r["bitwise_identical"]:
+            failures.append(
+                f"rank {rank}: fast-path results differ from negotiated")
+        if not r["fast_path"]["active"]:
+            failures.append(f"rank {rank}: plan never froze")
+        if any(b != 0 for b in r["steady_bytes_per_step"]):
+            failures.append(
+                f"rank {rank}: steady-state still negotiates bytes: "
+                f"{r['steady_bytes_per_step']}")
+    summary = {
+        "what": "eager fast-path smoke gate (loopback world-%d)"
+                % args.world,
+        "ranks": results,
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=1))
+    for f in failures:
+        print("FAIL:", f)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -144,15 +144,48 @@ def main(argv=None):
         u, s = opt_update(g, s, p)
         return apply_updates(p, u), s, l
 
+    def fp_snap():
+        return (rt.metrics_snapshot() if rt is not None else {})
+
+    fp0 = fp_snap()
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    enqueues = {"n": 0}
+
     p2, s2 = params, est
+    # warmup timed SEPARATELY: these steps pay full negotiation while
+    # the steady-state detector counts repeats; the steady window below
+    # runs off the frozen plan (HOROVOD_EAGER_FAST_PATH=1 default) —
+    # reporting both lets BENCH_r{N} attribute negotiation savings vs
+    # execution savings (ISSUE 4 satellite)
+    t0 = time.perf_counter()
     for _ in range(args.warmup):
         p2, s2, l = eager_step(p2, s2)
+        enqueues["n"] += n_leaves
     float(l)
+    eager_warm_s = (time.perf_counter() - t0) / max(args.warmup, 1)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         p2, s2, l = eager_step(p2, s2)
+        enqueues["n"] += n_leaves
     float(l)
     eager_s = (time.perf_counter() - t0) / args.steps
+
+    # A/B on the SAME runtime: toggle the plan cache off and repeat the
+    # steady window — this is the per-tensor negotiated number the fast
+    # path is measured against (cross-process drift can't fake it)
+    negotiated_s = None
+    if rt is not None:
+        rt.set_fast_path(False)
+        p2n, s2n = params, opt.init(params)
+        for _ in range(args.warmup):
+            p2n, s2n, l = eager_step(p2n, s2n)
+        float(l)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            p2n, s2n, l = eager_step(p2n, s2n)
+        float(l)
+        negotiated_s = (time.perf_counter() - t0) / args.steps
+        rt.set_fast_path(True)
 
     coord1 = (rt._native.coord_cycle_stats()
               if rt is not None else {})
@@ -171,12 +204,16 @@ def main(argv=None):
         return apply_updates(p, u), s, l
 
     p4, s4 = params, opt.init(params)
-    for _ in range(args.warmup):
+    # grouped warmup needs its own steady-state relearn (new names ⇒
+    # the per-tensor plan was invalidated); K+2 repeats cover it
+    for _ in range(max(args.warmup, 6)):
         p4, s4, l = eager_grouped_step(p4, s4)
+        enqueues["n"] += n_leaves
     float(l)
     t0 = time.perf_counter()
     for _ in range(args.steps):
         p4, s4, l = eager_grouped_step(p4, s4)
+        enqueues["n"] += n_leaves
     float(l)
     grouped_s = (time.perf_counter() - t0) / args.steps
 
@@ -187,20 +224,30 @@ def main(argv=None):
     # inside "negotiate_execute" below.
     tiny = jnp.ones((8,), jnp.float32)
     jax.block_until_ready(tiny)
+    # measure the NEGOTIATED round trip: the plan cache would turn this
+    # into a dict store + dispatch and hide the number being probed
+    if rt is not None:
+        rt.set_fast_path(False)
     for _ in range(args.warmup):
         hvd.synchronize(hvd.allreduce_async(tiny, name="rtt"))
     t0 = time.perf_counter()
     for _ in range(args.steps):
         hvd.synchronize(hvd.allreduce_async(tiny, name="rtt"))
     rtt_s = (time.perf_counter() - t0) / args.steps
+    if rt is not None:
+        rt.set_fast_path(True)
 
     # ---- phase decomposition: time each phase of the SAME pipelined
     # step (no extra barriers — through the remote-TPU tunnel a single
     # block_until_ready costs a ~100 ms RTT and would swamp the signal).
-    # grad/apply measure async dispatch; synchronize() is the step's
-    # only blocking point, so "negotiate_execute" absorbs the wait for
-    # grads to finish on device + negotiation + executor dispatch. The
-    # phases sum to the pipelined step time.
+    # grad/apply measure async dispatch. With the plan cache active the
+    # step's blocking point MOVES: the last enqueue dispatches the
+    # cached plan inline (so "enqueue" absorbs the wait for grads on
+    # device + the executor dispatch) and synchronize() just hands back
+    # stored futures, so "negotiate_execute" collapses toward zero —
+    # exactly the negotiation cost the fast path removed. With
+    # HOROVOD_EAGER_FAST_PATH=0 the old attribution (blocking inside
+    # synchronize) returns. The phases sum to the pipelined step time.
     def timed_eager_step(p, s, acc):
         t = time.perf_counter()
         l, g = grad_fn(p, x_local, y_local)
@@ -228,12 +275,41 @@ def main(argv=None):
     phases = {"grad_dispatch": 0.0, "enqueue": 0.0,
               "negotiate_execute": 0.0, "apply_dispatch": 0.0}
     p3, s3 = params, opt.init(params)
+    # re-reach steady state first (the rtt section changed the
+    # sequence), so the breakdown describes the fast-path step
+    warm = {k: 0.0 for k in phases}
+    for _ in range(max(args.warmup, 6)):
+        p3, s3, _ = timed_eager_step(p3, s3, warm)
+        enqueues["n"] += n_leaves
     for _ in range(args.steps):
         p3, s3, _ = timed_eager_step(p3, s3, phases)
+        enqueues["n"] += n_leaves
     breakdown = {k: round(v / args.steps * 1e3, 2)
                  for k, v in phases.items()}
 
-    n_leaves = len(jax.tree_util.tree_leaves(params))
+    fp1 = fp_snap()
+    fast_path = None
+    if fp1:
+        hits = int(fp1.get("fast_path_hits", 0)
+                   - fp0.get("fast_path_hits", 0))
+        fast_path = {
+            "enabled": bool(rt is not None and rt.fast_path_stats()
+                            ["enabled"]),
+            "hit_rate": round(hits / max(enqueues["n"], 1), 4),
+            "hits": hits,
+            "steps": int(fp1.get("fast_path_steps", 0)
+                         - fp0.get("fast_path_steps", 0)),
+            "invalidations": int(
+                fp1.get("fast_path_invalidations", 0)
+                - fp0.get("fast_path_invalidations", 0)),
+            "activations": int(
+                fp1.get("fast_path_activations", 0)
+                - fp0.get("fast_path_activations", 0)),
+            "negotiation_bypassed_bytes": int(
+                fp1.get("negotiation_bypassed_bytes", 0)
+                - fp0.get("negotiation_bypassed_bytes", 0)),
+        }
+
     report = {
         "what": "per-step wall time, 4x1024 MLP batch %d, single chip"
                 % B,
@@ -241,11 +317,18 @@ def main(argv=None):
         "native_eager": rt is not None,
         "grad_tensors_per_step": n_leaves,
         "spmd_step_ms": round(spmd_s * 1e3, 2),
+        # steady-state (plan-cache) step vs its own warmup (full
+        # negotiation) vs the A/B with the cache toggled off
         "eager_step_ms": round(eager_s * 1e3, 2),
+        "eager_warmup_step_ms": round(eager_warm_s * 1e3, 2),
+        "eager_negotiated_step_ms": (
+            round(negotiated_s * 1e3, 2)
+            if negotiated_s is not None else None),
         "eager_over_spmd": round(eager_s / spmd_s, 2),
         "eager_grouped_step_ms": round(grouped_s * 1e3, 2),
         "eager_grouped_over_spmd": round(grouped_s / spmd_s, 2),
         "cache_hits": int(rt.cache_hits()) if rt is not None else None,
+        "fast_path": fast_path,
         "runtime_roundtrip_ms": round(rtt_s * 1e3, 2),
         "phase_breakdown_ms": breakdown,
     }

@@ -194,6 +194,64 @@ def main():
             np.asarray(rs[i]), full[rank * 2:(rank + 1) * 2])
     out["grouped_reducescatter_ok"] = rs_ok
 
+    # 6b''. steady-state plan cache over the REAL multi-process XLA
+    # executor: a training-shaped loop (same names/shapes every step,
+    # rank-DIFFERENT submit order, rank-distinct values) must freeze a
+    # plan after the warmup and keep producing bitwise-identical
+    # results once negotiation is bypassed — the property the whole
+    # fast path stands on (identical plans frozen from identical
+    # negotiated rounds keep the cross-process program order aligned).
+    fp_names = ["fp_a", "fp_b", "fp_c"]
+    fp_order = (list(range(3)) if rank % 2 == 0
+                else list(reversed(range(3))))
+    fp_inputs = [
+        np.full((16,), float((rank + 1) * (i + 1)), dtype=np.float32)
+        for i in range(3)
+    ]
+    step_results = []
+    for _step in range(12):
+        fp_handles = {}
+        for i in fp_order:
+            fp_handles[i] = hvd.allreduce_async(
+                fp_inputs[i], name=fp_names[i], op=hvd.Sum)
+        step_results.append(
+            [np.asarray(hvd.synchronize(fp_handles[i]))
+             for i in range(3)]
+        )
+    fp_stats = st.eager_runtime.fast_path_stats()
+    fp_ok = fp_stats["active"] and fp_stats["steps"] > 0
+    for res in step_results:
+        for i in range(3):
+            # bitwise: fast-path steps must equal the negotiated ones
+            fp_ok = fp_ok and bool(
+                np.array_equal(res[i], step_results[0][i])
+            )
+        fp_ok = fp_ok and bool(
+            np.allclose(res[0], [s_world * 1.0] * 16)
+        )
+    out["fast_path_ok"] = bool(fp_ok)
+    out["fast_path"] = {k: fp_stats[k] for k in
+                        ("active", "hits", "steps", "invalidations")}
+
+    # 6b'''. DistributedOptimizer outside jit in a native world: the
+    # whole per-step bucket set rides ONE batched grouped enqueue
+    # (optim/distributed.py → grouped_allreduce_async → enqueue_batch)
+    # instead of a blocking round trip per bucket; averaged gradients
+    # must come back exact
+    import optax
+
+    dopt = hvd.DistributedOptimizer(optax.sgd(1.0), op=hvd.Average)
+    dparams = {"w": jnp.zeros((4,), jnp.float32)}
+    dstate = dopt.init(dparams)
+    dgrads = {"w": jnp.full((4,), float(rank + 1), jnp.float32)}
+    opt_ok = True
+    for _ in range(3):
+        updates, dstate = dopt.update(dgrads, dstate, dparams)
+        # average of (r+1) over ranks, negated by SGD lr=1
+        opt_ok = opt_ok and bool(np.allclose(
+            np.asarray(updates["w"]), -(s_world / size)))
+    out["dist_opt_ok"] = bool(opt_ok)
+
     # 6c. process-set collectives through the negotiated path: every
     # rank registers the set (synchronized, reference process_sets.py:123),
     # members run subset ops over the set's sub-mesh, non-members run a
@@ -262,16 +320,41 @@ def main():
 
     # 7. join: rank 0 runs out of data; the others keep reducing and the
     # joined rank contributes zeros through the XLA executor (reference
-    # JoinOp, collective_operations.h:325)
+    # JoinOp, collective_operations.h:325). The peers enter this holding
+    # an ACTIVE cached plan: rank 0's pending join is broadcast in every
+    # negotiation cycle, and the peers' next bypassed step must detect
+    # it and fall back to negotiation (plan invalidated with reason
+    # peer_join) instead of dispatching a collective rank 0 never runs.
     if size > 1:
+        import time as _time
+
+        for _ in range(6):  # re-freeze a plan on every rank
+            a = np.asarray(hvd.allreduce(
+                np.full((4,), float(rank + 1), np.float32),
+                op=hvd.Sum, name="jp"))
+        join_fp_ok = st.eager_runtime.fast_path_stats()["active"]
         if rank == 0:
             hvd.join()
-            out["join_ok"] = True
+            out["join_ok"] = bool(join_fp_ok)
         else:
+            # let rank 0's join reach the coordinator and broadcast
+            _time.sleep(0.5)
+            expect_nj = sum(r + 1 for r in range(1, size))
+            for _ in range(2):
+                red = np.asarray(hvd.allreduce(
+                    np.full((4,), float(rank + 1), np.float32),
+                    op=hvd.Sum, name="jp"))
+                join_fp_ok = join_fp_ok and bool(
+                    np.allclose(red, expect_nj))
+            s_fp = st.eager_runtime.fast_path_stats()
+            join_fp_ok = join_fp_ok and (
+                s_fp["last_invalidation"] == "peer_join"
+                and not s_fp["active"])
             t = np.full((3,), float(rank + 1), dtype=np.float32)
             red = np.asarray(hvd.allreduce(t, op=hvd.Sum, name="tail"))
             expect_tail = sum(r + 1 for r in range(1, size))
-            out["join_ok"] = bool(np.allclose(red, expect_tail))
+            out["join_ok"] = bool(
+                join_fp_ok and np.allclose(red, expect_tail))
             hvd.join()
     else:
         out["join_ok"] = True

@@ -1,0 +1,397 @@
+"""Steady-state plan cache (eager control-plane fast path).
+
+After K identical enqueue sequences the EagerRuntime freezes the
+negotiated fusion buckets + controller order into an ExecutionPlan and
+bypasses the coordinator round trip entirely; any sequence deviation
+(new tensor, shape change, process-set churn, join, injected fault)
+must fall back to full negotiation with correct results. docs/eager.md
+documents the contract; this file covers its edges.
+"""
+
+import multiprocessing as mp
+import socket
+
+import numpy as np
+import pytest
+
+from horovod_tpu.core.exceptions import HorovodInternalError
+from horovod_tpu.ops.eager_runtime import EagerRuntime
+from horovod_tpu.utils import faults, metrics
+
+WARMUP_K = 3
+
+
+@pytest.fixture
+def rt():
+    r = EagerRuntime(0, 1, cycle_ms=1.0, cache_capacity=64,
+                     fast_path=True, fast_path_warmup=WARMUP_K)
+    yield r
+    r.shutdown()
+
+
+def _step(rt, names, shape=(8,), mult=1.0):
+    """One training-shaped step: enqueue every name, sync in order."""
+    ins = {
+        n: np.full(shape, (i + 1) * mult, np.float32)
+        for i, n in enumerate(names)
+    }
+    hs = {n: rt.allreduce_async(n, ins[n]) for n in names}
+    return {n: np.asarray(rt.synchronize(h)) for n, h in hs.items()}, ins
+
+
+def _activate(rt, names, shape=(8,), steps=WARMUP_K + 4):
+    outs = []
+    for _ in range(steps):
+        out, ins = _step(rt, names, shape)
+        outs.append((out, ins))
+    assert rt.fast_path_stats()["active"], rt.fast_path_stats()
+    return outs
+
+
+# ------------------------------------------------------- steady state
+
+def test_plan_activates_and_bypasses_negotiation(rt):
+    names = [f"g{i}" for i in range(4)]
+    outs = _activate(rt, names)
+    s = rt.fast_path_stats()
+    assert s["activations"] == 1 and s["hits"] > 0 and s["steps"] > 0
+    assert s["bypassed_bytes"] > 0
+    # loopback world of 1: allreduce sum returns the input
+    for out, ins in outs:
+        for n in names:
+            np.testing.assert_array_equal(out[n], ins[n])
+    # steady state: the wire byte counter stops growing entirely
+    before = rt.bytes_negotiated()
+    for _ in range(5):
+        _step(rt, names)
+    assert rt.bytes_negotiated() == before
+
+
+def test_fast_path_results_bitwise_equal_negotiated(rt):
+    """The same runtime, same inputs, fast path off vs on: results must
+    be bit-for-bit identical (the acceptance contract for
+    HOROVOD_EAGER_FAST_PATH=0 parity)."""
+    names = [f"b{i}" for i in range(3)]
+    rt.set_fast_path(False)
+    negotiated, _ = _step(rt, names, mult=0.3)
+    assert not rt.fast_path_stats()["active"]
+    rt.set_fast_path(True)
+    _activate(rt, names)
+    fast, _ = _step(rt, names, mult=0.3)
+    assert rt.fast_path_stats()["steps"] > 0
+    for n in names:
+        np.testing.assert_array_equal(negotiated[n], fast[n])
+
+
+def test_fast_path_disabled_never_activates():
+    r = EagerRuntime(0, 1, cycle_ms=1.0, fast_path=False)
+    try:
+        for _ in range(WARMUP_K + 6):
+            out, ins = _step(r, ["x0", "x1"])
+            for n, v in ins.items():
+                np.testing.assert_array_equal(out[n], v)
+        s = r.fast_path_stats()
+        assert not s["active"] and s["hits"] == 0 and s["steps"] == 0
+    finally:
+        r.shutdown()
+
+
+def test_mixed_op_plan(rt):
+    """A step mixing allreduce + broadcast + reducescatter freezes and
+    replays as one plan."""
+    from horovod_tpu._native import OP_BROADCAST, OP_REDUCESCATTER
+
+    def mixed_step():
+        h1 = rt.allreduce_async("m_ar", np.full((8,), 2.0, np.float32))
+        h2 = rt.enqueue("m_bc", np.full((4,), 7.0, np.float32),
+                        OP_BROADCAST, root_rank=0)
+        h3 = rt.enqueue("m_rs", np.arange(8, dtype=np.float32),
+                        OP_REDUCESCATTER)
+        return [np.asarray(rt.synchronize(h)) for h in (h1, h2, h3)]
+
+    outs = [mixed_step() for _ in range(WARMUP_K + 5)]
+    s = rt.fast_path_stats()
+    assert s["active"] and s["steps"] > 0
+    for o in outs:
+        np.testing.assert_array_equal(o[0], np.full((8,), 2.0))
+        np.testing.assert_array_equal(o[1], np.full((4,), 7.0))
+        np.testing.assert_array_equal(o[2], np.arange(8, dtype=np.float32))
+
+
+def test_grouped_enqueue_batch_rides_fast_path(rt):
+    """The batched entry point (one lock/queue round per gradient set)
+    feeds the same window/plan machinery."""
+    def gstep():
+        hs = rt.enqueue_batch([
+            dict(name=f"q{i}", tensor=np.full((8,), i + 1.0, np.float32),
+                 group="G", group_size=3)
+            for i in range(3)
+        ])
+        return [np.asarray(rt.synchronize(h)) for h in hs]
+
+    outs = [gstep() for _ in range(WARMUP_K + 5)]
+    s = rt.fast_path_stats()
+    assert s["active"] and s["steps"] > 0
+    for o in outs:
+        for i in range(3):
+            np.testing.assert_array_equal(o[i], np.full((8,), i + 1.0))
+
+
+# ------------------------------------------------------- invalidation
+
+def test_shape_change_invalidates_then_relearns(rt):
+    names = ["s0", "s1"]
+    _activate(rt, names, shape=(8,))
+    # shape change mid-run: deviation → full negotiation → re-freeze
+    outs = _activate(rt, names, shape=(16,))
+    s = rt.fast_path_stats()
+    assert s["invalidations"] >= 1 and s["activations"] == 2
+    assert "deviation" in s["last_invalidation"] or s["active"]
+    for out, ins in outs:
+        for n in names:
+            np.testing.assert_array_equal(out[n], ins[n])
+
+
+def test_new_tensor_invalidates(rt):
+    names = ["n0", "n1"]
+    _activate(rt, names)
+    # a stranger name arrives mid-step: the held tensors replay through
+    # negotiation and every handle still resolves correctly
+    h0 = rt.allreduce_async("n0", np.full((8,), 1.0, np.float32))
+    hx = rt.allreduce_async("brand_new", np.full((2,), 5.0, np.float32))
+    h1 = rt.allreduce_async("n1", np.full((8,), 2.0, np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(rt.synchronize(h0)), np.full((8,), 1.0))
+    np.testing.assert_array_equal(
+        np.asarray(rt.synchronize(hx)), np.full((2,), 5.0))
+    np.testing.assert_array_equal(
+        np.asarray(rt.synchronize(h1)), np.full((8,), 2.0))
+    s = rt.fast_path_stats()
+    assert not s["active"] and s["invalidations"] == 1
+
+
+def test_process_set_churn_invalidates(rt):
+    names = ["p0", "p1"]
+    _activate(rt, names)
+    rt.register_process_set(7, [0])
+    s = rt.fast_path_stats()
+    assert not s["active"] and s["invalidations"] == 1
+    # plan re-learns and set-scoped traffic itself stays correct
+    out, ins = _step(rt, names)
+    for n in names:
+        np.testing.assert_array_equal(out[n], ins[n])
+    _activate(rt, names)
+    rt.deregister_process_set(7)
+    s = rt.fast_path_stats()
+    assert not s["active"] and s["invalidations"] == 2
+
+
+def test_sync_before_step_complete_falls_back(rt):
+    """submit/sync interleaving finer than the plan step: synchronize on
+    a held handle must replay through negotiation, not hang."""
+    names = ["w0", "w1"]
+    _activate(rt, names)
+    h0 = rt.allreduce_async("w0", np.full((8,), 3.0, np.float32))
+    out = np.asarray(rt.synchronize(h0, timeout_s=20.0))
+    np.testing.assert_array_equal(out, np.full((8,), 3.0))
+    s = rt.fast_path_stats()
+    assert not s["active"]
+    assert s["last_invalidation"] == "sync_before_step_complete"
+
+
+def test_public_invalidate_plan_resets(rt):
+    """The elastic-reset shape: an explicit invalidation (what a
+    restore-and-retry cycle amounts to for a surviving runtime) drops
+    the plan and the next steps renegotiate then re-freeze."""
+    names = ["e0", "e1"]
+    _activate(rt, names)
+    before = rt.bytes_negotiated()
+    rt.invalidate_plan("elastic_reset")
+    s = rt.fast_path_stats()
+    assert not s["active"] and s["invalidations"] == 1
+    _activate(rt, names)
+    assert rt.bytes_negotiated() > before  # renegotiation really happened
+    assert rt.fast_path_stats()["activations"] == 2
+
+
+def test_elastic_reinit_starts_cold(monkeypatch):
+    """A real elastic reset tears the runtime down and re-inits
+    (elastic/state.py _reinitialize → basics.shutdown + init): the new
+    runtime must start with no plan and empty counters."""
+    import horovod_tpu as hvd
+    from horovod_tpu.core.state import global_state
+
+    monkeypatch.setenv("HVD_TPU_NATIVE", "1")
+    hvd.init()
+    rt1 = global_state().eager_runtime
+    assert rt1 is not None
+    for _ in range(WARMUP_K + 4):
+        h = hvd.allreduce_async(np.ones((4,), np.float32), name="el")
+        hvd.synchronize(h)
+    assert rt1.fast_path_stats()["active"]
+    from horovod_tpu.elastic.state import _reinitialize
+
+    _reinitialize()
+    rt2 = global_state().eager_runtime
+    assert rt2 is not None and rt2 is not rt1
+    s = rt2.fast_path_stats()
+    assert not s["active"] and s["hits"] == 0 and s["steps"] == 0
+    h = hvd.allreduce_async(np.ones((4,), np.float32), name="el")
+    np.testing.assert_array_equal(
+        np.asarray(hvd.synchronize(h)), np.ones((4,), np.float32))
+    hvd.shutdown()
+
+
+def test_join_invalidates(rt):
+    names = ["j0", "j1"]
+    _activate(rt, names)
+    rt.join_sync(timeout_s=20.0)  # world of 1: completes immediately
+    s = rt.fast_path_stats()
+    assert not s["active"] and s["invalidations"] == 1
+
+
+# ------------------------------------------------------------- faults
+
+def test_fault_point_vetoes_activation_and_recovers():
+    """eager.fast_path:error wired through plan activation: the plan is
+    invalidated at freeze time, the runtime stays on full negotiation
+    (correct results, no hang), and once the rule's budget is spent the
+    next steady window activates normally."""
+    faults.configure("eager.fast_path:error:times=1")
+    r = EagerRuntime(0, 1, cycle_ms=1.0, fast_path=True,
+                     fast_path_warmup=WARMUP_K)
+    try:
+        names = ["f0", "f1"]
+        outs = []
+        for _ in range(WARMUP_K + 3):
+            outs.append(_step(r, names))
+        s = r.fast_path_stats()
+        # first activation attempt was vetoed by the injected fault
+        assert s["invalidations"] >= 1
+        assert s["last_invalidation"] == "fault_injected"
+        for out, ins in outs:
+            for n in names:
+                np.testing.assert_array_equal(out[n], ins[n])
+        # the rule fired once; warmup restarts and the plan then freezes
+        outs = _activate(r, names, steps=WARMUP_K + 4)
+        for out, ins in outs:
+            for n in names:
+                np.testing.assert_array_equal(out[n], ins[n])
+    finally:
+        faults.reset()
+        r.shutdown()
+
+
+def test_executor_error_during_fast_step_fails_and_invalidates():
+    calls = {"n": 0}
+
+    from horovod_tpu.ops.eager_runtime import LoopbackExecutor
+
+    inner = LoopbackExecutor(1, 0)
+
+    def flaky(batch, tensors):
+        calls["n"] += 1
+        if calls["n"] == WARMUP_K + 3:  # first fast-path dispatch
+            raise RuntimeError("boom")
+        return inner(batch, tensors)
+
+    r = EagerRuntime(0, 1, cycle_ms=1.0, executor=flaky,
+                     fast_path=True, fast_path_warmup=WARMUP_K)
+    try:
+        for _ in range(WARMUP_K + 2):
+            _step(r, ["x"])
+        assert r.fast_path_stats()["active"]
+        h = r.allreduce_async("x", np.ones((8,), np.float32))
+        with pytest.raises(HorovodInternalError, match="boom"):
+            r.synchronize(h, timeout_s=20.0)
+        s = r.fast_path_stats()
+        assert not s["active"]
+        assert s["last_invalidation"] == "executor_error"
+        # negotiation takes over again, correctly
+        out, ins = _step(r, ["x"])
+        np.testing.assert_array_equal(out["x"], ins["x"])
+    finally:
+        r.shutdown()
+
+
+# ------------------------------------------------------------ metrics
+
+def test_fast_path_counters_exported(rt):
+    metrics.enable()
+    try:
+        _activate(rt, ["m0", "m1"])
+        text = metrics.scrape()
+        assert "hvd_eager_fast_path_hits_total" in text
+        assert "hvd_eager_fast_path_invalidations_total" in text
+        assert "hvd_eager_negotiation_bypassed_bytes_total" in text
+        snap = rt.metrics_snapshot()
+        assert snap["fast_path_hits"] > 0
+        assert snap["fast_path_active"] == 1
+        assert snap["negotiation_bypassed_bytes"] > 0
+    finally:
+        metrics.disable()
+
+
+# --------------------------------------------- weak scaling (world 2)
+
+def _ws_worker(rank, size, port, q):
+    try:
+        r = EagerRuntime(rank, size, "127.0.0.1", port, cycle_ms=1.0,
+                         fast_path=True, fast_path_warmup=WARMUP_K)
+        try:
+            names = [f"g{i}" for i in range(8)]
+            order = names if rank % 2 == 0 else list(reversed(names))
+            steady_deltas = []
+            for step in range(WARMUP_K + 14):
+                before = r.bytes_negotiated()
+                hs = [
+                    r.allreduce_async(n, np.full((64,), 1.0, np.float32))
+                    for n in order
+                ]
+                for h in hs:
+                    out = np.asarray(r.synchronize(h, timeout_s=30.0))
+                    # loopback world of 2: sum of identical = 2x
+                    np.testing.assert_array_equal(
+                        out, np.full((64,), 2.0, np.float32))
+                if step >= WARMUP_K + 4:
+                    steady_deltas.append(r.bytes_negotiated() - before)
+            q.put((rank, "ok", {
+                "steady_bytes_per_step": steady_deltas,
+                "stats": r.fast_path_stats(),
+            }))
+        finally:
+            r.shutdown()
+    except Exception as e:  # pragma: no cover - diagnostic path
+        q.put((rank, "err", repr(e)))
+
+
+def test_weak_scaling_world2_steady_state_negotiates_zero_bytes():
+    """Loopback world-2 weak scaling: with the fast path on, the
+    steady-state per-step bytes_negotiated drops to 0 — the whole
+    negotiation plane is off the critical path (SCALING artifact
+    claim)."""
+    with socket.socket() as s:
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_ws_worker, args=(r, 2, port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, status, payload = q.get(timeout=120)
+            assert status == "ok", f"rank {rank}: {payload}"
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    for rank, payload in results.items():
+        assert payload["stats"]["active"], payload["stats"]
+        assert payload["steady_bytes_per_step"], "no steady steps seen"
+        assert all(d == 0 for d in payload["steady_bytes_per_step"]), (
+            payload["steady_bytes_per_step"])

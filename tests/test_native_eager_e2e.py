@@ -93,7 +93,7 @@ def test_native_eager_end_to_end(size):
             "reducescatter_ok", "alltoall_ok", "grouped_ok",
             "grouped_sync_ok",
             "grouped_allgather_ok", "grouped_reducescatter_ok",
-            "sparse_ok",
+            "sparse_ok", "fast_path_ok", "dist_opt_ok",
             "process_set_ok", "join_ok",
         ):
             assert out[r][key], f"rank {r}: {key} failed: {out[r]}"
