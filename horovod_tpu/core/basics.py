@@ -208,7 +208,8 @@ def init(
 
         from ..utils.logging import configure_logging
 
-        configure_logging(st.knobs.log_level, st.knobs.log_hide_timestamp)
+        configure_logging(st.knobs.log_level, st.knobs.log_hide_timestamp,
+                          rank_prefix=st.knobs.log_rank)
 
         from ..utils.timeline import Timeline
 
@@ -223,6 +224,15 @@ def init(
         from ..utils import metrics
 
         metrics.configure(st.knobs)
+
+        # flight recorder (utils/flight.py): arm the control-plane
+        # event ring, the SIGUSR2 dump-on-demand handler and the crash
+        # excepthook; rank and the driver sink resolve from the
+        # launcher env. Before the eager runtime so its enqueue events
+        # are recorded from the first collective.
+        from ..utils import flight
+
+        flight.configure(st.knobs)
 
         # fault injection (utils/faults.py): the module already armed
         # itself from the env at import (worker processes need that);
@@ -308,9 +318,10 @@ def shutdown() -> None:
             st.eager_runtime.shutdown()
         if st.timeline is not None:
             st.timeline.close()
-        from ..utils import metrics
+        from ..utils import flight, metrics
 
         metrics.on_shutdown()
+        flight.on_shutdown()
         st.reset()
 
 

@@ -175,10 +175,28 @@ class Knobs:
     # standalone per-worker GET /metrics port; 0 = don't serve (the
     # rendezvous KV server mounts /metrics regardless)
     metrics_port: int = 0
+    # workers push their exposition to the rendezvous KV at most once
+    # per this interval; the rendezvous /metrics merges the pushes into
+    # one rank-labeled cluster scrape (docs/metrics.md). 0 = no push.
+    metrics_push_interval_s: float = 5.0
+
+    # --- flight recorder (utils/flight.py, docs/flight.md) ---
+    # bounded ring of control-plane events, dumped on stall abort /
+    # executor error / SIGTERM / SIGUSR2 / crash and shipped to the
+    # driver via PUT /flight/<rank>. ON by default (a black box that
+    # is off when the plane crashes is no black box); =0 leaves a
+    # single predicted branch per record site.
+    flight_recorder: bool = True
+    flight_dir: str = ""  # dump directory; "" = <tmpdir>/hvd_flight
+    flight_capacity: int = 4096  # events kept in the ring
 
     # --- logging ---
     log_level: str = "WARNING"
     log_hide_timestamp: bool = False
+    # rank-prefixed stderr lines ("[rank N] ..."), resolved from the
+    # launcher env without importing jax — makes interleaved
+    # multi-rank stderr attributable (utils/logging.py)
+    log_rank: bool = False
 
     # --- mesh / topology overrides ---
     # Comma-separated axis spec, e.g. "dp=8" or "dp=4,tp=2"; empty = one
@@ -256,8 +274,15 @@ class Knobs:
                 or ""
             ),
             metrics_port=_env_int("METRICS_PORT", 0),
+            metrics_push_interval_s=_env_float(
+                "METRICS_PUSH_INTERVAL_S", 5.0
+            ),
+            flight_recorder=_env_bool("FLIGHT_RECORDER", True),
+            flight_dir=_env("FLIGHT_DIR", "") or "",
+            flight_capacity=_env_int("FLIGHT_CAPACITY", 4096),
             log_level=_env("LOG_LEVEL", "WARNING") or "WARNING",
             log_hide_timestamp=_env_bool("LOG_HIDE_TIME", False),
+            log_rank=_env_bool("LOG_RANK", False),
             mesh_spec=_env("MESH", "") or "",
             serving_buckets=_env("SERVING_BUCKETS", "1,4,16,64")
             or "1,4,16,64",

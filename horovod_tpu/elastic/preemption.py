@@ -190,6 +190,19 @@ class PreemptionHandler:
             # the exit code must still say "preempted": a failed
             # emergency write is worse logging, not a worker failure
             LOG.error("preemption commit failed: %s", e)
+        # flight recorder (utils/flight.py): the last control-plane
+        # moments ship to the driver before we exit. Signal-safe by
+        # design — flight takes none of the metrics/StepStats locks,
+        # only its own dump lock, which record() never holds. After
+        # the state commit: the snapshot is the priority inside the
+        # grace window, the black box rides in second.
+        try:
+            from ..utils import flight
+
+            flight.record("preempt", signum=signum)
+            flight.dump("preemption")
+        except Exception:
+            pass
         exit_fn(PREEMPTED_EXIT_CODE)
 
 

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..core.exceptions import HorovodInternalError
 from ..utils import faults as _faults
+from ..utils import flight as _flight
 from ..utils import metrics as _metrics
 from .._native import (
     BATCHED,
@@ -263,6 +264,11 @@ class EagerRuntime:
             autotune_bayes=autotune_bayes,
         )
         self._executor = executor or LoopbackExecutor(size, rank)
+        # identity for the flight recorder's cross-rank attribution
+        # (utils/flight.py): the stall-abort straggler report needs to
+        # know which peers exist and who we are
+        self._rank = int(rank)
+        self._size = int(size)
         # negotiation watchdog (HOROVOD_STALL_ABORT_S): a collective
         # wait with no observable progress for this long aborts with
         # HorovodInternalError instead of hanging — the elastic run()
@@ -503,6 +509,10 @@ class EagerRuntime:
         self._handle_op[handle] = kwargs["op"]
         if kwargs["op"] in _PLAN_OPS:
             self._fp_outstanding.add(handle)
+        # flight ring (utils/flight.py): the enqueue is the unit the
+        # cross-rank straggler analysis counts — "rank R has not
+        # submitted tensor T" is literally a lagging enqueue count
+        _flight.record("enqueue", name, op=kwargs["op"], handle=handle)
         if _metrics.enabled():  # stamp only when someone will read it
             self._handle_ts[handle] = time.perf_counter()
         # span opens only after the native enqueue accepted the tensor — a
@@ -598,6 +608,8 @@ class EagerRuntime:
         }
         self._fp_plan = ExecutionPlan(list(captured), entries)
         self._fp_activations += 1
+        _flight.record("plan_activate", batches=len(captured),
+                       tensors=len(entries))
         tl = _timeline()
         if tl is not None:
             tl.instant("fast_path", "PLAN_ACTIVATED",
@@ -613,6 +625,9 @@ class EagerRuntime:
         self._fp_next_handle -= 1
         self._fp_step[name] = (h, arr)
         self._fp_hits += 1
+        # a bypassed enqueue still counts as a submission: peers on the
+        # negotiated path must not read a fast-path rank as a straggler
+        _flight.record("enqueue", name, handle=h, fast_path=True)
         ready = ()
         if (len(self._fp_step) == len(plan.names)
                 and not self._fp_dispatching):
@@ -667,6 +682,7 @@ class EagerRuntime:
         if had_plan:
             self._fp_invalidations += 1
             self._fp_last_invalidation = reason
+            _flight.record("plan_invalidate", reason=reason)
             tl = _timeline()
             if tl is not None:
                 tl.instant("fast_path", "PLAN_INVALIDATED",
@@ -693,6 +709,12 @@ class EagerRuntime:
                         args={"batch_id": batch.batch_id,
                               "fast_path": True,
                               "fused_with": len(batch.names)})
+            if _flight.enabled():
+                _flight.record(
+                    "exec_begin", batch.names[0] if batch.names else "",
+                    op=batch.op, n=len(batch.names),
+                    bytes=int(batch.total_bytes),
+                    names=list(batch.names), fast_path=True)
             try:
                 tensors = {n: tensors_all[n] for n in batch.names}
                 t0 = time.perf_counter() if m_on else 0.0
@@ -702,6 +724,12 @@ class EagerRuntime:
                         _OP_METRIC_NAMES.get(batch.op, str(batch.op)),
                         len(batch.names), batch.total_bytes,
                         time.perf_counter() - t0)
+                if _flight.enabled():
+                    _flight.record(
+                        "exec_end",
+                        batch.names[0] if batch.names else "",
+                        op=batch.op, names=list(batch.names),
+                        fast_path=True)
                 with self._lock:
                     for n in batch.names:
                         if n in results:
@@ -710,11 +738,18 @@ class EagerRuntime:
                             self._fp_failed[handles[n]] = (
                                 f"fast-path executor returned no result"
                                 f" for '{n}'")
-            except Exception:
+            except Exception as e:
                 import traceback
 
                 error = traceback.format_exc(limit=8)
                 self._last_exec_error = error
+                if _flight.enabled():
+                    _flight.record(
+                        "exec_error",
+                        batch.names[0] if batch.names else "",
+                        op=batch.op, fast_path=True,
+                        error=str(e)[:200])
+                    _flight.dump("executor_error")
             finally:
                 if tl is not None and execute is not None:
                     for n in batch.names:
@@ -964,16 +999,34 @@ class EagerRuntime:
         """Convert a stalled negotiation into HorovodInternalError:
         release the handle, close its bookkeeping/timeline span, raise
         — the elastic run() wrapper restores committed state and
-        retries instead of hanging past every deadline."""
+        retries instead of hanging past every deadline. With the
+        flight recorder on, the ring is dumped first and the message
+        is upgraded to name the suspected straggler ranks and the
+        tensors they have not submitted, cross-referenced against
+        peers' last dumps (utils/flight.py, docs/flight.md)."""
         _metrics.record_stall_abort()
         self._native.release(handle)
         with self._lock:
+            # everything still awaiting negotiation/execution — the
+            # tensor set the straggler analysis attributes (snapshot
+            # BEFORE popping the aborting handle's own input)
+            pending = sorted(set(self._inputs) | set(self._fp_step))
             self._fp_outstanding.discard(handle)
             name = self._handle_name.pop(handle, None)
             op = self._handle_op.pop(handle, None)
             self._handle_ts.pop(handle, None)
             if name is not None:
                 self._inputs.pop(name, None)
+        straggler = ""
+        if _flight.enabled():
+            _flight.record("stall_abort", name or "", handle=handle,
+                           waited_s=round(waited_s, 3))
+            try:
+                straggler = _flight.straggler_report(
+                    pending, self._size, self._rank,
+                    reason="stall_abort")
+            except Exception:
+                straggler = ""
         tl = _timeline()
         if tl is not None and name is not None and op in _OP_ACTIVITIES:
             tl.activity_end(name, _OP_ACTIVITIES[op][0])
@@ -984,6 +1037,7 @@ class EagerRuntime:
             + f" made no progress for {waited_s:.1f}s "
             "(HOROVOD_STALL_ABORT_S watchdog; a peer likely died — "
             "elastic training will restore and retry)"
+            + (f"; {straggler}" if straggler else "")
         )
 
     def _await_handle(self, handle: int, timeout_s: float,
@@ -1106,6 +1160,15 @@ class EagerRuntime:
                     # MarkCycleStart, operations.cc:734)
                     self._last_cycle = batch.cycle
                     tl.mark_cycle_start()
+                if _flight.enabled():
+                    # one event per negotiated batch received from the
+                    # controller — the moment a tensor's negotiation
+                    # ended on THIS rank
+                    _flight.record(
+                        "response",
+                        batch.names[0] if batch.names else "",
+                        op=batch.op, cycle=int(batch.cycle),
+                        n=len(batch.names), names=list(batch.names))
                 ours: List[str] = []
                 if batch.op not in (OP_JOIN, OP_BARRIER):
                     # only tensors THIS rank enqueued get span events —
@@ -1165,6 +1228,12 @@ class EagerRuntime:
                         args={"batch_id": batch.batch_id,
                               "fused_with": len(batch.names)},
                     )
+            if _flight.enabled():
+                _flight.record(
+                    "exec_begin", batch.names[0] if batch.names else "",
+                    op=batch.op, n=len(batch.names),
+                    bytes=int(batch.total_bytes),
+                    names=list(batch.names))
             try:
                 with self._lock:
                     tensors = {
@@ -1179,6 +1248,11 @@ class EagerRuntime:
                         len(batch.names), batch.total_bytes,
                         time.perf_counter() - t_exec,
                     )
+                if _flight.enabled():
+                    _flight.record(
+                        "exec_end",
+                        batch.names[0] if batch.names else "",
+                        op=batch.op, names=list(batch.names))
                 with self._lock:
                     for h in batch.handles:
                         name = self._handle_name.pop(h, None)
@@ -1204,7 +1278,7 @@ class EagerRuntime:
                     depth = len(self._inputs) + len(self._fp_step)
                 _metrics.set_queue_depth(depth)
                 self._native.batch_done(batch, ok=True)
-            except Exception:
+            except Exception as e:
                 # keep the executor's failure for synchronize()'s error
                 # message — the native error channel only carries
                 # negotiation/transport failures, so a swallowed
@@ -1213,6 +1287,12 @@ class EagerRuntime:
                 import traceback
 
                 self._last_exec_error = traceback.format_exc(limit=8)
+                if _flight.enabled():
+                    _flight.record(
+                        "exec_error",
+                        batch.names[0] if batch.names else "",
+                        op=batch.op, error=str(e)[:200])
+                    _flight.dump("executor_error")
                 self._native.batch_done(batch, ok=False)
                 with self._lock:
                     for h in batch.handles:

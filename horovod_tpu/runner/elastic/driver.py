@@ -21,6 +21,7 @@ changes through WorkerNotificationClient so they can commit early.
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import threading
 import time
@@ -210,7 +211,63 @@ class ElasticDriver:
                     _metrics.record_elastic_event("blacklist")
                     LOG.warning("blacklisting failed host %s", host)
             self._host_manager.update_available_hosts()
+        if not states or any(s != SUCCESS for s in states.values()):
+            # failed/aborted round: the next rendezvous.init wipes the
+            # store, and with it any flight dumps the dying workers
+            # shipped — persist them to disk first so the post-mortem
+            # survives the respawn (docs/flight.md)
+            self._persist_flight_dumps()
         return states
+
+    def _persist_flight_dumps(self) -> None:
+        """Write worker flight dumps (PUT /flight/<rank>) out of the
+        rendezvous store into HOROVOD_FLIGHT_DIR for offline analysis
+        with scripts/flight_analyze.py."""
+        import json
+
+        from ..http.http_server import FLIGHT_META_SCOPE
+        from ...utils.flight import FLIGHT_SCOPE
+
+        with self._rendezvous.lock:
+            dumps = dict(self._rendezvous.store.get(FLIGHT_SCOPE, {}))
+            meta = dict(self._rendezvous.store.get(FLIGHT_META_SCOPE, {}))
+        if not dumps:
+            return
+        import tempfile
+
+        directory = (
+            os.environ.get("HVD_TPU_FLIGHT_DIR")
+            or os.environ.get("HOROVOD_FLIGHT_DIR")
+            or os.path.join(tempfile.gettempdir(), "hvd_flight")
+        )
+        try:
+            os.makedirs(directory, exist_ok=True)
+            for rank_key, payload in dumps.items():
+                path = os.path.join(
+                    directory, f"flight_rank{rank_key}.jsonl")
+                # single-host launches share this path with the
+                # worker's own rank-local writes: a final crash dump
+                # that landed locally but whose PUT never reached us
+                # would be clobbered by our (older) stored copy —
+                # keep whichever is newer than the receipt stamp
+                try:
+                    recv = json.loads(meta[rank_key]).get(
+                        "recv_time_unix", 0.0)
+                except (KeyError, ValueError):
+                    recv = 0.0
+                if (os.path.exists(path)
+                        and os.path.getmtime(path) > recv):
+                    continue
+                with open(path, "wb") as f:
+                    f.write(payload)
+            LOG.warning(
+                "flight recorder: persisted dumps from ranks %s to %s "
+                "— analyze with: python scripts/flight_analyze.py "
+                "%s/flight_rank*.jsonl",
+                sorted(dumps), directory, directory,
+            )
+        except OSError as e:
+            LOG.warning("could not persist flight dumps: %s", e)
 
     def _wrap_exec(self) -> Callable:
         """Exec wrapper recording worker exit states into the registry

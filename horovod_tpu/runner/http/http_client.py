@@ -88,6 +88,22 @@ def wait_for_key(
     )
 
 
+def server_clock(addr: str, port: int,
+                 timeout_s: float = 2.0) -> "tuple[float, float]":
+    """One retry-free ping to the rendezvous ``GET /clock`` route:
+    returns ``(server_time_unix, rtt_s)``. Deliberately outside the
+    RetryPolicy — it is a *measurement* (the flight recorder and
+    ``scripts/flight_analyze.py`` derive clock offsets from it), and a
+    backed-off retry would smear the RTT it exists to bound."""
+    import json as _json
+
+    t0 = time.monotonic()
+    with urllib.request.urlopen(
+            f"http://{addr}:{port}/clock", timeout=timeout_s) as resp:
+        body = _json.loads(resp.read())
+    return float(body["time_unix"]), time.monotonic() - t0
+
+
 def delete(addr: str, port: int, scope: str, key: str) -> None:
     def _do() -> None:
         faults.inject("http.delete", scope=scope, key=key)

@@ -10,14 +10,22 @@ elastic re-rendezvous. Paths: /scope/key. A GET for a missing key returns
 
 from __future__ import annotations
 
+import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from ...utils import faults
+from ...utils.flight import FLIGHT_SCOPE
 from ..util.hosts import SlotInfo
 
 RENDEZVOUS_SCOPE = "rendezvous"
+
+# driver-side receipt stamps for worker flight dumps (PUT /flight/<r>):
+# scripts/flight_analyze.py reads them as a second clock-alignment
+# signal next to each dump's own /clock-probe offset
+FLIGHT_META_SCOPE = "flight_meta"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -41,22 +49,35 @@ class _KVHandler(BaseHTTPRequestHandler):
         return False
 
     def do_GET(self):
-        if self.path.split("?", 1)[0].rstrip("/") == "/metrics":
-            # live telemetry scrape (utils/metrics.py) of THIS process's
-            # registry. In a multi-process launch the workers run in
-            # their own processes, so this shows only driver-side
-            # activity — per-worker telemetry needs HOROVOD_METRICS_PORT
-            # on the workers (docs/metrics.md). Single-segment path —
-            # can't collide with the scope/key namespace (always two
-            # segments).
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path == "/metrics":
+            # cluster-aggregated telemetry scrape (utils/metrics.py):
+            # this process's registry plus every worker exposition
+            # pushed to /metrics_push/<rank>, the latter rank-labeled —
+            # one endpoint answers for the whole world
+            # (docs/metrics.md). Single-segment path — can't collide
+            # with the scope/key namespace (always two segments).
             from ...utils import metrics
 
-            ctype, body = metrics.exposition()
+            with self.server.lock:  # type: ignore[attr-defined]
+                pushed = dict(
+                    self.server.store.get(  # type: ignore[attr-defined]
+                        metrics.METRICS_PUSH_SCOPE, {})
+                )
+            ctype, body = metrics.exposition(pushed or None)
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+            return
+        if path == "/clock":
+            # clock-alignment ping for the flight recorder: workers
+            # stamp each dump with (server time - local time) measured
+            # through this route so flight_analyze can merge per-rank
+            # dumps onto the driver's time axis (utils/flight.py)
+            self._reply(200, json.dumps(
+                {"time_unix": time.time()}).encode())
             return
         if self._injected_503():
             return
@@ -82,7 +103,19 @@ class _KVHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         with self.server.lock:  # type: ignore[attr-defined]
-            self.server.store.setdefault(sk[0], {})[sk[1]] = body  # type: ignore[attr-defined]
+            store = self.server.store  # type: ignore[attr-defined]
+            store.setdefault(sk[0], {})[sk[1]] = body
+            if sk[0] == FLIGHT_SCOPE:
+                # PUT /flight/<rank>: stamp the driver-side receipt so
+                # post-hoc analysis has a second alignment anchor and
+                # an arrival order even for dumps whose /clock probe
+                # failed
+                store.setdefault(FLIGHT_META_SCOPE, {})[sk[1]] = (
+                    json.dumps({
+                        "recv_time_unix": time.time(),
+                        "bytes": len(body),
+                    }).encode()
+                )
         self._reply(200, b"ok")
 
     def do_DELETE(self):
@@ -153,6 +186,8 @@ class RendezvousServer(KVStoreServer):
 
     def init(self, host_assignments: List[SlotInfo]) -> int:
         """Publish a new round of slot assignments; returns server port."""
+        from ...utils.metrics import METRICS_PUSH_SCOPE
+
         if not self._thread.is_alive():
             self.start_server()
         with self.lock:
@@ -164,6 +199,15 @@ class RendezvousServer(KVStoreServer):
                 scope[f"rank_{slot.rank}"] = (
                     slot.to_response_string().encode()
                 )
+            # a new round is a new worker incarnation (and possibly a
+            # smaller world): previous-round flight dumps would poison
+            # straggler attribution with stale enqueue counts, and
+            # departed ranks' metric pushes would serve forever on the
+            # aggregated scrape. The elastic driver persists dumps to
+            # disk before calling init (driver._persist_flight_dumps).
+            for stale in (FLIGHT_SCOPE, FLIGHT_META_SCOPE,
+                          METRICS_PUSH_SCOPE):
+                self.store.pop(stale, None)
         self._round += 1
         return self.port
 

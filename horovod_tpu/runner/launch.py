@@ -138,6 +138,18 @@ def parse_args(argv: Optional[List[str]] = None):
     p.add_argument("--emergency-checkpoint", dest="emergency_checkpoint",
                    help="Rank-0 emergency snapshot path written on "
                         "preemption (SIGTERM).")
+    p.add_argument("--flight-recorder", dest="flight_recorder",
+                   action="store_const", const="1", default=None,
+                   help="Force the control-plane flight recorder on in "
+                        "workers (default on; docs/flight.md).")
+    p.add_argument("--no-flight-recorder", dest="flight_recorder",
+                   action="store_const", const="0",
+                   help="Disable the flight recorder (its record sites "
+                        "become single predicted branches).")
+    p.add_argument("--flight-dir", dest="flight_dir",
+                   help="Directory for rank-local flight dumps "
+                        "(default <tmpdir>/hvd_flight); dumps also "
+                        "ship to the rendezvous server.")
     p.add_argument("--log-level", dest="log_level",
                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
                             "FATAL"])

@@ -187,6 +187,13 @@ class InferenceEngine:
     def buckets(self) -> Tuple[int, ...]:
         return self._buckets
 
+    @property
+    def cached_executables(self) -> int:
+        """Compiled programs currently cached — surfaced on /healthz so
+        probes can tell a warm replica from one that will pay AOT
+        compiles on the next cold shape (docs/serving.md)."""
+        return len(self._cache)
+
     def bucket_for(self, n: int) -> int:
         """Smallest compiled bucket covering ``n`` examples (callers
         split batches larger than the top bucket — see __call__)."""

@@ -43,7 +43,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..utils import faults, metrics, retry
+from ..utils import faults, flight, metrics, retry
 from .batcher import RequestTimeout
 from .server import AUTH_HEADER, ServingServer, sign_body
 
@@ -214,6 +214,7 @@ class ReplicaSet:
                     f"request budget {timeout_s}s exhausted during "
                     f"dispatch/failover")
             idx, addr = self._pick()
+            flight.record("serving_dispatch", str(idx), n=int(x.shape[0]))
             try:
                 faults.inject("serving.dispatch", replica=idx)
                 remaining = max(deadline.remaining(), 0.5)
@@ -229,6 +230,8 @@ class ReplicaSet:
             except BaseException as e:
                 if _ejects_replica(e):
                     self._mark_dead(idx, e)
+                    flight.record("serving_failover", str(idx),
+                                  error=str(e)[:120])
                 raise
             finally:
                 self._release(idx)
@@ -398,8 +401,14 @@ def serve_replica(argv=None) -> int:
         ).start()
         server = ServingServer(
             batcher.__call__, port=args.port, key=key,
-            health_extra=lambda: {"buckets": list(engine.buckets),
-                                  "queued": batcher.pending},
+            # probe body: queue depth + bucket-cache size (in-flight
+            # count comes from ServingServer.health itself) — enough
+            # for a probe to tell "idle" from "wedged" without auth
+            health_extra=lambda: {
+                "buckets": list(engine.buckets),
+                "queued": batcher.pending,
+                "bucket_cache": engine.cached_executables,
+            },
         )
         role = "replica"
 

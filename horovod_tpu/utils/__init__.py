@@ -1,1 +1,1 @@
-from . import logging, metrics, timeline  # noqa: F401
+from . import flight, logging, metrics, timeline  # noqa: F401

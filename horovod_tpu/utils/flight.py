@@ -1,0 +1,544 @@
+"""Cross-rank flight recorder: post-hoc forensics for distributed stalls.
+
+Horovod's signature debugging aid is the coordinator's stall check that
+names *which ranks have not submitted which tensors*
+(stall_inspector.cc, Sergeev & Del Balso 2018). Our timeline
+(utils/timeline.py) and metrics (utils/metrics.py) are per-process:
+when a world-N job hangs, each rank holds only its own view, and the
+stall watchdog (PR 2) aborts with a message that cannot say *who* is
+late. This module closes that gap with an aircraft-style black box:
+
+* a **bounded ring buffer** of control-plane events — collective
+  enqueue / negotiation response / exec begin+end, fast-path plan
+  activation/invalidation, elastic transitions, retry/fault firings,
+  serving dispatch — each stamped with rank, monotonic + wall time and
+  a per-rank sequence number. Recording is lock-light: one enabled
+  check, a ``deque.append`` (atomic under the GIL) and an
+  ``itertools.count`` bump — no lock on the hot path, and a single
+  predicted branch when ``HOROVOD_FLIGHT_RECORDER=0`` (the same no-op
+  discipline as utils/metrics.py, asserted by tests/test_flight.py);
+* **dump triggers**: the stall watchdog (before it raises
+  ``HorovodInternalError``), executor errors, preemption SIGTERM,
+  ``SIGUSR2`` on demand, and an excepthook for crash-at-exit. Dumps
+  write rank-local JSONL under ``HOROVOD_FLIGHT_DIR`` and ship to the
+  driver via ``PUT /flight/<rank>`` on the rendezvous HTTP server
+  (runner/http/http_server.py), with a ``GET /clock`` ping so every
+  dump carries its clock offset to the driver for cross-rank
+  alignment;
+* **straggler attribution**: :func:`straggler_report` cross-references
+  peers' last dumps (when available) against the aborting rank's
+  pending tensors, so the stall-abort message names the suspected
+  straggler ranks and the tensors they have not submitted — the
+  distributed form of the reference's stall warning.
+
+``scripts/flight_analyze.py`` merges per-rank dumps (clock-offset
+aligned) into a straggler / critical-path report;
+``scripts/flight_check.py`` is the world-2 loopback smoke gate.
+
+Signal-handler safety: every function a signal handler may reach
+(``record``, ``dump``) avoids the metrics/StepStats locks entirely
+(see elastic/preemption.py for why) — the only lock here serializes
+whole dumps against each other, and it is never held by ``record``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal as _signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# module state (the no-op fast path)
+# ---------------------------------------------------------------------------
+
+DEFAULT_CAPACITY = 4096
+
+_enabled = False
+_configured = False  # True when configure() (hvd.init) enabled us
+_events: "deque" = deque(maxlen=DEFAULT_CAPACITY)
+_seq = itertools.count()
+_rank = -1
+_sink: Optional[Tuple[str, int]] = None  # rendezvous (addr, port)
+_dir = ""
+_dump_lock = threading.Lock()
+_dump_count = 0
+_handlers_installed = False
+_prev_excepthook = None
+_prev_sigusr2 = None
+
+FLIGHT_SCOPE = "flight"  # rendezvous KV scope dumps land in
+
+
+def _default_dir() -> str:
+    return os.path.join(tempfile.gettempdir(), "hvd_flight")
+
+
+def enabled() -> bool:
+    """Whether the recorder is recording. Hot paths with per-event
+    assembly work (building a names list) should gate on this to skip
+    the assembly too; plain record() calls need no guard."""
+    return _enabled
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    global _enabled, _events
+    if capacity is not None and capacity != _events.maxlen:
+        _events = deque(_events, maxlen=max(int(capacity), 16))
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def set_rank(rank: int) -> None:
+    global _rank
+    _rank = int(rank)
+
+
+def rank() -> int:
+    return _rank
+
+
+def set_sink(addr: Optional[str], port: int = 0) -> None:
+    """Where dumps ship: the rendezvous/KV HTTP server. ``None``
+    disables shipping (dumps stay rank-local files)."""
+    global _sink
+    _sink = (addr, int(port)) if addr and port else None
+
+
+def sink() -> Optional[Tuple[str, int]]:
+    return _sink
+
+
+def dump_dir() -> str:
+    return _dir or _default_dir()
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+def record(kind: str, name: str = "", **detail) -> None:
+    """Append one event to the ring. Lock-free: a tuple build plus a
+    ``deque.append`` with ``maxlen`` (old events fall off the far end).
+    Safe from any thread and from signal handlers."""
+    if not _enabled:
+        return
+    _events.append((
+        next(_seq), time.monotonic(), time.time(), kind, name,
+        detail or None,
+    ))
+
+
+def snapshot() -> List[tuple]:
+    """A point-in-time copy of the ring (oldest first)."""
+    return list(_events)
+
+
+def event_count() -> int:
+    return len(_events)
+
+
+def clear() -> None:
+    _events.clear()
+
+
+# ---------------------------------------------------------------------------
+# clock alignment + dumping
+# ---------------------------------------------------------------------------
+
+def _clock_probe() -> dict:
+    """One ping to the sink's ``GET /clock``: returns the offset that
+    maps this rank's wall clock onto the driver's
+    (``t_driver ≈ t_wall + clock_offset_s``) plus the ping RTT, or {}
+    when no sink is reachable. flight_analyze uses the offsets to merge
+    per-rank dumps onto one time axis."""
+    if _sink is None:
+        return {}
+    addr, port = _sink
+    try:
+        t0m = time.monotonic()
+        t0w = time.time()
+        with urllib.request.urlopen(
+                f"http://{addr}:{port}/clock", timeout=2.0) as resp:
+            body = json.loads(resp.read())
+        rtt = time.monotonic() - t0m
+        server_t = float(body["time_unix"])
+        # the server stamped mid-flight; our best wall-clock estimate of
+        # that instant is request start + rtt/2
+        return {
+            "clock_offset_s": server_t - (t0w + rtt / 2.0),
+            "clock_rtt_s": rtt,
+        }
+    except Exception:
+        return {}
+
+
+def _push(payload: bytes) -> bool:
+    """Ship a dump to ``PUT /flight/<rank>`` on the sink. Raw urllib
+    with a short timeout and NO retry policy: this runs from abort
+    paths and signal handlers, where the shared RetryPolicy's metrics
+    recording (registry locks) must not be touched and a dead driver
+    must cost at most the timeout."""
+    if _sink is None:
+        return False
+    addr, port = _sink
+    try:
+        req = urllib.request.Request(
+            f"http://{addr}:{port}/{FLIGHT_SCOPE}/{_rank}",
+            data=payload, method="PUT",
+        )
+        with urllib.request.urlopen(req, timeout=2.0):
+            pass
+        return True
+    except Exception:
+        return False
+
+
+def dump(reason: str = "manual") -> Optional[str]:
+    """Serialize the ring to rank-local JSONL and ship it to the driver.
+
+    Line 1 is a header (rank, reason, wall/monotonic stamps, clock
+    offset to the driver, event count); each further line is one event.
+    Returns the local file path (None when nothing could be written —
+    the push may still have succeeded)."""
+    if not _enabled:
+        return None
+    # non-blocking: a signal handler (SIGUSR2, preemption SIGTERM) runs
+    # on the main thread and may interrupt a frame that already holds
+    # this non-reentrant lock mid-dump — blocking here would deadlock
+    # the handler (and, for preemption, eat the whole grace window).
+    # A dump is best-effort; the one in flight carries the same ring.
+    if not _dump_lock.acquire(blocking=False):
+        return None
+    try:
+        global _dump_count
+        _dump_count += 1
+        events = snapshot()
+        header = {
+            "flight_header": 1,
+            "rank": _rank,
+            "reason": reason,
+            "dump": _dump_count,
+            "time_unix": time.time(),
+            "monotonic": time.monotonic(),
+            "events": len(events),
+        }
+        header.update(_clock_probe())
+        lines = [json.dumps(header)]
+        for seq, t_mono, t_wall, kind, name, detail in events:
+            ev = {
+                "seq": seq,
+                "t_mono": round(t_mono, 6),
+                "t_wall": round(t_wall, 6),
+                "kind": kind,
+                "name": name,
+            }
+            if detail:
+                for k, v in detail.items():
+                    ev.setdefault(k, v)
+            lines.append(json.dumps(ev, default=str))
+        payload = ("\n".join(lines) + "\n").encode()
+        path: Optional[str] = None
+        try:
+            d = dump_dir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"flight_rank{_rank}.jsonl")
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except Exception:
+            path = None
+        _push(payload)
+        return path
+    finally:
+        _dump_lock.release()
+
+
+def dump_count() -> int:
+    return _dump_count
+
+
+# ---------------------------------------------------------------------------
+# cross-rank straggler attribution
+# ---------------------------------------------------------------------------
+
+def parse_dump(text: str) -> Tuple[dict, List[dict]]:
+    """(header, events) from a dump's JSONL text. Unparseable lines are
+    skipped — a truncated dump should still yield what it carries."""
+    header: dict = {}
+    events: List[dict] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("flight_header"):
+            header = obj
+        else:
+            events.append(obj)
+    return header, events
+
+
+def fetch_peer_dump(peer_rank: int) -> Optional[Tuple[dict, List[dict]]]:
+    """The peer's last dump from the sink (``GET /flight/<rank>``), or
+    None when the sink has none / is unreachable."""
+    if _sink is None:
+        return None
+    addr, port = _sink
+    try:
+        with urllib.request.urlopen(
+                f"http://{addr}:{port}/{FLIGHT_SCOPE}/{peer_rank}",
+                timeout=2.0) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except Exception:
+        return None
+    return parse_dump(text)
+
+
+def _enqueue_counts(names: Sequence[str], events) -> Dict[str, int]:
+    """Per-name enqueue counts restricted to ``names``. Counts — not
+    sets — so a tensor enqueued on every previous step but missing from
+    the current one still reads as 'behind' (the peer's count lags)."""
+    want = set(names)
+    counts: Dict[str, int] = {}
+    for ev in events:
+        if isinstance(ev, dict):
+            kind, name = ev.get("kind"), ev.get("name")
+        else:
+            kind, name = ev[3], ev[4]
+        if kind == "enqueue" and name in want:
+            counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+def _fmt_names(names: Sequence[str], limit: int = 6) -> str:
+    names = list(names)
+    head = ", ".join(names[:limit])
+    if len(names) > limit:
+        head += f" (+{len(names) - limit} more)"
+    return head
+
+
+def straggler_report(pending_names: Sequence[str], world_size: int,
+                     my_rank: Optional[int] = None,
+                     reason: str = "stall_abort") -> str:
+    """Attribute a stall: dump our own ring (so the driver and peers
+    can see it), fetch every peer's last dump from the sink, and name
+    the ranks whose enqueue counts lag ours on the tensors we are
+    still waiting for. Returns a one-line human report ('' when the
+    recorder is off)."""
+    if not _enabled:
+        return ""
+    my_rank = _rank if my_rank is None else my_rank
+    pending = sorted(set(pending_names))
+    path = dump(reason)
+    parts: List[str] = []
+    stragglers: List[Tuple[int, List[str]]] = []
+    unavailable: List[int] = []
+    fetched = 0
+    if pending and _sink is not None and world_size > 1:
+        mine = _enqueue_counts(pending, snapshot())
+        # total wall budget on the peer sweep: a stall is exactly when
+        # the sink is most likely wedged, and elastic recovery is
+        # blocked until this report's HorovodInternalError raises — at
+        # large world sizes N serial 2s timeouts would dwarf the stall
+        # window itself. Unfetched ranks read as unavailable.
+        fetch_deadline = time.monotonic() + 8.0
+        for r in range(world_size):
+            if r == my_rank:
+                continue
+            if time.monotonic() >= fetch_deadline:
+                unavailable.append(r)
+                continue
+            peer = fetch_peer_dump(r)
+            if peer is None:
+                unavailable.append(r)
+                continue
+            fetched += 1
+            theirs = _enqueue_counts(pending, peer[1])
+            behind = [
+                n for n in pending
+                if theirs.get(n, 0) < mine.get(n, 0)
+            ]
+            if behind:
+                stragglers.append((r, behind))
+    if stragglers:
+        parts.append(
+            "suspected straggler "
+            + ("rank" if len(stragglers) == 1 else "ranks")
+            + " (per peer flight dumps): "
+            + "; ".join(
+                f"rank {r} has not submitted {_fmt_names(b)}"
+                for r, b in stragglers
+            )
+        )
+    if pending and world_size > 1:
+        if _sink is None:
+            parts.append("no flight sink configured to fetch peer dumps")
+        elif unavailable:
+            # a dump-less peer is itself a forensic signal (it may be
+            # the dead rank) — report it whether or not some other
+            # peer's counts already lag
+            parts.append(
+                "no peer flight dumps available to attribute the stall"
+                if not fetched else
+                f"no dumps from ranks {unavailable}"
+            )
+        elif not stragglers and fetched:
+            parts.append("peer dumps show no enqueue lag")
+    if pending:
+        parts.append(f"locally pending: {_fmt_names(pending)}")
+    if path:
+        parts.append(f"flight dump: {path}")
+    return "; ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# trigger handlers (SIGUSR2 on demand, crash excepthook)
+# ---------------------------------------------------------------------------
+
+def _sigusr2(signum, frame) -> None:
+    record("signal_dump", signum=signum)
+    dump("sigusr2")
+    # chain: an application's own SIGUSR2 tooling (stack dumps, config
+    # reload) must keep firing — the recorder defaults ON and must not
+    # silently eat a signal the app was using
+    prev = _prev_sigusr2
+    if callable(prev):
+        prev(signum, frame)
+
+
+def _excepthook(exc_type, exc, tb):
+    # a crashing worker leaves its last control-plane moments behind —
+    # the dump ships before the interpreter dies (atexit would be too
+    # late for os._exit paths, too broad for clean exits)
+    try:
+        record("crash", exc_type.__name__, error=str(exc)[:200])
+        dump("crash")
+    except Exception:
+        pass
+    if _prev_excepthook is not None:
+        _prev_excepthook(exc_type, exc, tb)
+
+
+def install_handlers() -> bool:
+    """Arm SIGUSR2 (dump on demand) and the crash excepthook.
+    Idempotent; returns False when signal handlers cannot be installed
+    from this thread (the excepthook is still chained)."""
+    global _handlers_installed, _prev_excepthook, _prev_sigusr2
+    if _handlers_installed:
+        return True
+    if _prev_excepthook is None and sys.excepthook is not _excepthook:
+        _prev_excepthook = sys.excepthook
+        sys.excepthook = _excepthook
+    try:
+        prev = _signal.signal(_signal.SIGUSR2, _sigusr2)
+    except (ValueError, AttributeError, OSError):
+        return False  # not the main thread / no SIGUSR2 on platform
+    if prev is not _sigusr2 and prev not in (
+            _signal.SIG_IGN, _signal.SIG_DFL, None):
+        _prev_sigusr2 = prev
+    _handlers_installed = True
+    return True
+
+
+# ---------------------------------------------------------------------------
+# lifecycle (core/basics.py calls configure/on_shutdown)
+# ---------------------------------------------------------------------------
+
+def _env_first(*names: str) -> Optional[str]:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return None
+
+
+def configure(knobs=None, *, enabled_override: Optional[bool] = None,
+              rank: Optional[int] = None,
+              sink_addr: Optional[str] = None,
+              sink_port: Optional[int] = None,
+              directory: Optional[str] = None,
+              capacity: Optional[int] = None,
+              handlers: Optional[bool] = None) -> None:
+    """Arm the recorder from the knob snapshot (hvd.init) or explicit
+    overrides (tests, check scripts). Rank defaults to the launcher's
+    HOROVOD_RANK env; the sink defaults to the launcher-published
+    rendezvous address, so worker dumps reach the driver with zero
+    extra wiring."""
+    global _configured, _dir
+    want = bool(getattr(knobs, "flight_recorder", True)
+                if enabled_override is None else enabled_override)
+    if rank is not None:
+        set_rank(rank)
+    elif _rank < 0:
+        env_rank = _env_first("HVD_TPU_RANK", "HOROVOD_RANK")
+        if env_rank is not None:
+            try:
+                set_rank(int(env_rank))
+            except ValueError:
+                pass
+    if sink_addr is not None:
+        set_sink(sink_addr, sink_port or 0)
+    elif _sink is None:
+        addr = _env_first(
+            "HVD_TPU_RENDEZVOUS_ADDR", "HOROVOD_GLOO_RENDEZVOUS_ADDR")
+        port = _env_first(
+            "HVD_TPU_RENDEZVOUS_PORT", "HOROVOD_GLOO_RENDEZVOUS_PORT")
+        if addr and port:
+            try:
+                set_sink(addr, int(port))
+            except ValueError:
+                pass
+    if directory is not None:
+        _dir = directory
+    elif not _dir:
+        _dir = getattr(knobs, "flight_dir", "") or ""
+    cap = capacity if capacity is not None else getattr(
+        knobs, "flight_capacity", None)
+    if not want:
+        disable()
+        return
+    _configured = True
+    enable(cap)
+    if handlers if handlers is not None else True:
+        install_handlers()
+
+
+def on_shutdown() -> None:
+    """hvd.shutdown(): stop recording if configure() was what enabled
+    us. Handlers stay installed (they no-op while disabled); the ring
+    keeps its contents for post-shutdown inspection."""
+    global _configured
+    if _configured:
+        _configured = False
+        disable()
+
+
+def reset() -> None:
+    """Test hook: clear events/counters and return to the disabled,
+    unconfigured state."""
+    global _configured, _dump_count, _rank, _sink, _dir, _seq
+    disable()
+    _configured = False
+    _events.clear()
+    _seq = itertools.count()
+    _dump_count = 0
+    _rank = -1
+    _sink = None
+    _dir = ""

@@ -5,11 +5,20 @@ with levels TRACE/DEBUG/INFO/WARNING/ERROR/FATAL, env-configured via
 HOROVOD_LOG_LEVEL and HOROVOD_LOG_HIDE_TIME. Python logging is the natural
 host here; the C++ native runtime (horovod_tpu/_native) has its own
 mirror-image logger for the background thread.
+
+Multi-rank attribution: with ``HOROVOD_LOG_RANK=1`` (or the
+``rank_prefix`` argument, wired through worker init in core/basics.py)
+every line carries a ``[rank N]`` prefix resolved from the launcher's
+``HOROVOD_RANK`` env — no jax import, so the prefix is correct from the
+first line of a spawned worker, before (or without) jax initializing.
+Interleaved stderr from a multi-rank launch is then attributable by
+grep alone.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import sys
 
 _LEVELS = {
@@ -24,28 +33,72 @@ _LEVELS = {
 LOGGER = logging.getLogger("horovod_tpu")
 
 
-class _RankFilter(logging.Filter):
-    def filter(self, record: logging.LogRecord) -> bool:
-        try:
-            import jax
+def _env_rank() -> int:
+    """The launcher-assigned rank, or -1 outside a launched worker."""
+    for key in ("HVD_TPU_RANK", "HOROVOD_RANK"):
+        v = os.environ.get(key)
+        if v:
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return -1
 
-            record.hvd_rank = jax.process_index()
-        except Exception:
-            record.hvd_rank = -1
+
+class _RankFilter(logging.Filter):
+    """Stamps ``record.hvd_rank``: launcher env first (cheap, correct
+    pre-jax), jax.process_index() as the fallback for worlds started
+    without the launcher. The resolved value is cached — the per-record
+    jax import this used to do was measurable noise on chatty levels."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._rank = _env_rank()
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if self._rank < 0:
+            try:
+                import jax
+
+                self._rank = jax.process_index()
+            except Exception:
+                pass  # keep retrying until a backend exists
+        record.hvd_rank = self._rank
         return True
 
 
-def configure_logging(level: str = "WARNING", hide_timestamp: bool = False) -> None:
+def _env_truthy(name: str) -> bool:
+    v = (os.environ.get("HVD_TPU_" + name)
+         or os.environ.get("HOROVOD_" + name) or "")
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def configure_logging(level: str = "WARNING",
+                      hide_timestamp: bool = False,
+                      rank_prefix: bool = None) -> None:
+    """(Re)configure the horovod_tpu logger. ``rank_prefix`` (default:
+    the HOROVOD_LOG_RANK env) switches to the ``[rank N]`` line format;
+    re-calling updates the level and format of the existing handler."""
+    if rank_prefix is None:
+        rank_prefix = _env_truthy("LOG_RANK")
     LOGGER.setLevel(_LEVELS.get(level.strip().lower(), logging.WARNING))
     if not LOGGER.handlers:
         h = logging.StreamHandler(sys.stderr)
-        fmt = "[%(hvd_rank)s]<%(levelname)s> %(message)s"
-        if not hide_timestamp:
-            fmt = "%(asctime)s " + fmt
-        h.setFormatter(logging.Formatter(fmt))
         h.addFilter(_RankFilter())
+        h._hvd_managed = True  # only OUR handler gets re-formatted
         LOGGER.addHandler(h)
         LOGGER.propagate = False
+    if rank_prefix:
+        fmt = "[rank %(hvd_rank)s] <%(levelname)s> %(message)s"
+    else:
+        fmt = "[%(hvd_rank)s]<%(levelname)s> %(message)s"
+    if not hide_timestamp:
+        fmt = "%(asctime)s " + fmt
+    for h in LOGGER.handlers:
+        # re-applying on re-init keeps rank_prefix/level switchable,
+        # but user-attached handlers keep their own formatters
+        if getattr(h, "_hvd_managed", False):
+            h.setFormatter(logging.Formatter(fmt))
 
 
 def get_logger() -> logging.Logger:

@@ -28,9 +28,14 @@ from __future__ import annotations
 import bisect
 import contextlib
 import json
+import os
+import re
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+import urllib.request
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import flight as _flight
 
 # ---------------------------------------------------------------------------
 # module-level enable gate (the no-op fast path)
@@ -332,11 +337,201 @@ def scrape() -> str:
     return registry.render()
 
 
-def exposition() -> Tuple[str, bytes]:
+def exposition(
+    pushed: Optional[Dict[str, bytes]] = None,
+) -> Tuple[str, bytes]:
     """(content-type, body) for serving a scrape over HTTP — the one
-    definition both the standalone endpoint and the rendezvous server
-    mount (runner/http/http_server.py)."""
-    return PROM_CONTENT_TYPE, scrape().encode()
+    definition the standalone endpoint, the serving server and the
+    rendezvous server all mount (runner/http/http_server.py).
+
+    With ``pushed`` (rank label → exposition payload, as collected by
+    the rendezvous server from worker ``PUT /metrics_push/<rank>``
+    calls) the scrape is **cluster-aggregated**: this process's series
+    stay unlabeled and every pushed series gains a ``rank="<r>"``
+    label, so one endpoint answers for the whole world."""
+    if not pushed:
+        return PROM_CONTENT_TYPE, scrape().encode()
+    payloads: List[Tuple[str, str]] = [("", scrape())]
+    for rank_label in sorted(pushed, key=lambda r: (len(r), r)):
+        body = pushed[rank_label]
+        text = (body.decode("utf-8", "replace")
+                if isinstance(body, (bytes, bytearray)) else str(body))
+        payloads.append((rank_label, text))
+    return PROM_CONTENT_TYPE, merge_expositions(payloads).encode()
+
+
+#: rendezvous KV scope worker metric pushes land in (the aggregation
+#: source for the rendezvous /metrics mount)
+METRICS_PUSH_SCOPE = "metrics_push"
+
+
+def merge_expositions(payloads: Iterable[Tuple[str, str]]) -> str:
+    """Merge Prometheus text payloads into one exposition, injecting a
+    ``rank`` label into every sample of a non-empty-labeled payload.
+    Families are regrouped so HELP/TYPE headers appear once, before all
+    of a family's samples (what parsers and :func:`lint_exposition`
+    require)."""
+    help_: Dict[str, str] = {}
+    type_: Dict[str, str] = {}
+    samples: Dict[str, List[str]] = {}
+    order: List[str] = []
+    for rank_label, text in payloads:
+        fam: Optional[str] = None
+        for line in text.splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                name, _, tail = line[7:].partition(" ")
+                if not name:
+                    continue
+                target = help_ if line.startswith("# HELP ") else type_
+                target.setdefault(name, tail)
+                fam = name
+                continue
+            if not line.strip() or line.startswith("#"):
+                continue
+            key, _, val = line.rpartition(" ")
+            if not key:
+                continue
+            name, brace, labels = key.partition("{")
+            family = (
+                fam if fam and name in (
+                    fam, fam + "_bucket", fam + "_sum", fam + "_count")
+                else name
+            )
+            if rank_label:
+                extra = f'rank="{_escape_label(str(rank_label))}"'
+                inner = labels[:-1] if brace else ""
+                line = (
+                    f"{name}{{"
+                    + (inner + "," if inner else "")
+                    + extra + f"}} {val}"
+                )
+            bucket = samples.get(family)
+            if bucket is None:
+                bucket = samples[family] = []
+                order.append(family)
+            bucket.append(line)
+    out: List[str] = []
+    for family in order:
+        if family in help_:
+            out.append(f"# HELP {family} {help_[family]}")
+        if family in type_:
+            out.append(f"# TYPE {family} {type_[family]}")
+        out.extend(samples[family])
+    return "\n".join(out) + ("\n" if out else "")
+
+
+# -- exposition lint (test helper; docs/metrics.md) -------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"           # metric name
+    r"(?:\{(.*)\})?"                          # optional label block
+    r" (NaN|[+-]?Inf|-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)$"
+)
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+_PROM_KINDS = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _split_labels(block: str) -> List[str]:
+    """Split 'a="x",b="y,z"' on commas outside quotes."""
+    parts, buf, in_q, esc = [], [], False, False
+    for ch in block:
+        if esc:
+            buf.append(ch)
+            esc = False
+        elif ch == "\\":
+            buf.append(ch)
+            esc = True
+        elif ch == '"':
+            buf.append(ch)
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if buf:
+        parts.append("".join(buf))
+    return parts
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Validate Prometheus text exposition; returns a list of problems
+    (empty = parseable). Checks: sample-line grammar, label syntax,
+    TYPE kinds, TYPE-before-samples, duplicate series, and histogram
+    bucket monotonicity with a closing ``le="+Inf"``. Used by the
+    regression tests that scrape /metrics under concurrent registry
+    mutation — both the process-local and the rank-aggregated output
+    must stay parseable at any instant."""
+    errors: List[str] = []
+    typed: Dict[str, str] = {}
+    seen: set = set()
+    hist: Dict[Tuple[str, str], List[Tuple[float, float]]] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            name, _, tail = line[7:].partition(" ")
+            if not name:
+                errors.append(f"line {i}: malformed comment header")
+                continue
+            if line.startswith("# TYPE "):
+                if tail not in _PROM_KINDS:
+                    errors.append(f"line {i}: unknown TYPE {tail!r}")
+                if name in typed:
+                    errors.append(f"line {i}: duplicate TYPE for {name}")
+                typed[name] = tail
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            errors.append(f"line {i}: unparseable sample: {line!r}")
+            continue
+        name, labels, val = m.groups()
+        label_parts = _split_labels(labels) if labels else []
+        for part in label_parts:
+            if not _LABEL_RE.match(part):
+                errors.append(f"line {i}: bad label {part!r}")
+        key = (name, labels or "")
+        if key in seen:
+            errors.append(f"line {i}: duplicate series {name}{{{labels}}}")
+        seen.add(key)
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed:
+                family = name[: -len(suffix)]
+                break
+        if family not in typed:
+            errors.append(
+                f"line {i}: sample {name} precedes its TYPE header")
+        if typed.get(family) == "histogram" and name == family + "_bucket":
+            le, rest = None, []
+            for part in label_parts:
+                if part.startswith('le="'):
+                    le = part[4:-1]
+                else:
+                    rest.append(part)
+            if le is None:
+                errors.append(f"line {i}: histogram bucket missing le=")
+            else:
+                hist.setdefault((family, ",".join(rest)), []).append(
+                    (float("inf") if le == "+Inf" else float(le),
+                     float(m.group(3)))
+                )
+    for (family, series), buckets in hist.items():
+        buckets.sort(key=lambda b: b[0])
+        if not buckets or buckets[-1][0] != float("inf"):
+            errors.append(
+                f'{family}{{{series}}}: histogram lacks le="+Inf"')
+        cum = -1.0
+        for le, v in buckets:
+            if v < cum:
+                errors.append(
+                    f"{family}{{{series}}}: bucket counts not "
+                    f"cumulative at le={le}")
+                break
+            cum = v
+    return errors
 
 
 # ---------------------------------------------------------------------------
@@ -626,6 +821,7 @@ def record_timeline_activity(activity: str, seconds: float) -> None:
 def record_retry(point: str) -> None:
     """One backed-off retry of a control-plane call (utils/retry.py),
     labeled by call point (http.put, checkpoint.save, ...)."""
+    _flight.record("retry", point)  # flight recorder has its own gate
     if not _enabled:
         return
     registry.counter(
@@ -638,6 +834,7 @@ def record_retry(point: str) -> None:
 def record_retry_giveup(point: str) -> None:
     """A retried call that exhausted its attempts/deadline and
     re-raised."""
+    _flight.record("retry_giveup", point)
     if not _enabled:
         return
     registry.counter(
@@ -650,6 +847,7 @@ def record_retry_giveup(point: str) -> None:
 def record_fault(point: str, action: str) -> None:
     """One injected fault fired (utils/faults.py), by injection point
     and action — lets chaos runs prove the faults actually happened."""
+    _flight.record("fault", point, action=action)
     if not _enabled:
         return
     registry.counter(
@@ -672,6 +870,7 @@ def record_stall_abort() -> None:
 def record_elastic_event(kind: str) -> None:
     """An elastic lifecycle transition (reset, hosts-updated, round,
     blacklist, ...)."""
+    _flight.record("elastic", kind)
     if not _enabled:
         return
     registry.counter(
@@ -922,6 +1121,64 @@ def stop_http_server() -> None:
         _http_thread = None
 
 
+# ---------------------------------------------------------------------------
+# worker → rendezvous metrics push (the aggregation feed). Each worker
+# PUTs its exposition under /metrics_push/<rank> at most once per
+# HOROVOD_METRICS_PUSH_INTERVAL_S; the rendezvous /metrics mount merges
+# the pushed payloads into one rank-labeled scrape (docs/metrics.md).
+# ---------------------------------------------------------------------------
+
+_push_thread: Optional[threading.Thread] = None
+_push_stop: Optional[threading.Event] = None
+
+
+def push_once(addr: str, port: int, rank: int) -> bool:
+    """One exposition PUT to the rendezvous store. Raw urllib with a
+    short timeout and no retry ladder: telemetry is best-effort and a
+    dead driver must never stall a worker."""
+    body = scrape().encode()
+    try:
+        req = urllib.request.Request(
+            f"http://{addr}:{port}/{METRICS_PUSH_SCOPE}/{rank}",
+            data=body, method="PUT",
+        )
+        with urllib.request.urlopen(req, timeout=2.0):
+            pass
+        return True
+    except Exception:
+        return False
+
+
+def start_metrics_push(addr: str, port: int, rank: int,
+                       interval_s: float = 5.0) -> None:
+    """Start (or restart) the background push loop: one immediate push,
+    then one per interval, plus a final flush on stop so short-lived
+    workers still publish their last state."""
+    global _push_thread, _push_stop
+    stop_metrics_push()
+    stop = threading.Event()
+
+    def loop():
+        push_once(addr, port, rank)
+        while not stop.wait(max(interval_s, 0.05)):
+            push_once(addr, port, rank)
+        push_once(addr, port, rank)
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name="hvd-metrics-push")
+    t.start()
+    _push_thread, _push_stop = t, stop
+
+
+def stop_metrics_push() -> None:
+    global _push_thread, _push_stop
+    if _push_thread is not None:
+        _push_stop.set()
+        _push_thread.join(timeout=5)
+        _push_thread = None
+        _push_stop = None
+
+
 def http_port() -> Optional[int]:
     return _http_server.server_address[1] if _http_server else None
 
@@ -948,12 +1205,29 @@ def configure(knobs) -> None:
         step_stats.open_log(knobs.metrics_file)
     if getattr(knobs, "metrics_port", 0):
         start_http_server(knobs.metrics_port)
+    # launcher-spawned worker: feed the rendezvous server's aggregated
+    # /metrics (the driver process itself has no rank env and does not
+    # push — its registry is the unlabeled series of the merge)
+    interval = float(
+        getattr(knobs, "metrics_push_interval_s", 0.0) or 0.0)
+    addr = (os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+            or os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"))
+    port = (os.environ.get("HVD_TPU_RENDEZVOUS_PORT")
+            or os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT"))
+    rank = (os.environ.get("HVD_TPU_RANK")
+            or os.environ.get("HOROVOD_RANK"))
+    if interval > 0 and addr and port and rank is not None:
+        try:
+            start_metrics_push(addr, int(port), int(rank), interval)
+        except ValueError:
+            pass
 
 
 def on_shutdown() -> None:
     """hvd.shutdown(): flush/close the step log and endpoint; disable
     only if configure() was what enabled us."""
     global _configured
+    stop_metrics_push()  # joins after a final flush
     step_stats.close_log()
     stop_http_server()
     set_native_stats_provider(None)

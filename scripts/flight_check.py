@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""Flight-recorder forensics smoke gate: world-2 loopback stall autopsy.
+
+Sits next to ``metrics_summary --check`` / ``chaos_check`` /
+``eager_fastpath_check`` / ``serving_loadgen --check`` in the repo's
+check scripts (docs/flight.md). Scenario:
+
+* a KV/rendezvous server runs in the parent (the "driver") — it is the
+  flight-dump sink (``PUT /flight/<rank>``), the clock source
+  (``GET /clock``) and the aggregated ``/metrics`` endpoint;
+* two EagerRuntime worker processes run a negotiated training loop
+  (fast path off — the stall being manufactured lives in negotiation);
+  rank 1 carries a ``collective:delay:secs=...:name=g3`` fault, so on
+  the faulted step it silently stops submitting ``g3`` onward;
+* the parent sends rank 1 ``SIGUSR2`` (the on-demand dump trigger)
+  while it sleeps in the injected delay, then rank 0's stall watchdog
+  fires: it dumps its ring, cross-references rank 1's dump from the
+  sink, and the upgraded abort message must **name rank 1 and the
+  unsubmitted tensors**;
+* after both workers finish, ``scripts/flight_analyze.py`` merges the
+  dumps from the server and its report must name rank 1 as the
+  straggler with ``g3`` unsubmitted, and the aggregated ``/metrics``
+  must expose worker-rank-labeled series that lint clean.
+
+Exits 0 with a JSON summary on success, 1 with the first failed
+assertion otherwise.
+
+Usage:
+    python scripts/flight_check.py [--check] [--delay 5.0]
+"""
+
+import argparse
+import importlib.util
+import json
+import multiprocessing as mp
+import os
+import signal
+import socket
+import sys
+import tempfile
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+TENSORS_PER_STEP = 8
+STEPS = 4           # fault arms after 3 clean g3 enqueues → fires step 3
+STALL_ABORT_S = 2.5
+SIGUSR2_AT_S = 0.7  # into the faulted step: rank 1 is asleep by then
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _worker(rank, size, nport, kv_port, delay_s, flight_dir, q, hold):
+    # env BEFORE horovod imports: the fault spec arms at import, and
+    # metrics/flight resolve the sink from the rendezvous env
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if rank == 1:
+        os.environ["HOROVOD_TPU_FAULT_SPEC"] = (
+            f"collective:delay:secs={delay_s}:name=g3:after={STEPS - 1}"
+        )
+    import numpy as np
+
+    from horovod_tpu.core.exceptions import HorovodInternalError
+    from horovod_tpu.ops.eager_runtime import EagerRuntime
+    from horovod_tpu.utils import flight, metrics
+
+    metrics.enable()
+    metrics.start_metrics_push("127.0.0.1", kv_port, rank,
+                               interval_s=0.3)
+    flight.configure(enabled_override=True, rank=rank,
+                     sink_addr="127.0.0.1", sink_port=kv_port,
+                     directory=flight_dir, handlers=True)
+
+    rt = EagerRuntime(rank, size, "127.0.0.1", nport, cycle_ms=1.0,
+                      fast_path=False, stall_abort_s=STALL_ABORT_S)
+    rng = np.random.RandomState(7)
+    names = [f"g{i}" for i in range(TENSORS_PER_STEP)]
+    try:
+        for step in range(STEPS):
+            q.put((rank, "step", step))
+            x = [rng.randn(32).astype(np.float32) for _ in names]
+            handles = {
+                n: rt.allreduce_async(n, x[i])
+                for i, n in enumerate(names)
+            }
+            for n in names:
+                rt.synchronize(handles[n], timeout_s=60.0)
+        q.put((rank, "done", {"dumps": flight.dump_count()}))
+    except HorovodInternalError as e:
+        q.put((rank, "aborted", {"message": str(e),
+                                 "dumps": flight.dump_count()}))
+    except Exception as e:  # pragma: no cover - diagnostic path
+        q.put((rank, "error", repr(e)))
+    finally:
+        # the coordinator lives in rank 0: hold it open until the
+        # parent has seen every worker finish, or rank 1's last step
+        # would stall against a vanished world
+        if rank == 0:
+            hold.wait(timeout=60.0)
+        metrics.stop_metrics_push()
+        rt.shutdown()
+
+
+def _load_analyzer():
+    spec = importlib.util.spec_from_file_location(
+        "flight_analyze", os.path.join(_REPO, "scripts",
+                                       "flight_analyze.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="run the smoke gate (default behavior)")
+    ap.add_argument("--delay", type=float, default=60.0,
+                    help="injected per-enqueue delay on rank 1's g3 — "
+                         "long by design: the straggler stays wedged "
+                         "and is reaped after the autopsy, so its last "
+                         "dump stays the forensic (mid-stall) one")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from horovod_tpu.runner.http.http_server import KVStoreServer
+    from horovod_tpu.utils import metrics as _metrics
+
+    kv = KVStoreServer()
+    kv_port = kv.start_server()
+    nport = _free_port()
+    flight_dir = tempfile.mkdtemp(prefix="hvd_flight_check_")
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    hold = ctx.Event()
+    procs = [
+        ctx.Process(target=_worker,
+                    args=(r, 2, nport, kv_port, args.delay,
+                          flight_dir, q, hold))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+
+    results = {}
+    failures = []
+    report = {}
+    sigusr2_sent = False
+    deadline = time.monotonic() + 120.0
+    try:
+        # drive until rank 0's verdict: rank 1 is wedged by design (it
+        # sleeps inside the injected delay) and is reaped afterwards —
+        # a real straggler does not politely exit either
+        while 0 not in results and time.monotonic() < deadline:
+            try:
+                rank, kind, payload = q.get(timeout=5.0)
+            except Exception:
+                continue
+            if kind == "step":
+                if rank == 1 and payload == STEPS - 1 and not sigusr2_sent:
+                    # rank 1 is (about to be) asleep inside the
+                    # injected delay: exercise the on-demand trigger so
+                    # its dump is on the sink BEFORE rank 0's watchdog
+                    # fires and cross-references it
+                    time.sleep(SIGUSR2_AT_S)
+                    os.kill(procs[1].pid, signal.SIGUSR2)
+                    sigusr2_sent = True
+                continue
+            results[rank] = (kind, payload)
+        # autopsy done: reap the wedged straggler, release rank 0
+        if procs[1].is_alive():
+            procs[1].terminate()
+        hold.set()
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+
+        # -- assertions ----------------------------------------------------
+        if 0 not in results:
+            failures.append(f"rank 0 never reported: {results}")
+        else:
+            kind0, payload0 = results[0]
+            if kind0 != "aborted":
+                failures.append(
+                    f"rank 0 should have stall-aborted, got {kind0}: "
+                    f"{payload0}")
+            else:
+                msg = payload0["message"]
+                if "rank 1 has not submitted" not in msg:
+                    failures.append(
+                        f"abort message does not name the straggler "
+                        f"rank: {msg!r}")
+                if "g3" not in msg:
+                    failures.append(
+                        f"abort message does not name the unsubmitted "
+                        f"tensor: {msg!r}")
+        if not sigusr2_sent:
+            failures.append("never reached the faulted step")
+
+        # dumps reachable from the sink for both ranks
+        for r in range(2):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{kv_port}/flight/{r}",
+                        timeout=5) as resp:
+                    resp.read()
+            except Exception as e:
+                failures.append(f"no flight dump on sink for rank {r}: "
+                                f"{e}")
+
+        # aggregated forensics: the analyzer must name rank 1 + g3
+        analyzer = _load_analyzer()
+        dumps = analyzer.load_server("127.0.0.1", kv_port, 2)
+        report = analyzer.analyze(dumps) if dumps else {}
+        if report.get("suspected_straggler_ranks") != [1]:
+            failures.append(
+                "analyzer did not single out rank 1: "
+                f"{report.get('suspected_straggler_ranks')}")
+        if "g3" not in report.get("stragglers", {}).get("1", []):
+            failures.append(
+                "analyzer report lacks g3 in rank 1's unsubmitted set: "
+                f"{report.get('stragglers')}")
+
+        # cluster-aggregated /metrics: rank-labeled worker series that
+        # lint clean (per-rank push bounded by the push interval)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{kv_port}/metrics", timeout=5) as r:
+            scrape = r.read().decode()
+        for label in ('rank="0"', 'rank="1"'):
+            if label not in scrape:
+                failures.append(
+                    f"aggregated /metrics lacks {label} series")
+        lint = _metrics.lint_exposition(scrape)
+        if lint:
+            failures.append(f"aggregated /metrics fails lint: {lint[:3]}")
+    finally:
+        kv.shutdown_server()
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+
+    summary = {
+        "what": "flight-recorder forensics smoke gate (loopback world-2)",
+        "results": {r: k for r, (k, _) in results.items()},
+        "suspected_stragglers": report.get("suspected_straggler_ranks"),
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=1))
+    for f in failures:
+        print("FAIL:", f)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Umbrella local PR gate: run every smoke check with one command.
+
+The repo's check scripts each gate one subsystem; this script runs the
+whole family and exits nonzero if ANY fails, so one command gates a PR
+locally before the full pytest tier:
+
+* ``metrics`` — a tiny loopback run with ``HOROVOD_TPU_METRICS_FILE``
+  set, then ``scripts/metrics_summary.py --check`` on the JSONL
+  (telemetry flowed);
+* ``chaos`` — ``scripts/chaos_check.py`` (elastic recovery under
+  worker kill + HTTP error rates + discovery flap);
+* ``eager_fastpath`` — ``scripts/eager_fastpath_check.py`` (plan cache
+  engages, bitwise parity, zero steady negotiated bytes);
+* ``serving`` — an in-process engine+batcher+server driven by
+  ``scripts/serving_loadgen.py --check`` (traffic succeeds, batching
+  metrics live);
+* ``flight`` — ``scripts/flight_check.py`` (world-2 stall autopsy:
+  straggler named, dumps aggregated, rank-labeled /metrics).
+
+Usage:
+    python scripts/run_all_checks.py [--only NAME ...] [--skip NAME ...]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+_SCRIPTS = os.path.join(_REPO, "scripts")
+
+
+def _env():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run(argv, timeout_s=600, env=None):
+    proc = subprocess.run(
+        argv, env=env or _env(), cwd=_REPO, timeout=timeout_s,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    return proc.returncode, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the gates
+# ---------------------------------------------------------------------------
+
+def check_metrics() -> "tuple[int, str]":
+    """Produce a metrics JSONL with a tiny loopback run, then gate it
+    with metrics_summary --check."""
+    with tempfile.TemporaryDirectory(prefix="hvd_checks_") as d:
+        jsonl = os.path.join(d, "run.jsonl")
+        src = textwrap.dedent(f"""
+            import jax.numpy as jnp
+            import horovod_tpu as hvd
+            hvd.init()
+            for _ in range(3):
+                with hvd.metrics.step():
+                    hvd.allreduce(jnp.ones((64,), jnp.float32))
+            hvd.shutdown()
+        """)
+        env = _env()
+        env["HOROVOD_TPU_METRICS_FILE"] = jsonl
+        rc, out = _run([sys.executable, "-c", src], env=env)
+        if rc != 0:
+            return rc, out
+        rc2, out2 = _run([
+            sys.executable, os.path.join(_SCRIPTS, "metrics_summary.py"),
+            jsonl, "--check",
+        ])
+        return rc2, out + out2
+
+
+def check_chaos():
+    return _run([sys.executable, os.path.join(_SCRIPTS, "chaos_check.py")])
+
+
+def check_eager_fastpath():
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "eager_fastpath_check.py"),
+        "--check",
+    ])
+
+
+def check_serving():
+    """Spin up engine → batcher → ServingServer in-process and fire
+    serving_loadgen --check at it (the same wire surface the replica
+    entrypoint serves, without needing an orbax checkpoint)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from horovod_tpu.serving.batcher import DynamicBatcher
+    from horovod_tpu.serving.engine import InferenceEngine
+    from horovod_tpu.serving.server import ServingServer
+    from horovod_tpu.utils import metrics
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    engine = InferenceEngine(
+        lambda p, x: jnp.tanh(x @ p), w, buckets=(1, 4, 8),
+        feature_shape=(8,),
+    )
+    metrics.enable()
+    batcher = DynamicBatcher(engine, max_batch=8, max_wait_ms=2.0,
+                             queue_limit=64).start()
+    server = ServingServer(batcher.__call__, port=0)
+    port = server.start()
+    try:
+        url = f"http://127.0.0.1:{port}"
+        return _run([
+            sys.executable, os.path.join(_SCRIPTS, "serving_loadgen.py"),
+            "--url", url, "--requests", "40", "--concurrency", "4",
+            "--input-shape", "8", "--examples", "1:4",
+            "--secret-env", "", "--scrape", f"{url}/metrics", "--check",
+        ])
+    finally:
+        server.shutdown()
+        batcher.close(drain=False)
+        metrics.reset()
+
+
+def check_flight():
+    return _run([sys.executable, os.path.join(_SCRIPTS, "flight_check.py"),
+                 "--check"])
+
+
+GATES = [
+    ("metrics", check_metrics),
+    ("chaos", check_chaos),
+    ("eager_fastpath", check_eager_fastpath),
+    ("serving", check_serving),
+    ("flight", check_flight),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", action="append", default=[],
+                    help="run only gates whose name contains this")
+    ap.add_argument("--skip", action="append", default=[],
+                    help="skip gates whose name contains this")
+    ap.add_argument("--verbose", action="store_true",
+                    help="print each gate's full output, not just "
+                         "failures")
+    args = ap.parse_args(argv)
+
+    selected = [
+        (name, fn) for name, fn in GATES
+        if (not args.only or any(o in name for o in args.only))
+        and not any(s in name for s in args.skip)
+    ]
+    if not selected:
+        print("run_all_checks: no gates selected", file=sys.stderr)
+        return 2
+
+    outcomes = {}
+    t_all = time.perf_counter()
+    for name, fn in selected:
+        t0 = time.perf_counter()
+        try:
+            rc, out = fn()
+        except Exception as e:  # a crashed gate is a failed gate
+            rc, out = 1, f"gate raised: {e!r}"
+        dt = time.perf_counter() - t0
+        outcomes[name] = rc
+        status = "OK" if rc == 0 else f"FAIL (exit {rc})"
+        print(f"[{name}] {status} in {dt:.1f}s")
+        if rc != 0 or args.verbose:
+            print(textwrap.indent(out.rstrip(), "    "))
+    failed = [n for n, rc in outcomes.items() if rc != 0]
+    print(json.dumps({
+        "what": "umbrella smoke gates",
+        "outcomes": outcomes,
+        "wall_s": round(time.perf_counter() - t_all, 1),
+        "ok": not failed,
+    }))
+    if failed:
+        print("run_all_checks FAILED:", ", ".join(failed))
+        return 1
+    print(f"run_all_checks OK: {len(outcomes)} gates passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

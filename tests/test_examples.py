@@ -3,6 +3,7 @@ the integration surface users copy; SURVEY.md §4). Each runs in-process
 on the 8-device CPU mesh with tiny configs."""
 
 import numpy as np
+import pytest
 
 from horovod_tpu.utils.script_loader import load_example as _load
 
@@ -44,6 +45,9 @@ def test_bert_pretraining_tiny():
     assert 0 <= mfu < 1
 
 
+@pytest.mark.slow  # ~65s of ResNet-50 AOT compile — the single
+# largest tier-1 test; moved to the slow tier to keep the gate inside
+# its time budget (the PR-1 precedent for multi-minute AOT compiles)
 def test_resnet_synthetic_tiny():
     per_chip, mfu = _load("resnet50_synthetic").main(
         ["--batch-size", "2", "--image-size", "32", "--num-iters", "1",

@@ -1,0 +1,465 @@
+"""Flight recorder + cross-rank forensics (utils/flight.py,
+scripts/flight_analyze.py, docs/flight.md).
+
+Covers: the disabled no-op fast path (< 1 us/call, matching the
+metrics-registry pattern), ring bounding, dump format + parse
+round-trip, the rendezvous PUT /flight/<rank> route with its receipt
+stamp and GET /clock, straggler attribution against peer dumps, the
+analyzer's merge/report, eager-runtime event emission, the SIGUSR2
+on-demand trigger, knob wiring through hvd.init, and the worker →
+rendezvous metrics push feeding the rank-aggregated /metrics."""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.utils import flight, metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight():
+    flight.reset()
+    yield
+    flight.reset()
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ no-op path
+
+def test_disabled_records_nothing():
+    assert not flight.enabled()
+    flight.record("enqueue", "g0", op=1)
+    flight.record("fault", "collective")
+    assert flight.event_count() == 0
+    assert flight.dump("manual") is None
+
+
+def test_disabled_overhead_under_1us_per_call():
+    """HOROVOD_FLIGHT_RECORDER=0 acceptance: the no-op path (module
+    flag check + return) must cost < 1 us per call — the same bound
+    the metrics registry holds (tests/test_metrics.py)."""
+    assert not flight.enabled()
+    n = 200_000
+    rec = flight.record
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rec("enqueue", "g0", op=1, handle=7)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 1e-6, f"no-op record costs {per_call * 1e9:.0f} ns"
+
+
+# ------------------------------------------------------------ ring buffer
+
+def test_ring_is_bounded_and_ordered():
+    flight.enable(capacity=32)
+    for i in range(100):
+        flight.record("enqueue", f"g{i}")
+    assert flight.event_count() == 32
+    events = flight.snapshot()
+    # oldest fell off the far end; sequence stays monotonic
+    assert [e[4] for e in events] == [f"g{i}" for i in range(68, 100)]
+    seqs = [e[0] for e in events]
+    assert seqs == sorted(seqs)
+
+
+def test_enable_preserves_events_on_resize():
+    flight.enable(capacity=64)
+    for i in range(10):
+        flight.record("x", str(i))
+    flight.enable(capacity=128)
+    assert flight.event_count() == 10
+
+
+# ------------------------------------------------------------ dump format
+
+def test_dump_roundtrip(tmp_path):
+    flight.configure(enabled_override=True, rank=3,
+                     directory=str(tmp_path), handlers=False)
+    flight.record("enqueue", "g0", op=1, handle=5)
+    flight.record("exec_end", "g0", names=["g0"])
+    path = flight.dump("unit_test")
+    assert path and os.path.exists(path)
+    header, events = flight.parse_dump(open(path).read())
+    assert header["rank"] == 3
+    assert header["reason"] == "unit_test"
+    assert header["events"] == 2
+    assert events[0]["kind"] == "enqueue"
+    assert events[0]["name"] == "g0"
+    assert events[0]["op"] == 1
+    assert events[1]["kind"] == "exec_end"
+    assert events[0]["seq"] < events[1]["seq"]
+    # a second dump overwrites (the file is "the last dump")
+    flight.record("stall_abort", "g1")
+    path2 = flight.dump("again")
+    assert path2 == path
+    header2, events2 = flight.parse_dump(open(path).read())
+    assert header2["reason"] == "again"
+    assert len(events2) == 3
+
+
+# ----------------------------------------------- rendezvous flight routes
+
+@pytest.fixture()
+def kv_server():
+    from horovod_tpu.runner.http.http_server import KVStoreServer
+
+    srv = KVStoreServer()
+    srv.start_server()
+    yield srv
+    srv.shutdown_server()
+
+
+def test_clock_route(kv_server):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{kv_server.port}/clock", timeout=5) as r:
+        body = json.loads(r.read())
+    assert abs(body["time_unix"] - time.time()) < 5.0
+
+
+def test_dump_ships_to_sink_with_receipt_stamp(kv_server, tmp_path):
+    from horovod_tpu.runner.http.http_server import FLIGHT_META_SCOPE
+
+    flight.configure(enabled_override=True, rank=2,
+                     sink_addr="127.0.0.1", sink_port=kv_server.port,
+                     directory=str(tmp_path), handlers=False)
+    flight.record("enqueue", "g0")
+    flight.dump("ship_it")
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{kv_server.port}/flight/2", timeout=5) as r:
+        header, events = flight.parse_dump(r.read().decode())
+    assert header["rank"] == 2
+    # the /clock probe ran at dump time: offset is near zero locally
+    assert abs(header["clock_offset_s"]) < 5.0
+    assert len(events) == 1
+    meta = json.loads(
+        kv_server.store[FLIGHT_META_SCOPE]["2"].decode())
+    assert meta["bytes"] > 0
+    assert abs(meta["recv_time_unix"] - time.time()) < 5.0
+    # and the peer-fetch helper sees it
+    got = flight.fetch_peer_dump(2)
+    assert got is not None and got[0]["rank"] == 2
+
+
+# ------------------------------------------------- straggler attribution
+
+def _put_fake_dump(port, rank, enqueues):
+    lines = [json.dumps({"flight_header": 1, "rank": rank,
+                         "reason": "fake", "time_unix": time.time(),
+                         "events": len(enqueues)})]
+    for i, name in enumerate(enqueues):
+        lines.append(json.dumps({
+            "seq": i, "t_mono": float(i), "t_wall": time.time(),
+            "kind": "enqueue", "name": name}))
+    body = ("\n".join(lines) + "\n").encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/flight/{rank}", data=body,
+        method="PUT")
+    urllib.request.urlopen(req, timeout=5)
+
+
+def test_straggler_report_names_lagging_peer(kv_server, tmp_path):
+    flight.configure(enabled_override=True, rank=0,
+                     sink_addr="127.0.0.1", sink_port=kv_server.port,
+                     directory=str(tmp_path), handlers=False)
+    # we enqueued g0..g3 twice; peer 1's dump shows g3 only once and
+    # peer 2 kept up; peer 3 has no dump at all
+    for _ in range(2):
+        for n in ("g0", "g1", "g2", "g3"):
+            flight.record("enqueue", n)
+    _put_fake_dump(kv_server.port, 1,
+                   ["g0", "g1", "g2", "g3", "g0", "g1", "g2"])
+    _put_fake_dump(kv_server.port, 2,
+                   ["g0", "g1", "g2", "g3"] * 2)
+    msg = flight.straggler_report(["g2", "g3"], world_size=4, my_rank=0)
+    assert "rank 1 has not submitted g3" in msg
+    assert "rank 2" not in msg
+    assert "[3]" in msg  # no dump from rank 3 is called out
+    assert "locally pending: g2, g3" in msg
+    # our own dump shipped as a side effect (peers/analyzer see us too)
+    assert flight.fetch_peer_dump(0) is not None
+
+
+def test_straggler_report_without_sink(tmp_path):
+    flight.configure(enabled_override=True, rank=0,
+                     directory=str(tmp_path), handlers=False)
+    flight.record("enqueue", "g0")
+    msg = flight.straggler_report(["g0"], world_size=2, my_rank=0)
+    assert "no flight sink configured" in msg
+    assert "locally pending: g0" in msg
+
+
+# ------------------------------------------------------------- analyzer
+
+def _write_dump(path, rank, events, offset=0.0):
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "flight_header": 1, "rank": rank, "reason": "test",
+            "time_unix": time.time(), "events": len(events),
+            "clock_offset_s": offset}) + "\n")
+        for i, ev in enumerate(events):
+            ev = dict(ev)
+            ev.setdefault("seq", i)
+            ev.setdefault("t_mono", float(i))
+            ev.setdefault("t_wall", time.time())
+            f.write(json.dumps(ev) + "\n")
+
+
+def test_flight_analyze_names_straggler(tmp_path):
+    analyzer = _load_script("flight_analyze")
+    # rank 0 enqueued g0,g1 twice and executed the first round; its
+    # second round is pending. rank 1 only ever enqueued the first
+    # round — it is the straggler for both tensors.
+    d0 = str(tmp_path / "flight_rank0.jsonl")
+    d1 = str(tmp_path / "flight_rank1.jsonl")
+    _write_dump(d0, 0, [
+        {"kind": "enqueue", "name": "g0"},
+        {"kind": "enqueue", "name": "g1"},
+        {"kind": "exec_end", "name": "g0", "names": ["g0", "g1"]},
+        {"kind": "enqueue", "name": "g0"},
+        {"kind": "enqueue", "name": "g1"},
+        {"kind": "stall_abort", "name": "g0"},
+    ], offset=0.25)
+    _write_dump(d1, 1, [
+        {"kind": "enqueue", "name": "g0"},
+        {"kind": "enqueue", "name": "g1"},
+        {"kind": "exec_end", "name": "g0", "names": ["g0", "g1"]},
+    ], offset=-0.25)
+    report = analyzer.analyze([analyzer.load_file(d0),
+                               analyzer.load_file(d1)])
+    assert report["suspected_straggler_ranks"] == [1]
+    assert report["stragglers"]["1"] == ["g0", "g1"]
+    assert report["ranks"][0]["pending"] == ["g0", "g1"]
+    assert report["ranks"][1]["pending"] == []
+    # clock offsets applied to the aligned activity stamps
+    assert (report["ranks"][0]["last_activity_aligned_unix"]
+            != report["ranks"][1]["last_activity_aligned_unix"])
+    text = analyzer.render(report)
+    assert "SUSPECTED STRAGGLER rank 1" in text
+    # CLI entry: exit 0 and a JSON artifact
+    out = str(tmp_path / "report.json")
+    assert analyzer.main([d0, d1, "--json", out]) == 0
+    assert json.load(open(out))["suspected_straggler_ranks"] == [1]
+
+
+def test_flight_analyze_handles_duplicate_rank_dumps(tmp_path):
+    """A rank can appear twice (local file + server fetch): the merge
+    must not fall through to comparing header dicts (TypeError) — the
+    later duplicate wins."""
+    analyzer = _load_script("flight_analyze")
+    d0 = str(tmp_path / "a.jsonl")
+    d0b = str(tmp_path / "b.jsonl")
+    _write_dump(d0, 0, [{"kind": "enqueue", "name": "g0"}])
+    _write_dump(d0b, 0, [{"kind": "enqueue", "name": "g0"},
+                         {"kind": "enqueue", "name": "g1"}])
+    report = analyzer.analyze([analyzer.load_file(d0),
+                               analyzer.load_file(d0b)])
+    assert report["ranks"][0]["events"] == 2  # later duplicate won
+
+
+def test_dump_is_nonblocking_when_lock_held(tmp_path):
+    """A signal handler re-entering dump() on the main thread must not
+    deadlock on the non-reentrant dump lock — it bails instead."""
+    flight.configure(enabled_override=True, rank=0,
+                     directory=str(tmp_path), handlers=False)
+    flight.record("enqueue", "g0")
+    assert flight._dump_lock.acquire(blocking=False)
+    try:
+        assert flight.dump("reentrant") is None
+    finally:
+        flight._dump_lock.release()
+    assert flight.dump("after") is not None
+
+
+def test_flight_analyze_no_dumps_is_an_error():
+    analyzer = _load_script("flight_analyze")
+    assert analyzer.main([]) == 1
+
+
+# ------------------------------------------------ eager runtime events
+
+def test_eager_runtime_emits_flight_events(tmp_path):
+    from horovod_tpu.ops.eager_runtime import EagerRuntime
+
+    flight.configure(enabled_override=True, rank=0,
+                     directory=str(tmp_path), handlers=False)
+    rt = EagerRuntime(0, 1, cycle_ms=1.0, fast_path=False)
+    try:
+        h = rt.allreduce_async("fr_x", np.ones((8,), np.float32))
+        rt.synchronize(h, timeout_s=30.0)
+    finally:
+        rt.shutdown()
+    kinds = {}
+    names = set()
+    for ev in flight.snapshot():
+        kinds[ev[3]] = kinds.get(ev[3], 0) + 1
+        names.add(ev[4])
+    assert kinds.get("enqueue", 0) >= 1
+    assert kinds.get("response", 0) >= 1
+    assert kinds.get("exec_begin", 0) >= 1
+    assert kinds.get("exec_end", 0) >= 1
+    assert "fr_x" in names
+
+
+def test_fast_path_plan_events(tmp_path):
+    from horovod_tpu.ops.eager_runtime import EagerRuntime
+
+    flight.configure(enabled_override=True, rank=0,
+                     directory=str(tmp_path), handlers=False)
+    rt = EagerRuntime(0, 1, cycle_ms=1.0, fast_path=True,
+                      fast_path_warmup=2)
+    try:
+        for _ in range(6):
+            hs = [rt.allreduce_async(f"fp_{i}",
+                                     np.ones((4,), np.float32))
+                  for i in range(3)]
+            for h in hs:
+                rt.synchronize(h, timeout_s=30.0)
+        assert rt.fast_path_stats()["active"]
+        rt.invalidate_plan("unit_test")
+    finally:
+        rt.shutdown()
+    kinds = [ev[3] for ev in flight.snapshot()]
+    assert "plan_activate" in kinds
+    assert "plan_invalidate" in kinds
+    # bypassed enqueues still count as submissions
+    fast_enqueues = [
+        ev for ev in flight.snapshot()
+        if ev[3] == "enqueue" and (ev[5] or {}).get("fast_path")
+    ]
+    assert fast_enqueues
+
+
+# -------------------------------------------------------- SIGUSR2 trigger
+
+def test_sigusr2_dumps_on_demand(tmp_path):
+    flight.configure(enabled_override=True, rank=0,
+                     directory=str(tmp_path), handlers=True)
+    flight.record("enqueue", "g0")
+    assert flight.dump_count() == 0
+    os.kill(os.getpid(), signal.SIGUSR2)
+    deadline = time.monotonic() + 5.0
+    while flight.dump_count() == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert flight.dump_count() >= 1
+    header, events = flight.parse_dump(
+        open(os.path.join(str(tmp_path), "flight_rank0.jsonl")).read())
+    assert header["reason"] == "sigusr2"
+    assert any(e["kind"] == "signal_dump" for e in events)
+
+
+def test_sigusr2_chains_preexisting_handler(tmp_path):
+    """An application's own SIGUSR2 handler must keep firing after the
+    recorder (default ON) installs its dump trigger."""
+    fired = []
+    prev = signal.signal(signal.SIGUSR2, lambda s, f: fired.append(s))
+    # earlier tests may have armed the recorder's handler already;
+    # force a fresh install so it captures OUR handler as the previous
+    flight._handlers_installed = False
+    flight._prev_sigusr2 = None
+    try:
+        flight.configure(enabled_override=True, rank=0,
+                         directory=str(tmp_path), handlers=True)
+        os.kill(os.getpid(), signal.SIGUSR2)
+        deadline = time.monotonic() + 5.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert fired == [signal.SIGUSR2]  # the app handler still ran
+        assert flight.dump_count() >= 1   # and so did the dump
+    finally:
+        signal.signal(signal.SIGUSR2, prev)
+        flight._prev_sigusr2 = None
+        flight._handlers_installed = False
+
+
+# ------------------------------------------------------------ knob wiring
+
+def test_knob_disables_recorder(monkeypatch):
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER", "0")
+    hvd.init()
+    try:
+        assert not flight.enabled()
+        flight.record("enqueue", "x")
+        assert flight.event_count() == 0
+    finally:
+        hvd.shutdown()
+
+
+def test_default_on_and_shutdown_disables(monkeypatch, tmp_path):
+    import horovod_tpu as hvd
+
+    monkeypatch.setenv("HOROVOD_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("HOROVOD_FLIGHT_CAPACITY", "77")
+    hvd.init()
+    assert flight.enabled()  # black boxes default on
+    assert flight.dump_dir() == str(tmp_path)
+    hvd.shutdown()
+    assert not flight.enabled()  # configure()-driven enable ends with it
+
+
+# ------------------------------------------- metrics push + aggregation
+
+def test_metrics_push_feeds_aggregated_scrape(kv_server):
+    metrics.reset()
+    metrics.enable()
+    try:
+        metrics.registry.counter("t_push_total", "x").inc(5)
+        assert metrics.push_once("127.0.0.1", kv_server.port, 1)
+        metrics.registry.counter("t_push_total", "x").inc(2)
+        metrics.start_metrics_push("127.0.0.1", kv_server.port, 0,
+                                   interval_s=30.0)  # immediate first push
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{kv_server.port}/metrics",
+                    timeout=5) as r:
+                scrape = r.read().decode()
+            if 'rank="0"' in scrape:
+                break
+            time.sleep(0.05)
+        assert 't_push_total{rank="1"} 5' in scrape
+        assert 't_push_total{rank="0"} 7' in scrape
+        # headers dedup to one family block and the merge lints clean
+        assert scrape.count("# TYPE t_push_total counter") == 1
+        assert metrics.lint_exposition(scrape) == []
+    finally:
+        metrics.stop_metrics_push()
+        metrics.reset()
+
+
+# ----------------------------------------------------- world-2 e2e gate
+
+@pytest.mark.slow
+def test_flight_check_e2e_gate():
+    """The acceptance scenario end-to-end (scripts/flight_check.py):
+    injected collective delay on rank 1, stall watchdog autopsy naming
+    rank 1 + g3, aggregated analyzer report, rank-labeled /metrics."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "flight_check.py")],
+        env=env, cwd=_REPO, timeout=150,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    assert proc.returncode == 0, f"flight_check failed:\n{proc.stdout}"
+    assert '"ok": true' in proc.stdout

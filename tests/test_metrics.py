@@ -379,6 +379,78 @@ def test_metrics_summary_check_mode(tmp_path, capsys):
     assert main([str(tmp_path / "missing.jsonl"), "--check"]) == 1
 
 
+# ----------------------------------------------------- exposition lint
+
+def test_lint_accepts_own_exposition():
+    metrics.enable()
+    metrics.registry.counter("t_l_total", "x", ("op",)).labels("a").inc()
+    metrics.registry.gauge("t_l_depth", "y").set(3)
+    metrics.registry.histogram("t_l_lat", "z").observe(0.01)
+    assert metrics.lint_exposition(metrics.scrape()) == []
+
+
+def test_lint_catches_breakage():
+    assert metrics.lint_exposition("t_x_total 1\n")  # no TYPE header
+    bad_dup = ("# TYPE t_x counter\n"
+               "t_x 1\n"
+               "t_x 2\n")
+    assert any("duplicate series" in e
+               for e in metrics.lint_exposition(bad_dup))
+    bad_hist = ("# TYPE t_h histogram\n"
+                't_h_bucket{le="1"} 5\n'
+                't_h_bucket{le="+Inf"} 3\n'
+                "t_h_sum 1\nt_h_count 3\n")
+    assert any("cumulative" in e
+               for e in metrics.lint_exposition(bad_hist))
+    no_inf = ("# TYPE t_h2 histogram\n"
+              't_h2_bucket{le="1"} 1\n'
+              "t_h2_sum 1\nt_h2_count 1\n")
+    assert any('le="+Inf"' in e for e in metrics.lint_exposition(no_inf))
+    assert any("unparseable" in e
+               for e in metrics.lint_exposition("not a sample line\n"))
+
+
+def test_scrape_stays_parseable_under_concurrent_mutation():
+    """Regression gate for the exposition's consistency: scrape in a
+    loop while another thread mutates the registry (new counters, new
+    label children, histogram observes) — every intermediate scrape,
+    plain AND rank-aggregated, must lint clean."""
+    import threading
+
+    metrics.enable()
+    stop = threading.Event()
+
+    def mutate():
+        i = 0
+        c = metrics.registry.counter("t_mut_total", "m", ("w",))
+        h = metrics.registry.histogram("t_mut_lat", "m")
+        while not stop.is_set():
+            c.labels(str(i % 7)).inc()
+            h.observe((i % 100) / 1000.0)
+            metrics.registry.counter(f"t_mut_{i % 13}_total", "m").inc()
+            i += 1
+
+    t = threading.Thread(target=mutate, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 1.5
+        n = 0
+        while time.time() < deadline:
+            text = metrics.scrape()
+            errs = metrics.lint_exposition(text)
+            assert errs == [], f"scrape #{n} unparseable: {errs[:3]}"
+            # the rank-aggregated form (rendezvous /metrics with worker
+            # pushes) must hold the same bar
+            _, body = metrics.exposition({"5": text.encode()})
+            merged_errs = metrics.lint_exposition(body.decode())
+            assert merged_errs == [], f"merged #{n}: {merged_errs[:3]}"
+            n += 1
+        assert n > 10  # the loop really exercised concurrency
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
 # ------------------------------------------------------------ elastic
 
 def test_elastic_reset_records_event(hvd8):

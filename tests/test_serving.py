@@ -278,6 +278,44 @@ def test_server_auth_health_and_metrics_mount():
         srv.shutdown()
 
 
+def test_healthz_status_body_distinguishes_idle_from_wedged():
+    """/healthz carries queue depth, in-flight count and bucket-cache
+    size (unauthenticated, probe-friendly) — the replica entrypoint
+    wires batcher.pending and engine.cached_executables through
+    health_extra (replica_set.py)."""
+    from horovod_tpu.serving.batcher import DynamicBatcher
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    import jax.numpy as jnp
+
+    engine = InferenceEngine(
+        lambda p, x: x * p, jnp.float32(2.0), buckets=(1, 4),
+        feature_shape=(3,))
+    batcher = DynamicBatcher(engine, max_batch=4, max_wait_ms=1.0,
+                             queue_limit=16).start()
+    srv = ServingServer(
+        batcher.__call__,
+        health_extra=lambda: {"buckets": list(engine.buckets),
+                              "queued": batcher.pending,
+                              "bucket_cache": engine.cached_executables})
+    port = srv.start()
+    try:
+        x = np.ones((2, 3), dtype=np.float32)
+        np.testing.assert_allclose(
+            predict_remote(f"127.0.0.1:{port}", x, 5.0), x * 2.0)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5.0) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok"
+        assert h["inflight"] == 0
+        assert h["queued"] == 0
+        assert h["buckets"] == [1, 4]
+        assert h["bucket_cache"] >= 1  # the executed bucket is cached
+    finally:
+        srv.shutdown()
+        batcher.close(drain=False)
+
+
 def test_replica_set_least_loaded_failover_and_revival():
     good = ServingServer(lambda x, t: x * 3.0)
     bad = ServingServer(lambda x, t: (_ for _ in ()).throw(
