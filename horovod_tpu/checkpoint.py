@@ -134,6 +134,47 @@ def save_model(
     _ckpt_io("checkpoint.save", _save)
 
 
+def orbax_rung(path: str, attrs: Optional[Dict[str, str]] = None):
+    """Build the orbax rung of the layered recovery ladder
+    (elastic/replication.py): a callable ``fn(state) -> bool`` that
+    restores a saved checkpoint's trees into elastic-state attributes
+    when the fresher rungs (peer replica, emergency snapshot) fall
+    through.
+
+    ``attrs`` maps state attribute name → checkpoint tree key (default
+    ``{"params": "params", "opt_state": "opt_state"}``, matching
+    :func:`save_model`); attributes the checkpoint does not carry are
+    left untouched. Attach it before ``hvd.elastic.run``::
+
+        state = hvd.elastic.TpuState(params=params, opt_state=opt_state)
+        state.orbax_restore = hvd.checkpoint.orbax_rung("/ckpt/latest")
+    """
+    mapping = dict(attrs) if attrs else {
+        "params": "params", "opt_state": "opt_state",
+    }
+
+    def _restore(state) -> bool:
+        import jax
+        import numpy as np
+
+        ckptr = _checkpointer()
+        raw = _ckpt_io(
+            "checkpoint.restore", ckptr.restore,
+            os.path.join(os.path.abspath(path), _TREE_DIR),
+        )
+        restored = False
+        for attr, key in mapping.items():
+            if key not in raw or attr not in state._known:
+                continue
+            host = jax.tree_util.tree_map(
+                lambda x: np.asarray(x), raw[key])
+            setattr(state, attr, host)
+            restored = True
+        return restored
+
+    return _restore
+
+
 def load_params(path: str):
     """Params-only restore: (params, metadata) as host arrays, no
     optimizer rebuild. The inference-side counterpart of load_model —

@@ -248,6 +248,17 @@ def init(
 
         retry.configure(st.knobs)
 
+        # async peer snapshot replication (elastic/replication.py):
+        # start the replica store + replicator thread and register with
+        # the rendezvous so ring partners can find this rank. No-op
+        # unless HOROVOD_REPLICATION=1 and the launcher published a
+        # rendezvous (single-controller worlds have no peers to hold
+        # replicas).
+        if st.knobs.replication_enabled:
+            from ..elastic import replication
+
+            replication.configure(st.knobs)
+
         if st.knobs.autotune and not st.knobs.native_eager:
             # compile-time bucket tuner for the SPMD path (single
             # controller — no cross-rank agreement needed). In native
@@ -322,6 +333,9 @@ def shutdown() -> None:
 
         metrics.on_shutdown()
         flight.on_shutdown()
+        from ..elastic import replication
+
+        replication.on_shutdown()
         st.reset()
 
 

@@ -6,5 +6,6 @@ state.py / driver.py / discovery.py here.
 """
 
 from . import preemption  # noqa: F401
+from . import replication  # noqa: F401
 from .preemption import PREEMPTED_EXIT_CODE  # noqa: F401
 from .state import ObjectState, State, TpuState, run  # noqa: F401

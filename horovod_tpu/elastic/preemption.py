@@ -25,13 +25,16 @@ wrapper call :func:`install` themselves.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import pickle
 import signal
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, Optional, Tuple
+
+from ..utils import faults
 
 LOG = logging.getLogger("horovod_tpu.elastic")
 
@@ -39,11 +42,15 @@ LOG = logging.getLogger("horovod_tpu.elastic")
 # (128+N): the elastic driver maps this to ABORTED, not FAILURE.
 PREEMPTED_EXIT_CODE = 83
 
-_EMERGENCY_FORMAT = 1
+# format 2: the snapshot pickle rides inside the envelope with an
+# embedded sha256 so a torn/corrupted file is *detected* instead of
+# silently restoring garbage; format-1 files (pre-checksum) still load.
+_EMERGENCY_FORMAT = 2
 
 
 def emergency_save(state, path: str) -> str:
-    """Serialize the state's committed snapshot to ``path`` atomically.
+    """Serialize the state's committed snapshot to ``path`` atomically
+    (tmp + rename) with an embedded checksum.
 
     The snapshot is host data by construction (ObjectState deep-copies,
     TpuState device_get's), so a plain pickle is safe inside a signal
@@ -51,10 +58,19 @@ def emergency_save(state, path: str) -> str:
     Returns the written path.
     """
     state.save()
+    saved_bytes = pickle.dumps(
+        state._saved, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha256(saved_bytes).hexdigest()
+    # digest first, corrupt second: an `emergency.payload:corrupt`
+    # rule simulates on-disk damage, which the embedded sha256 must
+    # catch on restore (utils/faults.py)
+    saved_bytes = faults.corrupt("emergency.payload", saved_bytes)
     payload = {
         "format": _EMERGENCY_FORMAT,
         "time_unix": time.time(),
-        "saved": state._saved,
+        "epoch": int(getattr(state, "_commit_count", 0)),
+        "sha256": digest,
+        "saved_pickle": saved_bytes,
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
                 exist_ok=True)
@@ -67,18 +83,39 @@ def emergency_save(state, path: str) -> str:
     return path
 
 
+def emergency_read(path: str) -> Tuple[int, dict]:
+    """Load and checksum-verify an emergency snapshot: returns
+    ``(commit_epoch, saved_dict)``. Raises ``ValueError`` on an unknown
+    format or a checksum mismatch, ``OSError``/``pickle`` errors on a
+    missing or truncated file — the recovery ladder catches all of
+    these and falls through to the next rung (elastic/replication.py).
+    """
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    fmt = payload.get("format")
+    if fmt == 1:  # pre-checksum files: no integrity to verify
+        return 0, payload["saved"]
+    if fmt != _EMERGENCY_FORMAT:
+        raise ValueError(
+            f"unknown emergency checkpoint format in {path}: {fmt!r}"
+        )
+    saved_bytes = payload["saved_pickle"]
+    digest = hashlib.sha256(saved_bytes).hexdigest()
+    if digest != payload.get("sha256"):
+        raise ValueError(
+            f"emergency checkpoint {path} failed checksum verification "
+            f"(stored {payload.get('sha256')!r}, computed {digest!r})"
+        )
+    return int(payload.get("epoch", 0)), pickle.loads(saved_bytes)
+
+
 def emergency_restore(state, path: str) -> None:
     """Load an emergency snapshot into ``state`` and restore it. The
     snapshot's keys must be attributes the state already registered —
-    restarting with a differently-shaped state is a real error."""
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    if payload.get("format") != _EMERGENCY_FORMAT:
-        raise ValueError(
-            f"unknown emergency checkpoint format in {path}: "
-            f"{payload.get('format')!r}"
-        )
-    saved = payload["saved"]
+    restarting with a differently-shaped state is a real error. Raises
+    on a corrupt/truncated file; inside the recovery ladder that raise
+    becomes a warning and a fall-through to the next rung."""
+    epoch, saved = emergency_read(path)
     unknown = [k for k in saved if k not in state._known]
     if unknown:
         raise ValueError(
@@ -87,6 +124,9 @@ def emergency_restore(state, path: str) -> None:
         )
     state._saved = saved
     state.restore()
+    if epoch:
+        state._commit_count = max(
+            int(getattr(state, "_commit_count", 0)), epoch)
 
 
 def _is_rank0() -> bool:

@@ -25,6 +25,7 @@ import jax
 
 from ..core.exceptions import HorovodInternalError, HostsUpdatedInterrupt
 from ..utils import faults
+from . import replication
 
 
 class _HostUpdateFlag:
@@ -77,6 +78,12 @@ class State:
                 step=self._commit_count,
             )
         self.save()
+        # async peer replication (elastic/replication.py): hand the
+        # committed snapshot to the background replicator. A single
+        # predicted branch when HOROVOD_REPLICATION is off; a dict-
+        # reference stash + notify when on — never a network round
+        # trip on the commit path.
+        replication.on_commit(self)
         self.check_host_updates()
 
     def check_host_updates(self) -> None:
@@ -216,6 +223,17 @@ def run(func: Callable) -> Callable:
                 state=state,
                 checkpoint_path=knobs.emergency_checkpoint or None,
             )
+        if knobs.recovery_ladder:
+            # layered recovery (elastic/replication.py): a restarted
+            # rank adopts the freshest verified committed snapshot —
+            # surviving-peer replica → emergency pickle → orbax — so a
+            # respawn resumes from the last commit instead of step 0.
+            # No-ops quietly when no source is configured/available.
+            replication.run_recovery_ladder(
+                state,
+                emergency_path=knobs.emergency_checkpoint or None,
+                orbax_restore=getattr(state, "orbax_restore", None),
+            )
         reset_limit = knobs.reset_limit
         resets = 0
         notify_needed = False
@@ -230,6 +248,9 @@ def run(func: Callable) -> Callable:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 metrics.record_elastic_event("reset")
+                # the survivor's own RAM is the top (implicit) ladder
+                # rung — record it so recovery telemetry is complete
+                metrics.record_recovery_rung("local")
                 state.restore()
                 _reinitialize()
                 notify_needed = True

@@ -52,6 +52,7 @@ class ElasticDriver:
         env: Dict[str, str],
         exec_fn: Optional[Callable] = None,
         nics: Optional[List[str]] = None,
+        rendezvous_state_dir: Optional[str] = None,
     ):
         self._host_manager = host_manager
         self._settings = settings
@@ -66,9 +67,27 @@ class ElasticDriver:
         self._exec_fn = exec_fn
 
         self._registry = WorkerStateRegistry(self._on_barrier)
-        self._rendezvous = RendezvousServer()
+        # --rendezvous-state-dir: the KV store (rendezvous state,
+        # worker registrations, replication manifests, shipped flight
+        # dumps, metrics pushes) persists to an atomic on-disk
+        # snapshot, so a crashed-and-restarted driver resumes the same
+        # job — same port, same round, same rank assignments — while
+        # workers ride their RetryPolicy through the outage
+        # (docs/recovery.md).
+        self._rendezvous = RendezvousServer(
+            state_dir=rendezvous_state_dir)
         self._rank_assignments: Dict[str, List[int]] = {}
         self._assignments: List[SlotInfo] = []
+        if self._rendezvous.restored:
+            for slot in self._rendezvous.last_assignments():
+                self._rank_assignments.setdefault(
+                    slot.hostname, []).append(slot.rank)
+            if self._rank_assignments:
+                LOG.warning(
+                    "resuming persisted rendezvous state (round %d, "
+                    "rank assignments %s)", self._rendezvous.round,
+                    self._rank_assignments,
+                )
 
         self._shutdown = threading.Event()
         self._notify_addr: Optional[str] = None

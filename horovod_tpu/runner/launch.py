@@ -138,6 +138,22 @@ def parse_args(argv: Optional[List[str]] = None):
     p.add_argument("--emergency-checkpoint", dest="emergency_checkpoint",
                    help="Rank-0 emergency snapshot path written on "
                         "preemption (SIGTERM).")
+    p.add_argument("--replication", dest="replication",
+                   action="store_const", const="1", default=None,
+                   help="Async peer snapshot replication: every "
+                        "state.commit() ships the committed snapshot "
+                        "to ring-partner ranks so a respawned worker "
+                        "restores from a surviving peer instead of "
+                        "stale disk state (docs/recovery.md).")
+    p.add_argument("--replication-partners", dest="replication_partners",
+                   type=int,
+                   help="Ring partners each rank replicates its "
+                        "snapshot to (default 1).")
+    p.add_argument("--rendezvous-state-dir", dest="rendezvous_state_dir",
+                   help="Directory for the rendezvous server's atomic "
+                        "on-disk state snapshot; a restarted driver "
+                        "pointed at the same directory resumes the "
+                        "same job on the same port (docs/recovery.md).")
     p.add_argument("--flight-recorder", dest="flight_recorder",
                    action="store_const", const="1", default=None,
                    help="Force the control-plane flight recorder on in "
@@ -250,6 +266,7 @@ def _run_elastic(args) -> int:
         command=args.command,
         env=env,
         nics=args.nics.split(",") if args.nics else None,
+        rendezvous_state_dir=args.rendezvous_state_dir or None,
     )
     return driver.run()
 

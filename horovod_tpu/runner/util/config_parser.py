@@ -40,6 +40,9 @@ ARG_TO_ENV = {
     # boolean False, so a store_false flag could never reach the env)
     "preemption": "HOROVOD_PREEMPTION",
     "emergency_checkpoint": "HOROVOD_EMERGENCY_CHECKPOINT",
+    # --replication stores the literal "1" (same reason as preemption)
+    "replication": "HOROVOD_REPLICATION",
+    "replication_partners": "HOROVOD_REPLICATION_PARTNERS",
     # --no-flight-recorder stores "0" for the same reason
     "flight_recorder": "HOROVOD_FLIGHT_RECORDER",
     "flight_dir": "HOROVOD_FLIGHT_DIR",

@@ -29,6 +29,11 @@ Actions:
 * ``flap``  — cooperative: ``inject()`` returns the action name and the
   call site implements the behavior (discovery returns an empty host
   set for one poll).
+* ``corrupt`` — deterministically XOR-flips ``nbytes`` (default 8)
+  bytes of a serialized payload at sites that route their bytes
+  through :func:`corrupt` (emergency checkpoints, snapshot replicas),
+  so checksum-verification paths are testable like every other
+  failure mode (docs/recovery.md).
 
 A rule's ``point`` matches an injection point exactly or as a
 dot-prefix (``http`` matches ``http.put``). Remaining ``key=value``
@@ -54,7 +59,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
 
@@ -64,7 +69,11 @@ from . import metrics as _metrics
 
 _enabled = False
 _rules: List["_Rule"] = []
-_lock = threading.Lock()
+# RLock, not Lock: the preemption SIGTERM handler routes the emergency
+# payload through corrupt() and may interrupt the main thread while it
+# holds this lock inside inject() — re-entry from the same thread must
+# not self-deadlock (same reasoning as PreemptionHandler._lock)
+_lock = threading.RLock()
 
 # test hook: kill-action exit (os._exit in production)
 _exit = os._exit
@@ -73,8 +82,8 @@ _sleep = time.sleep
 
 ENV_SPEC = "HOROVOD_TPU_FAULT_SPEC"
 
-_ACTIONS = ("error", "delay", "kill", "flap")
-_PARAM_KEYS = ("seed", "times", "after", "secs", "code")
+_ACTIONS = ("error", "delay", "kill", "flap", "corrupt")
+_PARAM_KEYS = ("seed", "times", "after", "secs", "code", "nbytes")
 
 
 class InjectedFault(ConnectionError):
@@ -90,7 +99,7 @@ class FaultSpecError(ValueError):
 class _Rule:
     __slots__ = (
         "point", "action", "prob", "times", "after", "secs", "code",
-        "match", "_rng", "calls", "fires", "text",
+        "nbytes", "match", "_rng", "calls", "fires", "text",
     )
 
     def __init__(self, text: str):
@@ -114,6 +123,7 @@ class _Rule:
         self.after = 0
         self.secs = 0.05
         self.code = 1
+        self.nbytes = 8
         self.match: Dict[str, str] = {}
         seed = 0
         for field in fields[2:]:
@@ -141,6 +151,8 @@ class _Rule:
                 self.secs = float(value)
             elif key == "code":
                 self.code = int(value)
+            elif key == "nbytes":
+                self.nbytes = int(value)
             elif key == "p":
                 self.prob = float(value)
             else:
@@ -214,26 +226,35 @@ def rules() -> List[str]:
         return [r.text for r in _rules]
 
 
-def inject(point: str, **ctx) -> Optional[str]:
-    """Fire any matching rules at a named injection point.
-
-    Raising actions raise (``error`` → :class:`InjectedFault`); the
-    ``kill`` action exits the process; ``delay`` sleeps inline.
-    Cooperative actions (``flap``) are returned by name for the call
-    site to implement. Returns None when nothing cooperative fired —
-    including always when injection is disabled (the fast path).
-    """
-    if not _enabled:
-        return None
+def _fired_rules(point: str, ctx: Dict[str, object]) -> List[_Rule]:
     fired: List[_Rule] = []
     with _lock:
         for rule in _rules:
             if rule.consider(point, ctx):
                 fired.append(rule)
-    # every fired rule is recorded and its non-raising action executed
-    # BEFORE any error raises: consider() already spent the rules'
-    # times/probability budget, so a raise must not swallow a
-    # co-fired delay/flap/kill or its accounting
+    return fired
+
+
+def _flip_bytes(data: bytes, rule: _Rule) -> bytes:
+    """Deterministically XOR-flip ``rule.nbytes`` bytes of ``data`` at
+    positions drawn from the rule's seeded RNG — the same fire pattern
+    every run, like every other action."""
+    if not data:
+        return data
+    buf = bytearray(data)
+    for _ in range(max(1, min(len(buf), rule.nbytes))):
+        buf[rule._rng.randrange(len(buf))] ^= 0xFF
+    return bytes(buf)
+
+
+def _run_actions(fired: List[_Rule], point: str,
+                 data: Optional[bytes] = None,
+                 ) -> "Tuple[Optional[str], Optional[bytes]]":
+    """Execute fired rules' actions. Every fired rule is recorded and
+    its non-raising action executed BEFORE any error rule raises:
+    consider() already spent the rules' times/probability budget, so a
+    raise must not swallow a co-fired delay/flap/kill/corrupt or its
+    accounting."""
     coop: Optional[str] = None
     error_rule: Optional[_Rule] = None
     for rule in fired:
@@ -242,6 +263,14 @@ def inject(point: str, **ctx) -> Optional[str]:
             _sleep(rule.secs)
         elif rule.action == "error":
             error_rule = error_rule or rule
+        elif rule.action == "corrupt":
+            if data is not None:
+                data = _flip_bytes(data, rule)
+            else:
+                # an inject()-only site has no payload to damage; hand
+                # the action name to the caller like any cooperative
+                # action so spec typos surface instead of vanishing
+                coop = rule.action
         elif rule.action != "kill":
             coop = rule.action
     for rule in fired:
@@ -252,7 +281,38 @@ def inject(point: str, **ctx) -> Optional[str]:
             f"injected fault at {point}"
             + (f" [{error_rule.text}]" if error_rule.text else "")
         )
+    return coop, data
+
+
+def inject(point: str, **ctx) -> Optional[str]:
+    """Fire any matching rules at a named injection point.
+
+    Raising actions raise (``error`` → :class:`InjectedFault`); the
+    ``kill`` action exits the process; ``delay`` sleeps inline.
+    Cooperative actions (``flap``, payload-less ``corrupt``) are
+    returned by name for the call site to implement. Returns None when
+    nothing cooperative fired — including always when injection is
+    disabled (the fast path).
+    """
+    if not _enabled:
+        return None
+    coop, _ = _run_actions(_fired_rules(point, ctx), point)
     return coop
+
+
+def corrupt(point: str, data: bytes, **ctx) -> bytes:
+    """Pass a serialized payload through the corruption gate at a named
+    point (checkpoint/replica payloads: ``emergency.payload``,
+    ``replication.payload``). A matching ``corrupt`` rule
+    deterministically flips ``nbytes`` (default 8) bytes; co-fired
+    error/delay/kill rules behave exactly as in :func:`inject`. Returns
+    ``data`` unchanged when injection is disabled (the fast path) or no
+    rule fires — integrity-verification paths are testable like every
+    other failure mode."""
+    if not _enabled:
+        return data
+    _, out = _run_actions(_fired_rules(point, ctx), point, data)
+    return out if out is not None else data
 
 
 # Worker processes are spawned by the launcher with the spec in their

@@ -184,24 +184,56 @@ def _clock_probe() -> dict:
         return {}
 
 
+_push_policy = None
+_push_outage = None
+
+
+def _push_degradation():
+    """Lazy bounded policy + outage tracker for dump shipping. The
+    policy is built with ``record_metrics=False``: pushes run from
+    abort paths and signal handlers, where the shared RetryPolicy's
+    metrics recording (registry locks) must not be touched. The outage
+    tracker turns a rendezvous outage into ONE warning, not one per
+    dump attempt."""
+    global _push_policy, _push_outage
+    if _push_policy is None:
+        import logging
+
+        from . import retry as _retry
+
+        _push_policy = _retry.RetryPolicy(
+            max_attempts=2, base_delay_s=0.1, max_delay_s=0.25,
+            record_metrics=False)
+        _push_outage = _retry.Outage(
+            logging.getLogger("horovod_tpu.flight"),
+            "flight-dump push to the rendezvous store")
+    return _push_policy, _push_outage
+
+
 def _push(payload: bytes) -> bool:
-    """Ship a dump to ``PUT /flight/<rank>`` on the sink. Raw urllib
-    with a short timeout and NO retry policy: this runs from abort
-    paths and signal handlers, where the shared RetryPolicy's metrics
-    recording (registry locks) must not be touched and a dead driver
-    must cost at most the timeout."""
+    """Ship a dump to ``PUT /flight/<rank>`` on the sink, under a
+    bounded metrics-free RetryPolicy (one quick retry) so a dead
+    driver costs at most two short timeouts, with log-spam suppression
+    across dumps (one warning per outage)."""
     if _sink is None:
         return False
     addr, port = _sink
-    try:
+    policy, outage = _push_degradation()
+
+    def _do() -> None:
         req = urllib.request.Request(
             f"http://{addr}:{port}/{FLIGHT_SCOPE}/{_rank}",
             data=payload, method="PUT",
         )
         with urllib.request.urlopen(req, timeout=2.0):
             pass
+
+    try:
+        policy.call(_do, point="flight.push")
+        outage.success()
         return True
-    except Exception:
+    except Exception as e:
+        outage.failure(e)
         return False
 
 
@@ -534,6 +566,8 @@ def reset() -> None:
     """Test hook: clear events/counters and return to the disabled,
     unconfigured state."""
     global _configured, _dump_count, _rank, _sink, _dir, _seq
+    global _push_policy, _push_outage
+    _push_policy = _push_outage = None
     disable()
     _configured = False
     _events.clear()

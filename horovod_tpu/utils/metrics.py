@@ -867,6 +867,45 @@ def record_stall_abort() -> None:
         "Collectives aborted by the stall watchdog").inc()
 
 
+def record_recovery_rung(rung: str) -> None:
+    """One state recovery resolved by the layered recovery ladder
+    (elastic/replication.py), labeled by the rung that supplied the
+    restored snapshot: peer / emergency / orbax / local / none."""
+    _flight.record("recovery", rung)
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_recovery_rung_total",
+        "State recoveries, by ladder rung (peer/emergency/orbax/"
+        "local/none)", ("rung",),
+    ).labels(rung).inc()
+    step_stats.add_elastic_event(f"recovery:{rung}")
+
+
+def record_replication(nbytes: int, n_partners: int) -> None:
+    """One committed snapshot shipped to ring partners by the async
+    replicator (elastic/replication.py)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_replication_snapshots_total",
+        "Committed snapshots replicated to ring partners").inc()
+    registry.counter(
+        "hvd_replication_bytes_total",
+        "Snapshot payload bytes shipped to ring partners",
+    ).inc(nbytes * max(n_partners, 1))
+
+
+def record_replication_error() -> None:
+    """A snapshot replication attempt that could not reach any ring
+    partner (best-effort: training continues)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_replication_errors_total",
+        "Snapshot replications that reached no ring partner").inc()
+
+
 def record_elastic_event(kind: str) -> None:
     """An elastic lifecycle transition (reset, hosts-updated, round,
     blacklist, ...)."""
@@ -1130,22 +1169,51 @@ def stop_http_server() -> None:
 
 _push_thread: Optional[threading.Thread] = None
 _push_stop: Optional[threading.Event] = None
+_push_policy = None
+_push_outage = None
+
+
+def _push_degradation():
+    """Lazy (import-cycle-safe) bounded policy + outage tracker for the
+    push loop: a rendezvous outage costs one quick in-interval retry
+    and ONE warning, not a warning per interval — the next interval's
+    push is the real retry ladder (docs/recovery.md)."""
+    global _push_policy, _push_outage
+    if _push_policy is None:
+        import logging
+
+        from . import retry as _retry
+
+        _push_policy = _retry.RetryPolicy(
+            max_attempts=2, base_delay_s=0.1, max_delay_s=0.25)
+        _push_outage = _retry.Outage(
+            logging.getLogger("horovod_tpu.metrics"),
+            "metrics push to the rendezvous store")
+    return _push_policy, _push_outage
 
 
 def push_once(addr: str, port: int, rank: int) -> bool:
-    """One exposition PUT to the rendezvous store. Raw urllib with a
-    short timeout and no retry ladder: telemetry is best-effort and a
-    dead driver must never stall a worker."""
+    """One exposition PUT to the rendezvous store. Best-effort under a
+    bounded RetryPolicy with log-spam suppression: a dead driver must
+    never stall a worker, and a driver outage warns once (utils/
+    retry.Outage), not once per push interval."""
     body = scrape().encode()
-    try:
+    policy, outage = _push_degradation()
+
+    def _do() -> None:
         req = urllib.request.Request(
             f"http://{addr}:{port}/{METRICS_PUSH_SCOPE}/{rank}",
             data=body, method="PUT",
         )
         with urllib.request.urlopen(req, timeout=2.0):
             pass
+
+    try:
+        policy.call(_do, point="metrics.push")
+        outage.success()
         return True
-    except Exception:
+    except Exception as e:
+        outage.failure(e)
         return False
 
 
@@ -1239,7 +1307,8 @@ def on_shutdown() -> None:
 def reset() -> None:
     """Test hook: clear every family, provider and accumulator and
     return to the disabled state."""
-    global _configured
+    global _configured, _push_policy, _push_outage
+    _push_policy = _push_outage = None
     on_shutdown()
     disable()
     _configured = False

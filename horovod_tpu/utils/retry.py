@@ -75,6 +75,7 @@ class RetryPolicy:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         seed: Optional[int] = None,
+        record_metrics: bool = True,
     ):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
@@ -88,6 +89,11 @@ class RetryPolicy:
         self.clock = clock
         self.sleep = sleep
         self.seed = seed
+        # record_metrics=False is for callers that may run inside a
+        # signal handler (the flight recorder's dump push): the metrics
+        # registry locks must never be touched there
+        # (elastic/preemption.py explains the deadlock).
+        self.record_metrics = record_metrics
 
     def delay_for_attempt(self, attempt: int,
                           rng: Optional[random.Random] = None) -> float:
@@ -123,17 +129,67 @@ class RetryPolicy:
                     raise
                 attempt += 1
                 if attempt >= self.max_attempts or deadline.expired():
-                    _metrics.record_retry_giveup(point or "unnamed")
+                    if self.record_metrics:
+                        _metrics.record_retry_giveup(point or "unnamed")
                     raise
                 delay = self.delay_for_attempt(attempt, rng)
                 remaining = deadline.remaining()
                 if remaining != float("inf"):
                     if remaining <= 0:
-                        _metrics.record_retry_giveup(point or "unnamed")
+                        if self.record_metrics:
+                            _metrics.record_retry_giveup(point or "unnamed")
                         raise
                     delay = min(delay, remaining)
-                _metrics.record_retry(point or "unnamed")
+                if self.record_metrics:
+                    _metrics.record_retry(point or "unnamed")
                 self.sleep(delay)
+
+
+class Outage:
+    """Log-spam suppressor for best-effort periodic loops (metrics
+    push, flight-dump shipping): a rendezvous outage produces ONE
+    warning when it starts and one recovery line when it ends, not one
+    warning per interval. Thread-safe; the boolean flip is the only
+    state, so it is also safe to call from signal-handler contexts
+    (logging's own handler lock is the caller's concern — the
+    preemption handler already accepts that trade, elastic/
+    preemption.py)."""
+
+    def __init__(self, logger, what: str):
+        self._logger = logger
+        self._what = what
+        self._down = False
+        self._failures = 0
+
+    @property
+    def down(self) -> bool:
+        return self._down
+
+    @property
+    def failures(self) -> int:
+        return self._failures
+
+    def failure(self, err: object = None) -> bool:
+        """Record one failed attempt. Returns True (and warns) only on
+        the first failure of an outage."""
+        self._failures += 1
+        if self._down:
+            return False
+        self._down = True
+        self._logger.warning(
+            "%s failing (%s); suppressing further warnings until it "
+            "recovers", self._what, err,
+        )
+        return True
+
+    def success(self) -> bool:
+        """Record one successful attempt; logs the recovery if an
+        outage was in progress. Returns True on that transition."""
+        if not self._down:
+            return False
+        self._down = False
+        self._logger.info("%s recovered", self._what)
+        return True
 
 
 # ---------------------------------------------------------------------------

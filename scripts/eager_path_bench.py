@@ -329,6 +329,124 @@ def main(argv=None):
     breakdown = {k: round(v / args.steps * 1e3, 2)
                  for k, v in phases.items()}
 
+    # ---- replication overhead A/B (docs/recovery.md acceptance
+    # gate): the same steady eager step plus a state.commit() per
+    # step, with async peer snapshot replication on vs off. The
+    # commit hook's critical-path cost is a dict-reference stash + a
+    # condition notify (pickling/chunking/shipping run on the
+    # replicator thread, coalescing to the newest snapshot when it
+    # falls behind), so "on" must sit within 3% of "off";
+    # HOROVOD_REPLICATION=0 additionally takes the single-branch
+    # no-op path (asserted by tests/test_recovery.py).
+    replication_block = None
+    try:
+        import json as _json
+        import subprocess
+        import textwrap
+
+        from horovod_tpu.elastic import replication as _rep
+        from horovod_tpu.elastic.state import TpuState
+        from horovod_tpu.runner.http.http_server import (
+            KVStoreServer as _KV,
+        )
+
+        _rkv = _KV()
+        _rkv_port = _rkv.start_server()
+        # the ring partner's replica store lives in its own PROCESS,
+        # as in production (another rank on another host) — an
+        # in-process server would bill the partner's receive CPU to
+        # this trainer and fake replication overhead
+        _repo_dir = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        _partner_proc = subprocess.Popen(
+            [sys.executable, "-c", textwrap.dedent("""
+                import sys, time
+                sys.path.insert(0, sys.argv[1])
+                from horovod_tpu.runner.http.http_server import (
+                    KVStoreServer)
+                kv = KVStoreServer()
+                print(kv.start_server(), flush=True)
+                time.sleep(3600)
+            """), _repo_dir],
+            stdout=subprocess.PIPE, text=True)
+        _partner_port = int(_partner_proc.stdout.readline())
+        _rep._http_put(
+            "127.0.0.1", _rkv_port, _rep.STORE_SCOPE, "rank_1",
+            _json.dumps([("127.0.0.1", _partner_port)]).encode())
+        _rstate = TpuState(params=params)
+
+        rep_stats = {}
+        rep_on_wall = [0.0]
+
+        def _steady_commit(arm_on):
+            t_arm0 = time.perf_counter()
+            if arm_on:
+                _rep.configure(
+                    enabled_override=True, rank=0, size=2, partners=1,
+                    rendezvous_addr="127.0.0.1",
+                    rendezvous_port=_rkv_port)
+            else:
+                _rep.stop()
+            p, s = params, opt.init(params)
+            for _ in range(max(args.warmup, 6)):
+                p, s, l = eager_step(p, s)
+                _rstate.params = p
+                _rstate.commit()
+            float(l)
+            # per-step MEDIAN, not window mean: the duty-cycled
+            # replicator touches at most ~d of wall time, so the
+            # steady-state step reading must not be dominated by the
+            # one step a ship (or a scheduler hiccup) lands on
+            times = []
+            for _ in range(args.steps):
+                t0 = time.perf_counter()
+                p, s, l = eager_step(p, s)
+                _rstate.params = p
+                _rstate.commit()
+                float(l)
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            dt = times[len(times) // 2]
+            if arm_on:  # accumulate across on-arm passes (each pass
+                # reconfigures and gets a fresh replicator)
+                for k, v in _rep.replicator().stats.items():
+                    rep_stats[k] = (
+                        v if k == "last_epoch"
+                        else rep_stats.get(k, 0) + v)
+                rep_on_wall[0] += time.perf_counter() - t_arm0
+            return dt
+
+        # interleave arms, min of per-pass medians (the flight-
+        # recorder A/B's noise discipline), and report the off-arm
+        # pass-to-pass spread as the harness noise floor: on a busy
+        # 2-core host A/A spread runs ~10%, far above the 3% gate, so
+        # the wall number must be read against noise_frac while the
+        # structural bound (replicator busy_s vs wall, capped by the
+        # duty cycle) is exact
+        ons, offs = [], []
+        for _ in range(3):
+            ons.append(_steady_commit(True))
+            offs.append(_steady_commit(False))
+        rep_on_s, rep_off_s = min(ons), min(offs)
+        _rep.reset()
+        _partner_proc.terminate()
+        _rkv.shutdown_server()
+        replication_block = {
+            "commit_step_ms_on": round(rep_on_s * 1e3, 3),
+            "commit_step_ms_off": round(rep_off_s * 1e3, 3),
+            "overhead_frac": round(rep_on_s / rep_off_s - 1.0, 4),
+            "noise_frac": round(max(offs) / min(offs) - 1.0, 4),
+            "replicator_busy_frac": round(
+                rep_stats.get("busy_s", 0.0)
+                / max(rep_on_wall[0], 1e-9), 4),
+            "replicator": {
+                k: (round(v, 3) if k == "busy_s" else int(v))
+                for k, v in rep_stats.items()
+            },
+        }
+    except Exception as e:  # bench must survive a broken loopback env
+        replication_block = {"error": repr(e)}
+
     fp1 = fp_snap()
     fast_path = None
     if fp1:
@@ -372,6 +490,7 @@ def main(argv=None):
         "cache_hits": int(rt.cache_hits()) if rt is not None else None,
         "fast_path": fast_path,
         "flight_recorder": flight_block,
+        "replication": replication_block,
         "runtime_roundtrip_ms": round(rtt_s * 1e3, 2),
         "phase_breakdown_ms": breakdown,
     }

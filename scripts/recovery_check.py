@@ -1,0 +1,419 @@
+#!/usr/bin/env python
+"""Layered-recovery smoke gate: world-2 loopback kill-and-recover-from-peer.
+
+Sits next to ``chaos_check`` / ``flight_check`` / ``eager_fastpath_check``
+in the repo's check scripts (docs/recovery.md). Scenario:
+
+* a KV/rendezvous server runs in the parent (the "driver") — it holds
+  the replica-store registrations and replication manifests;
+* two workers train a deterministic toy model with
+  ``HOROVOD_REPLICATION=1``: every ``state.commit()`` ships the
+  committed snapshot to the ring partner's in-memory replica store,
+  and every commit appends ``epoch digest loss`` to a log;
+* rank 1 is killed mid-training by a ``worker:kill`` fault rule; the
+  parent respawns it (``RECOVERY_RESUME=1``) and the replacement must
+  restore through the recovery ladder from **rank 0's surviving
+  replica** — rung ``peer``, zero orbax/emergency reads, restored
+  params bitwise-equal to the committed snapshot in the log;
+* with ``--corrupt-rounds``, the killed incarnation's replicas are
+  byte-flipped (``replication.payload:corrupt``), so the replacement's
+  checksum verification must reject the peer rung and fall through to
+  the emergency snapshot — and still converge;
+* with ``--http-chaos``, every worker KV heartbeat runs under injected
+  HTTP error rates the shared RetryPolicy must absorb with zero
+  give-ups.
+
+Exits 0 with a JSON summary on success, 1 with the failed assertions
+otherwise.
+
+Usage:
+    python scripts/recovery_check.py [--check] [--rounds N]
+        [--corrupt-rounds 2,3] [--http-chaos] [--verbose]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+STEPS_PER_ROUND = 4
+HTTP_CHAOS_SPEC = "http.put:error:0.15:seed=5;http.get:error:0.1:seed=6"
+
+_WORKER_SRC = textwrap.dedent('''
+    import hashlib, json, os, sys, time
+
+    import numpy as np
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from horovod_tpu.elastic import preemption, replication
+    from horovod_tpu.elastic.state import ObjectState
+    from horovod_tpu.runner.http import http_client
+    from horovod_tpu.utils import metrics
+
+    metrics.enable()
+    rank = int(os.environ["HOROVOD_RANK"])
+    workdir = os.environ["RECOVERY_DIR"]
+    total = int(os.environ["RECOVERY_TOTAL_STEPS"])
+    resume = os.environ.get("RECOVERY_RESUME") == "1"
+    emergency = os.environ.get("RECOVERY_EMERGENCY") or None
+    addr = os.environ["HVD_TPU_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HVD_TPU_RENDEZVOUS_PORT"])
+    incarnation = os.environ.get("RECOVERY_INCARNATION", "0")
+
+    replication.configure()  # HOROVOD_REPLICATION / rank / size from env
+
+    # startup barrier: wait until BOTH ranks' replica stores are
+    # registered before committing — otherwise a fast-importing rank
+    # can reach its kill commit while the peer is still importing jax,
+    # and the early snapshots have no store to land in
+    for peer in range(2):
+        http_client.wait_for_key(
+            addr, port, replication.STORE_SCOPE, f"rank_{peer}",
+            timeout_s=90.0)
+
+    TARGET = np.linspace(1.0, 2.0, 8)
+
+    def digest(p):
+        return hashlib.sha256(
+            np.ascontiguousarray(p).tobytes()).hexdigest()[:16]
+
+    def loss_of(p):
+        return float(np.mean((p - TARGET) ** 2))
+
+    state = ObjectState(params=np.zeros(8, dtype=np.float64), step=0)
+    rung = None
+    if resume:
+        rung = replication.run_recovery_ladder(
+            state, emergency_path=emergency)
+        out = {"rung": rung, "epoch": int(state._commit_count),
+               "step": int(state.step),
+               "digest": digest(state.params),
+               "loss": loss_of(state.params)}
+        with open(os.path.join(
+                workdir, f"resume_r{rank}_{incarnation}.json"), "w") as f:
+            json.dump(out, f)
+
+    log = open(os.path.join(workdir, f"commits_r{rank}.log"), "a")
+    for step in range(int(state.step), total):
+        # the "training step": deterministic gradient descent on a
+        # quadratic, so every incarnation replays identical math and
+        # snapshot digests are comparable bitwise
+        g = 2.0 * (state.params - TARGET) / 8.0
+        state.params = state.params - 0.5 * g
+        state.step = step + 1
+        state.commit()  # kill rules fire here; replication ships async
+        log.write(f"{state._commit_count} {digest(state.params)} "
+                  f"{loss_of(state.params):.10f}\\n")
+        log.flush()
+        if emergency:
+            preemption.emergency_save(state, emergency)
+        # heartbeat + readback through the retried control-plane client
+        # (the --http-chaos target: injected put AND get errors must be
+        # absorbed)
+        http_client.put(addr, port, "heartbeat", f"r{rank}",
+                        str(step).encode())
+        assert http_client.get(
+            addr, port, "heartbeat", f"r{rank}") == str(step).encode()
+        # drain the replicator each commit so the epoch available to
+        # the NEXT recovery is deterministic (a kill landing mid-ship
+        # would legitimately fall through — fine in production, noise
+        # in a gate that asserts the exact rung)
+        rep = replication.replicator()
+        if rep is not None:
+            rep.drain(5.0)
+    rep = replication.replicator()
+    if rep is not None:
+        rep.drain(5.0)
+    snap = metrics.registry.snapshot()
+    out = {
+        "rank": rank,
+        "rung": rung,
+        "final_loss": loss_of(state.params),
+        "final_digest": digest(state.params),
+        "epoch": int(state._commit_count),
+        "replication": dict(rep.stats) if rep is not None else None,
+        "recovery_rungs": snap.get("hvd_recovery_rung_total", {}),
+        "retries": snap.get("hvd_retries_total", {}),
+        "giveups": snap.get("hvd_retry_giveups_total", {}),
+        "faults": snap.get("hvd_faults_injected_total", {}),
+    }
+    path = os.path.join(workdir, f"done_r{rank}_{incarnation}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(out, f)
+    os.replace(path + ".tmp", path)
+    if rank == 0:
+        # hold the replica store open: replacements restore from THIS
+        # process's host memory until the parent releases us
+        deadline = time.time() + 180.0
+        release = os.path.join(workdir, "release")
+        while not os.path.exists(release) and time.time() < deadline:
+            time.sleep(0.05)
+    replication.stop()
+    print(f"recovery worker rank {rank} inc {incarnation}: completed",
+          flush=True)
+''')
+
+
+def _spawn(worker_path, env, verbose):
+    return subprocess.Popen(
+        [sys.executable, worker_path],
+        env=env,
+        stdout=None if verbose else subprocess.DEVNULL,
+        stderr=None if verbose else subprocess.DEVNULL,
+    )
+
+
+def _wait(proc, timeout_s, failures, what):
+    try:
+        return proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        failures.append(f"{what} did not exit within {timeout_s}s")
+        return None
+
+
+def run_scenario(rounds=1, corrupt_rounds=(), http_chaos=False,
+                 verbose=False):
+    """Run the kill-and-recover scenario; returns (failures, summary)."""
+    from horovod_tpu.runner.http.http_server import KVStoreServer
+
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="hvd_recovery_")
+    worker_path = os.path.join(workdir, "recovery_worker.py")
+    with open(worker_path, "w") as f:
+        f.write(_WORKER_SRC)
+
+    kv = KVStoreServer()
+    port = kv.start_server()
+
+    total = STEPS_PER_ROUND * (rounds + 1)
+    kill_steps = [3 + STEPS_PER_ROUND * r for r in range(rounds)]
+    emergency = os.path.join(workdir, "emergency_r1.pkl")
+
+    def base_env(rank):
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env.update({
+            "PYTHONPATH": _REPO,
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": "2",
+            "HVD_TPU_RENDEZVOUS_ADDR": "127.0.0.1",
+            "HVD_TPU_RENDEZVOUS_PORT": str(port),
+            "HOROVOD_REPLICATION": "1",
+            # full duty: the gate drains the replicator each commit to
+            # make the recoverable epoch deterministic; the production
+            # duty-cycle gap would only slow that loop down
+            "HOROVOD_REPLICATION_DUTY_CYCLE": "1",
+            "RECOVERY_DIR": workdir,
+            "RECOVERY_TOTAL_STEPS": str(total),
+            "HOROVOD_RETRY_BASE_DELAY": "0.02",
+            "HOROVOD_RETRY_MAX_DELAY": "0.2",
+        })
+        env.pop("HOROVOD_TPU_FAULT_SPEC", None)
+        if rank == 1:
+            env["RECOVERY_EMERGENCY"] = emergency
+        if http_chaos:
+            env["HOROVOD_TPU_FAULT_SPEC"] = HTTP_CHAOS_SPEC
+        return env
+
+    def rank1_spec(next_round):
+        """Fault spec for the rank-1 incarnation that will die in
+        ``next_round`` (1-based); None past the last kill."""
+        if next_round > rounds:
+            return None
+        parts = [f"worker:kill:rank=1:step={kill_steps[next_round - 1]}"]
+        if next_round in corrupt_rounds:
+            parts.append("replication.payload:corrupt:seed=9")
+        if http_chaos:
+            parts.append(HTTP_CHAOS_SPEC)
+        return ";".join(parts)
+
+    procs = []
+    summary = {"rounds": [], "workdir": workdir}
+    try:
+        p0 = _spawn(worker_path, base_env(0), verbose)
+        procs.append(p0)
+        env1 = base_env(1)
+        spec = rank1_spec(1)
+        if spec:
+            env1["HOROVOD_TPU_FAULT_SPEC"] = spec
+        env1["RECOVERY_INCARNATION"] = "0"
+        p1 = _spawn(worker_path, env1, verbose)
+        procs.append(p1)
+
+        for r in range(1, rounds + 1):
+            code = _wait(p1, 120.0, failures, f"round-{r} victim")
+            if code is None:
+                return failures, summary
+            if code == 0:
+                failures.append(
+                    f"round {r}: rank 1 exited cleanly instead of being "
+                    f"killed at commit {kill_steps[r - 1]}")
+                return failures, summary
+            env1 = base_env(1)
+            spec = rank1_spec(r + 1)
+            if spec:
+                env1["HOROVOD_TPU_FAULT_SPEC"] = spec
+            env1["RECOVERY_RESUME"] = "1"
+            env1["RECOVERY_INCARNATION"] = str(r)
+            p1 = _spawn(worker_path, env1, verbose)
+            procs.append(p1)
+
+        code = _wait(p1, 120.0, failures, "final rank-1 incarnation")
+        if code not in (0, None):
+            failures.append(f"final rank-1 incarnation exited {code}")
+        with open(os.path.join(workdir, "release"), "w") as f:
+            f.write("x")
+        _wait(p0, 60.0, failures, "rank 0")
+
+        # ----------------------------------------------------- assertions
+        commits = {}
+        commits_log = os.path.join(workdir, "commits_r1.log")
+        if os.path.exists(commits_log):
+            with open(commits_log) as f:
+                for line in f:
+                    epoch, dig, loss = line.split()
+                    commits[int(epoch)] = (dig, float(loss))
+        if not commits:
+            failures.append("rank 1 never logged a commit")
+
+        for r in range(1, rounds + 1):
+            expect_rung = (
+                "emergency" if r in corrupt_rounds else "peer")
+            path = os.path.join(workdir, f"resume_r1_{r}.json")
+            if not os.path.exists(path):
+                failures.append(f"round {r}: no resume record")
+                continue
+            with open(path) as f:
+                resume = json.load(f)
+            round_info = {"round": r, **resume,
+                          "expected_rung": expect_rung}
+            summary["rounds"].append(round_info)
+            if resume["rung"] != expect_rung:
+                failures.append(
+                    f"round {r}: recovered via rung {resume['rung']!r}, "
+                    f"wanted {expect_rung!r}")
+            want_epoch = kill_steps[r - 1] - 1
+            if resume["epoch"] != want_epoch:
+                failures.append(
+                    f"round {r}: restored epoch {resume['epoch']} != "
+                    f"last committed {want_epoch}")
+            elif commits.get(want_epoch, (None,))[0] != resume["digest"]:
+                failures.append(
+                    f"round {r}: restored params digest "
+                    f"{resume['digest']} != committed snapshot digest "
+                    f"{commits.get(want_epoch)}")
+
+        done_path = os.path.join(workdir, f"done_r1_{rounds}.json")
+        done = {}
+        if os.path.exists(done_path):
+            with open(done_path) as f:
+                done = json.load(f)
+        else:
+            failures.append("final rank-1 incarnation left no report")
+        # chaos/retry accounting aggregates over every surviving
+        # report (rank 0 runs the whole job under the same spec)
+        agg = {"retries": 0, "giveups": 0, "http_faults": 0}
+        for name in os.listdir(workdir):
+            if not name.startswith("done_r"):
+                continue
+            with open(os.path.join(workdir, name)) as f:
+                rep = json.load(f)
+            agg["retries"] += sum(rep.get("retries", {}).values())
+            agg["giveups"] += sum(rep.get("giveups", {}).values())
+            agg["http_faults"] += sum(
+                v for k, v in rep.get("faults", {}).items()
+                if k.startswith("http."))
+        if done:
+            rungs = done.get("recovery_rungs", {})
+            # zero orbax (and, on clean rounds, zero emergency) reads:
+            # the ladder stopped at the rung the scenario dictates
+            if rungs.get("orbax"):
+                failures.append(f"orbax rung was used: {rungs}")
+            if not corrupt_rounds and rungs.get("emergency"):
+                failures.append(
+                    f"emergency rung used in a clean run: {rungs}")
+            if agg["giveups"]:
+                failures.append(
+                    f"{agg['giveups']} retry give-ups (wanted 0)")
+            first_loss = commits.get(1, (None, None))[1]
+            final_loss = done.get("final_loss")
+            if (first_loss is not None and final_loss is not None
+                    and not final_loss < first_loss * 0.5):
+                failures.append(
+                    f"no convergence: final loss {final_loss} vs first "
+                    f"{first_loss}")
+            summary["final_loss"] = final_loss
+            summary["first_loss"] = first_loss
+            summary.update(agg)
+            summary["recovery_rungs"] = done.get("recovery_rungs", {})
+            if http_chaos:
+                if not agg["http_faults"]:
+                    failures.append("HTTP chaos rules never fired")
+                if not agg["retries"]:
+                    failures.append(
+                        "injected HTTP errors produced zero retries")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        kv.shutdown_server()
+    return failures, summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="run the smoke gate (default behavior)")
+    ap.add_argument("--rounds", type=int, default=1,
+                    help="consecutive kill-and-recover rounds")
+    ap.add_argument("--corrupt-rounds", default="",
+                    help="comma-separated 1-based rounds whose replicas "
+                         "are corrupt-faulted (recovery must fall "
+                         "through to the emergency snapshot)")
+    ap.add_argument("--http-chaos", action="store_true",
+                    help="inject HTTP error rates under every worker "
+                         "KV heartbeat")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    corrupt = tuple(
+        int(x) for x in args.corrupt_rounds.split(",") if x.strip())
+
+    t0 = time.perf_counter()
+    failures, summary = run_scenario(
+        rounds=args.rounds, corrupt_rounds=corrupt,
+        http_chaos=args.http_chaos, verbose=args.verbose,
+    )
+    summary.update({
+        "what": "layered-recovery smoke gate (loopback world-2)",
+        "rounds_requested": args.rounds,
+        "corrupt_rounds": list(corrupt),
+        "http_chaos": args.http_chaos,
+        "wall_s": round(time.perf_counter() - t0, 1),
+        "ok": not failures,
+    })
+    print(json.dumps(summary, indent=1))
+    # single-line machine-readable twin for wrappers (tests/test_recovery)
+    print("RECOVERY_SUMMARY_JSON:", json.dumps(summary))
+    for f in failures:
+        print("FAIL:", f)
+    if failures:
+        return 1
+    print("recovery check OK: killed rank restored from the surviving "
+          "peer's replica" + (" (+ corrupt fall-through)" if corrupt
+                              else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

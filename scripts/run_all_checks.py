@@ -16,7 +16,10 @@ locally before the full pytest tier:
   ``scripts/serving_loadgen.py --check`` (traffic succeeds, batching
   metrics live);
 * ``flight`` — ``scripts/flight_check.py`` (world-2 stall autopsy:
-  straggler named, dumps aggregated, rank-labeled /metrics).
+  straggler named, dumps aggregated, rank-labeled /metrics);
+* ``recovery`` — ``scripts/recovery_check.py`` (world-2 loopback
+  kill-and-recover: the respawned rank restores from the surviving
+  peer's replica through the recovery ladder).
 
 Usage:
     python scripts/run_all_checks.py [--only NAME ...] [--skip NAME ...]
@@ -137,12 +140,20 @@ def check_flight():
                  "--check"])
 
 
+def check_recovery():
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "recovery_check.py"),
+        "--check",
+    ])
+
+
 GATES = [
     ("metrics", check_metrics),
     ("chaos", check_chaos),
     ("eager_fastpath", check_eager_fastpath),
     ("serving", check_serving),
     ("flight", check_flight),
+    ("recovery", check_recovery),
 ]
 
 

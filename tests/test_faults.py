@@ -592,9 +592,8 @@ def test_emergency_save_is_atomic(tmp_path):
     state = ObjectState(step=5)
     path = str(tmp_path / "nested" / "e.pkl")
     preemption.emergency_save(state, path)
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    assert payload["saved"]["step"] == 5
+    epoch, saved = preemption.emergency_read(path)
+    assert saved["step"] == 5
     assert not [p for p in os.listdir(tmp_path / "nested")
                 if ".tmp." in p], "tmp file must be renamed away"
 
