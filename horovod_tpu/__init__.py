@@ -103,7 +103,9 @@ from .optim import (  # noqa: F401
     Compression,
     DistributedGradientTape,
     DistributedOptimizer,
+    Int8BlockCompressor,
     ShardedOptimizer,
+    error_feedback_specs,
     allgather_object,
     broadcast_object,
     broadcast_optimizer_state,

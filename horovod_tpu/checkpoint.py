@@ -51,6 +51,8 @@ _COMPRESSION_NAMES = {
     Compression.none: "none",
     Compression.fp16: "fp16",
     Compression.bf16: "bf16",
+    Compression.int8: "int8",
+    Compression.int8_raw: "int8-raw",
 }
 _COMPRESSION_BY_NAME = {v: k for k, v in _COMPRESSION_NAMES.items()}
 
@@ -100,8 +102,8 @@ def save_model(
         # numerics on reload with no error
         raise ValueError(
             "save_model can only serialize the built-in Compression "
-            "variants (none/fp16/bf16); re-wrap custom compressors "
-            "yourself after load_model"
+            "variants (none/fp16/bf16/int8/int8-raw); re-wrap custom "
+            "compressors yourself after load_model"
         )
     if op is None:
         op = ReduceOp.AVERAGE  # DistributedOptimizer's default

@@ -116,6 +116,18 @@ class Knobs:
     # fp16 ("compression") on the wire: reference torch/compression.py:20.
     # On TPU the native wire type is bfloat16.
     compression_wire_dtype: str = ""  # "", "bfloat16", "float16"
+    # Compressed collective data plane (optim/compression.py,
+    # docs/compression.md): "none" (bitwise-identical to the
+    # uncompressed plane), "fp16"/"bf16" (cast-on-the-wire), "int8"
+    # (block-quantized EQuARX-style quantize→reduce→requantize with
+    # error feedback), "int8-raw" (int8 without error feedback — A/B
+    # and debugging only). Reaches the gradient reduction paths
+    # (optim/distributed.py, optim/zero.py), the hierarchical DCN
+    # outer leg (ops/hierarchical.py), and the eager executors
+    # (ops/eager_runtime.py).
+    compression: str = "none"
+    # per-block quantization granularity (elements per int8 scale)
+    compression_block: int = 256
 
     # --- hierarchy (operations.cc:551-565) ---
     # On TPU: "hierarchical" = reduce-scatter over ICI within a slice, then
@@ -263,6 +275,8 @@ class Knobs:
                 "AUTOTUNE_STEPS_PER_SAMPLE", 10
             ),
             compression_wire_dtype=_env("COMPRESSION_WIRE_DTYPE", "") or "",
+            compression=_env("COMPRESSION", "") or "none",
+            compression_block=_env_int("COMPRESSION_BLOCK", 256),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),

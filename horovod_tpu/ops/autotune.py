@@ -51,6 +51,12 @@ class ParameterManager:
         self._bytes_in_sample = 0
         self._sample_start = time.perf_counter()
         self._pinned = False
+        # drop the first sample window after a threshold switch: the
+        # switch retraces/recompiles the step, and that one-off
+        # compile+warmup wall time would pollute the candidate's
+        # bytes/sec score (a big candidate could lose purely on its
+        # compile time)
+        self._skip_window = False
         self._log_rows: List[tuple] = []
 
     def fusion_threshold_bytes(self) -> int:
@@ -71,6 +77,16 @@ class ParameterManager:
         self._steps_in_sample += 1
         if self._steps_in_sample < self._knobs.autotune_steps_per_sample:
             return
+        if self._skip_window:
+            # first full window at a freshly-switched threshold:
+            # recompile/warmup time is in this window's wall clock, so
+            # scoring it would bias against the new candidate — reset
+            # the accumulators and score the NEXT window
+            self._skip_window = False
+            self._steps_in_sample = 0
+            self._bytes_in_sample = 0
+            self._sample_start = time.perf_counter()
+            return
         elapsed = max(time.perf_counter() - self._sample_start, 1e-9)
         score = self._bytes_in_sample / elapsed
         if self._warmup_left > 0:
@@ -86,6 +102,7 @@ class ParameterManager:
                 self._write_log()
             else:
                 self._current = self._candidates[self._idx]
+                self._skip_window = True
         self._steps_in_sample = 0
         self._bytes_in_sample = 0
         self._sample_start = time.perf_counter()
@@ -115,7 +132,11 @@ class SPMDStepTuner:
       * ``ordered_buckets`` — chained per-bucket all-reduces vs letting
         XLA's combiner merge them (docs/benchmarks.md, overlap section);
       * optionally ``hierarchical_allreduce`` × ``hierarchical_local_size``
-        — ICI-inner/DCN-outer routing (ops/hierarchical.py).
+        — ICI-inner/DCN-outer routing (ops/hierarchical.py);
+      * optionally ``compression`` — the wire dtype (none/bf16/int8,
+        docs/compression.md). Numerics-changing (int8 is lossy), so
+        ``tune_wire`` is opt-in and the build_step factory must rebuild
+        the optimizer and its state per candidate.
 
     Coordinate descent visits O(sum of dims) candidates, not the
     product — the same economy the reference's ParameterManager buys
@@ -148,6 +169,8 @@ class SPMDStepTuner:
         tune_ordered: bool = True,
         tune_hierarchical: bool = False,
         hier_blocks: Optional[List[int]] = None,
+        tune_wire: bool = False,
+        wire_candidates: Optional[List[str]] = None,
         log_path: str = "",
     ):
         if knobs is None:
@@ -167,6 +190,17 @@ class SPMDStepTuner:
         self._tune_ordered = tune_ordered
         self._tune_hier = tune_hierarchical
         self._hier_blocks = list(hier_blocks) if hier_blocks else [0]
+        # wire-dtype dimension (docs/compression.md): candidates are
+        # HOROVOD_COMPRESSION values; the winner pins knobs.compression
+        # so later compilations inherit it. OFF by default — unlike the
+        # other dimensions this one changes NUMERICS (int8 is lossy) and
+        # the build_step factory must rebuild optimizer + state per
+        # candidate (an error-feedback compressor changes the state
+        # tree). Opt in with tune_wire=True.
+        self._tune_wire = tune_wire
+        self._wire_candidates = (
+            list(wire_candidates) if wire_candidates
+            else ["none", "bf16", "int8"])
         # distinct default path from ParameterManager's (both write mode
         # "w"; sharing knobs.autotune_log would clobber whichever
         # finishes first)
@@ -215,6 +249,8 @@ class SPMDStepTuner:
                 self._knobs.hierarchical_allreduce)
             best["hierarchical_local_size"] = (
                 self._knobs.hierarchical_local_size)
+        if self._tune_wire:
+            best["compression"] = self._knobs.compression
 
         def score(ov):
             return self._time_candidate(build_step, args, {**best, **ov})
@@ -270,6 +306,20 @@ class SPMDStepTuner:
                     best_t = t
                     best["hierarchical_allreduce"] = True
                     best["hierarchical_local_size"] = blk
+            best, best_t = agree(best, best_t)
+
+        # dim 4: wire dtype (none/bf16/int8) — each candidate retraces
+        # through the factory, so _reduce_grad_tree resolves the knob
+        # and compiles the candidate's collective structure; the argmin
+        # is agreed through the same rank-0 broadcast as the others
+        if self._tune_wire:
+            for w in self._wire_candidates:
+                if w == best.get("compression"):
+                    continue  # the incumbent was already timed
+                t = score({"compression": w})
+                if t < best_t:
+                    best_t = t
+                    best["compression"] = w
             best, best_t = agree(best, best_t)
 
         self._apply(best)  # pin winners

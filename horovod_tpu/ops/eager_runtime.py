@@ -112,14 +112,22 @@ class ExecutionPlan:
     rank even when ranks enqueued in different orders; replaying them in
     plan order keeps the cross-process XLA program order consistent,
     which is the only consistency the data plane ever needed from the
-    controller."""
+    controller.
+
+    ``wire_key`` captures the compressed-wire dtype the executor held at
+    freeze time (optim/compression.py WireSpec.key, or None for the
+    uncompressed plane): the same executor serves negotiated and
+    bypassed steps, so a fast-path step is bitwise-identical to a
+    negotiated step under the same compressor — and set_wire() flushes
+    any plan frozen under a different wire."""
 
     def __init__(self, batches: List[ExecutionBatch],
-                 entries: Dict[str, _PlanEntry]):
+                 entries: Dict[str, _PlanEntry], wire_key=None):
         self.batches = batches
         self.entries = entries
         self.names = frozenset(entries)
         self.total_bytes = sum(int(b.total_bytes) for b in batches)
+        self.wire_key = wire_key
 
 
 def _is_jax_array(x) -> bool:
@@ -140,14 +148,141 @@ def _timeline():
     return active_timeline()
 
 
+_RESIDUAL_EVICTION_WARNED = [False]
+
+
+def _warn_residual_eviction_once() -> None:
+    """The executor's bounded error-feedback store cycled an entry out:
+    the evicted bucket restarts from a zero residual, degrading its
+    wire toward int8-raw (bias accumulates). One loud line beats a
+    silent numerics change."""
+    if _RESIDUAL_EVICTION_WARNED[0]:
+        return
+    _RESIDUAL_EVICTION_WARNED[0] = True
+    from ..utils.logging import get_logger
+
+    get_logger().warning(
+        "int8 error-feedback residual store exceeded its bound; "
+        "evicted buckets restart error feedback from zero (the wire "
+        "degrades toward int8-raw for them). This indicates bucket "
+        "churn — more distinct fused buckets than the store holds — "
+        "see docs/compression.md.")
+
+
+def _resolve_executor_wire(wire):
+    """Executor ctor plumbing: "auto" resolves the HOROVOD_COMPRESSION
+    knob (or raw env before hvd.init — bare EagerRuntime construction in
+    tests/check scripts); a string parses; a WireSpec/None passes
+    through."""
+    from ..optim import compression as _comp
+
+    if wire == "auto":
+        return _comp.resolve_wire()
+    if isinstance(wire, str):
+        return _comp.parse_wire(wire)
+    return wire
+
+
+def _batch_dtype_name(batch: ExecutionBatch) -> str:
+    """Numpy dtype name of a batch's payload: native batches carry a
+    numeric dtype code (DTYPE_TO_NUMPY key), python-built test batches
+    carry the name directly."""
+    return DTYPE_TO_NUMPY.get(batch.dtype, batch.dtype)
+
+
+def _batch_itemsize(batch: ExecutionBatch) -> int:
+    name = _batch_dtype_name(batch)
+    try:
+        return np.dtype(name).itemsize
+    except TypeError:
+        return 2 if name == "bfloat16" else 4
+
+
+def _wire_applies(spec, batch: ExecutionBatch) -> bool:
+    """The compressed wire covers floating SUM/AVERAGE allreduce
+    payloads; everything else moves at logical precision."""
+    if spec is None or batch.op != OP_ALLREDUCE:
+        return False
+    if batch.reduce_op not in (_REDUCE_SUM, _REDUCE_AVERAGE):
+        return False
+    name = _batch_dtype_name(batch)
+    if name == "bfloat16":
+        return True
+    try:
+        return bool(np.issubdtype(np.dtype(name), np.floating))
+    except TypeError:
+        return False
+
+
+def _record_wire_batch(spec, batch: ExecutionBatch, n_elements: int
+                       ) -> None:
+    """hvd_wire_bytes_{logical,sent}_total for one executed allreduce
+    batch — `sent` equals `logical` exactly on the uncompressed plane,
+    which is what compression_check's none-parity assertion reads."""
+    if not _metrics.enabled() or batch.op != OP_ALLREDUCE:
+        return
+    from ..optim.compression import wire_sent_bytes
+
+    itemsize = _batch_itemsize(batch)
+    logical = n_elements * itemsize
+    sent = wire_sent_bytes(
+        n_elements, itemsize, spec if _wire_applies(spec, batch) else None)
+    _metrics.record_wire_bytes(logical, sent)
+
+
 class LoopbackExecutor:
     """Executes batches with single-process semantics (every rank's
     contribution equals ours — the eager single-controller model of
-    ops/collectives.py)."""
+    ops/collectives.py).
 
-    def __init__(self, world_size: int, rank: int = 0):
+    `wire` ("auto" = the HOROVOD_COMPRESSION knob) simulates the
+    compressed data plane so world-local runs exercise — and account —
+    the same wire numerics the XLA executor produces: cast wires
+    accumulate in the cast dtype; the int8 wire applies both EQuARX
+    quantization stages (contribution and reduced shard) with
+    executor-held error-feedback residuals keyed by tensor name."""
+
+    def __init__(self, world_size: int, rank: int = 0, wire="auto"):
         self._n = world_size
         self._rank = rank
+        self.wire = _resolve_executor_wire(wire)
+        self._residuals: Dict[str, np.ndarray] = {}
+
+    def set_wire(self, wire) -> None:
+        self.wire = _resolve_executor_wire(wire)
+        self._residuals = {}
+
+    def _wire_allreduce(self, batch: ExecutionBatch, name: str, x):
+        """Wire-compressed SUM/AVERAGE of n identical contributions."""
+        from ..optim import compression as _comp
+
+        import jax.numpy as jnp
+
+        spec = self.wire
+        n = self._set_world(batch)[0]
+        scaled = np.asarray(x, dtype=np.float32) * batch.prescale
+        if spec.kind == "int8":
+            eff = scaled
+            if spec.error_feedback:
+                res = self._residuals.get(name)
+                if res is not None and res.shape == eff.shape:
+                    eff = eff + res
+            dq1 = np.asarray(_comp.quantize_dequantize(eff, spec.block))
+            if spec.error_feedback:
+                self._residuals.pop(name, None)
+                self._residuals[name] = eff - dq1
+                while len(self._residuals) > 4096:
+                    # bounded like the XLA executor's store: churn in
+                    # tensor names must not pin residuals forever
+                    self._residuals.pop(next(iter(self._residuals)))
+                    _warn_residual_eviction_once()
+            r = np.asarray(_comp.quantize_dequantize(dq1 * n, spec.block))
+        else:
+            w = jnp.asarray(scaled).astype(spec.wire_dtype)
+            r = np.asarray((w * n).astype(jnp.float32))
+        if batch.reduce_op == _REDUCE_AVERAGE:
+            r = r / n
+        return (r * batch.postscale).astype(np.asarray(x).dtype)
 
     def _set_world(self, batch: ExecutionBatch):
         """(size, local_rank) of the batch's process set — the set's
@@ -160,12 +295,20 @@ class LoopbackExecutor:
     def __call__(self, batch: ExecutionBatch, tensors: Dict[str, np.ndarray]
                  ) -> Dict[str, np.ndarray]:
         n, rank = self._set_world(batch)
+        wired = _wire_applies(self.wire, batch)
+        if batch.op == OP_ALLREDUCE:
+            _record_wire_batch(
+                self.wire, batch,
+                sum(int(np.asarray(tensors[nm]).size)
+                    for nm in batch.names if nm in tensors))
         out = {}
         for name in batch.names:
             if name not in tensors:
                 continue
             x = tensors[name]
-            if batch.op == OP_ALLREDUCE:
+            if batch.op == OP_ALLREDUCE and wired:
+                out[name] = self._wire_allreduce(batch, name, x)
+            elif batch.op == OP_ALLREDUCE:
                 scaled = x * batch.prescale
                 # n identical contributions: sum = x*n, min/max/adasum = x,
                 # product = x**n
@@ -252,6 +395,7 @@ class EagerRuntime:
         fast_path: bool = True,
         fast_path_warmup: int = 3,
         pipeline_depth: int = 2,
+        wire="auto",
     ):
         self._native = NativeRuntime()
         self._native.init(
@@ -263,7 +407,8 @@ class EagerRuntime:
             autotune_cycles_per_sample=autotune_cycles_per_sample,
             autotune_bayes=autotune_bayes,
         )
-        self._executor = executor or LoopbackExecutor(size, rank)
+        self._executor = executor or LoopbackExecutor(size, rank,
+                                                      wire=wire)
         # identity for the flight recorder's cross-rank attribution
         # (utils/flight.py): the stall-abort straggler report needs to
         # know which peers exist and who we are
@@ -606,7 +751,10 @@ class EagerRuntime:
         entries = {
             n: _PlanEntry(sig, kw) for n, (sig, kw) in window.items()
         }
-        self._fp_plan = ExecutionPlan(list(captured), entries)
+        wire = self._executor_wire()
+        self._fp_plan = ExecutionPlan(
+            list(captured), entries,
+            wire_key=wire.key if wire is not None else None)
         self._fp_activations += 1
         _flight.record("plan_activate", batches=len(captured),
                        tensors=len(entries))
@@ -832,8 +980,45 @@ class EagerRuntime:
                 self._fp_flush_locked("disabled")
             self._fp_on = bool(enabled)
 
+    def _executor_wire(self):
+        return getattr(self._executor, "wire", None)
+
+    def set_wire(self, wire) -> None:
+        """Switch the executor's wire compression live (bench A/B
+        surface; accepts a HOROVOD_COMPRESSION-style name, a WireSpec,
+        or None). Any frozen plan was captured under the old wire, so
+        the plan cache restarts — the change must land on every rank at
+        the same program point, like every topology-shaped mutation.
+
+        Refuses while collectives are outstanding: a batch negotiated
+        before the flip could otherwise execute under the old wire on
+        one rank and the new wire on another (the executor worker pops
+        batches asynchronously), silently splitting the world's
+        numerics. The gate keys on the program-order handle set
+        (_fp_outstanding), so under the SPMD contract every rank
+        accepts or refuses identically."""
+        spec = _resolve_executor_wire(wire)
+        set_fn = getattr(self._executor, "set_wire", None)
+        if set_fn is None:
+            raise HorovodInternalError(
+                "this executor does not support wire compression")
+        with self._lock:
+            # _fp_outstanding (issued native handles not yet
+            # synchronized) and _fp_step (a partial fast-path step)
+            # both mutate only in user-thread program order
+            if self._fp_outstanding or self._fp_step:
+                raise HorovodInternalError(
+                    f"set_wire with {len(self._fp_outstanding) + len(self._fp_step)} "
+                    "outstanding collective handle(s): synchronize "
+                    "every pending collective on every rank first, or "
+                    "a batch could execute under different wires on "
+                    "different ranks")
+        self._fp_barrier("wire_change")
+        set_fn(spec)
+
     def fast_path_stats(self) -> dict:
         with self._lock:
+            wire = self._executor_wire()
             return {
                 "enabled": self._fp_on,
                 "active": self._fp_plan is not None,
@@ -844,6 +1029,9 @@ class EagerRuntime:
                 "bypassed_bytes": self._fp_bypassed_bytes,
                 "last_invalidation": self._fp_last_invalidation,
                 "warmup": self._fp_warmup,
+                "wire": wire.kind if wire is not None else "none",
+                "plan_wire_key": (self._fp_plan.wire_key
+                                  if self._fp_plan is not None else None),
             }
 
     # --------------------------------------------------- process sets
@@ -1386,7 +1574,7 @@ class XlaExecutor:
     reference's fusion buffer (fusion_buffer_manager.h:30).
     """
 
-    def __init__(self, rank: int, world: int):
+    def __init__(self, rank: int, world: int, wire="auto"):
         import jax
         from jax.sharding import Mesh
 
@@ -1432,6 +1620,21 @@ class XlaExecutor:
         # there was pure per-step dispatch overhead (visible on grouped
         # batches, which stack every member tensor back to back)
         self._proc_shardings: Dict[int, object] = {}
+        # compressed data plane (optim/compression.py WireSpec): the
+        # wire dtype is part of every fused-program cache key, and the
+        # int8 error-feedback residuals live HERE, keyed per fused
+        # bucket — the eager-path mirror of the SPMD path's
+        # optimizer-state residual leaves (docs/compression.md)
+        self.wire = _resolve_executor_wire(wire)
+        self._wire_residuals: Dict[tuple, object] = {}
+
+    def set_wire(self, wire) -> None:
+        """Swap the wire spec (bench A/B; every process must switch at
+        the same point in the batch stream — the runtime's set_wire
+        flushes the plan first). Residuals from the old wire are
+        dropped: they describe the old quantization grid."""
+        self.wire = _resolve_executor_wire(wire)
+        self._wire_residuals = {}
 
     # -------------------------------------------------------- plumbing
 
@@ -1479,12 +1682,16 @@ class XlaExecutor:
         )
 
     def _program(self, key, leaf, out_spec_sharded: bool, mesh=None,
-                 arity: int = 1):
+                 arity: int = 1, out_specs=None):
         """jit(shard_map) over the proc mesh, cached by signature — the
         steady-state fast path (compilation plays the role the response
         cache plays for negotiation). With ``arity`` > 1 the program
         takes that many [world, ...] inputs and ``leaf`` sees one local
-        slice per argument (fused-batch pack/unpack runs inside)."""
+        slice per argument (fused-batch pack/unpack runs inside).
+        ``out_specs`` (a PartitionSpec pytree) overrides the
+        ``out_spec_sharded`` bool for mixed-replication outputs (the
+        int8 wire returns replicated tensors plus a sharded per-rank
+        residual)."""
         prog = self._programs.get(key)
         if prog is None:
             import jax
@@ -1494,12 +1701,14 @@ class XlaExecutor:
             def body(*stacked):
                 return leaf(*[s[0] for s in stacked])
 
+            if out_specs is None:
+                out_specs = P("proc") if out_spec_sharded else P()
             prog = jax.jit(
                 shard_map(
                     body,
                     mesh=mesh if mesh is not None else self._mesh,
                     in_specs=tuple(P("proc") for _ in range(arity)),
-                    out_specs=P("proc") if out_spec_sharded else P(),
+                    out_specs=out_specs,
                     check_vma=False,
                 )
             )
@@ -1623,6 +1832,12 @@ class XlaExecutor:
 
         mesh, n, _, tag = self._batch_ctx(batch)
         inputs = self._materialize(batch, tensors)
+        _record_wire_batch(self.wire, batch,
+                           sum(int(np.size(x)) for x in inputs))
+        wire = self.wire if _wire_applies(self.wire, batch) else None
+        if wire is not None and wire.kind == "int8":
+            return self._run_allreduce_int8(batch, tensors, inputs, mesh,
+                                            n, tag)
         # autotuned hierarchical routing, stamped on the batch by the
         # NATIVE loop at batch creation (operations.cc Batch) so every
         # rank executes the sample point of the cycle that delivered it
@@ -1647,6 +1862,14 @@ class XlaExecutor:
             leaf = self._reduce_leaf(
                 batch.reduce_op, batch.prescale, batch.postscale, n
             )
+        if wire is not None:
+            # cast wire: ONE cast per fused bucket around the reduce —
+            # the whole packed payload (prescale, psum, average divide,
+            # postscale) runs in the wire dtype and casts back
+            base_leaf, wd = leaf, wire.wire_dtype
+
+            def leaf(x, _base=base_leaf, _wd=wd):
+                return _base(x.astype(_wd)).astype(x.dtype)
         # Pack, reduce, and unpack INSIDE one program: one collective
         # HLO per fused batch (the reference memcpys into the fusion
         # buffer and issues one ncclAllReduce,
@@ -1680,7 +1903,7 @@ class XlaExecutor:
         prog = self._program(
             ("allreduce", tag, specs, str(inputs[0].dtype),
              batch.reduce_op, batch.prescale, batch.postscale,
-             hier_block),
+             hier_block, wire.key if wire is not None else None),
             fused, out_spec_sharded=False, mesh=mesh, arity=len(inputs),
         )
         res = prog(*[self._global_stack(x, mesh, n) for x in inputs])
@@ -1688,6 +1911,112 @@ class XlaExecutor:
             res = (res,)
         out = {}
         for name, r in zip(batch.names, res):
+            if name in tensors:
+                out[name] = r
+        return out
+
+    def _run_allreduce_int8(self, batch, tensors, inputs, mesh, n, tag):
+        """Fused allreduce on the int8 block-quantized wire: ONE program
+        per fused bucket packs the tensors, adds the executor-held
+        error-feedback residual, runs the quantized collective
+        (hierarchical DCN-outer-leg routing when the coordinator pinned
+        a hierarchy block, the flat EQuARX form otherwise), and slices
+        the dequantized sum back out. The residual is a per-bucket
+        device buffer keyed by the batch signature — the eager mirror of
+        the SPMD path's optimizer-state residual (docs/compression.md)."""
+        from jax import lax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..optim import compression as _comp
+        from .hierarchical import hierarchical_psum, resolve_block
+
+        spec = self.wire
+        reduce_op = batch.reduce_op
+        prescale, postscale = batch.prescale, batch.postscale
+        hier_block = 0
+        if getattr(batch, "tuned_hierarchical", False) and not tag:
+            hier_block = resolve_block(
+                n, int(getattr(batch, "tuned_hier_block", 0)))
+            if hier_block <= 1:
+                hier_block = 0
+        memo = getattr(batch, "_ar_specs", None)
+        if memo is None:
+            memo = tuple((x.size, tuple(x.shape)) for x in inputs)
+            batch._ar_specs = memo
+        specs = memo
+        total = sum(size for size, _ in specs)
+        ef = spec.error_feedback
+        rkey = (tuple(batch.names), specs, tag, spec.key, hier_block)
+
+        def fused(*vs):
+            if ef:
+                vs, res = vs[:-1], vs[-1]
+            else:
+                res = None
+            flats = [v.reshape(-1) for v in vs]
+            packed = (jnp.concatenate(flats)
+                      if len(flats) > 1 else flats[0])
+            if prescale != 1.0:
+                packed = packed * jnp.asarray(prescale, packed.dtype)
+            if hier_block:
+                out = hierarchical_psum(
+                    packed, ("proc",), {"proc": n}, hier_block,
+                    wire=spec, residual=res)
+            else:
+                out = _comp.quantized_psum(packed, "proc", n, spec.block,
+                                           residual=res)
+            y, new_res = out if ef else (out, None)
+            if reduce_op == _REDUCE_AVERAGE:
+                y = (y / n).astype(packed.dtype)
+            if postscale != 1.0:
+                y = y * jnp.asarray(postscale, y.dtype)
+            outs, off = [], 0
+            for size, shape in specs:
+                outs.append(lax.dynamic_slice_in_dim(
+                    y, off, size).reshape(shape))
+                off += size
+            if ef:
+                return tuple(outs) + (new_res,)
+            return tuple(outs)
+
+        out_specs = tuple(P() for _ in specs)
+        if ef:
+            out_specs = out_specs + (P("proc"),)
+        prog = self._program(
+            ("allreduce_int8", tag, specs, str(inputs[0].dtype),
+             reduce_op, prescale, postscale, hier_block, spec.key),
+            fused, out_spec_sharded=False, mesh=mesh,
+            arity=len(inputs) + (1 if ef else 0), out_specs=out_specs,
+        )
+        args = [self._global_stack(x, mesh, n) for x in inputs]
+        if ef:
+            res = self._wire_residuals.get(rkey)
+            if res is None:
+                res = jnp.zeros((total,), jnp.float32)
+            args.append(self._global_stack(res, mesh, n))
+        res_tuple = prog(*args)
+        if ef:
+            new_res = res_tuple[-1]
+            res_tuple = res_tuple[:-1]
+            # keep the residual on device, our shard only (the global
+            # view is [world*total]; ours is the local addressable one).
+            # Bound the store LRU-style: each entry is a bucket-sized
+            # f32 device buffer, and plan churn (elastic reinit,
+            # re-bucketing) would otherwise pin stale copies until OOM.
+            # The cap (256) sits far above any real step's bucket count
+            # (the residual working set is proportional to gradient
+            # size, same as the SPMD path's state residual); hitting it
+            # means eviction is silently degrading error feedback to
+            # int8-raw for the cycled buckets — warn once.
+            self._wire_residuals.pop(rkey, None)
+            self._wire_residuals[rkey] = new_res.addressable_shards[0].data
+            while len(self._wire_residuals) > 256:
+                self._wire_residuals.pop(
+                    next(iter(self._wire_residuals)))
+                _warn_residual_eviction_once()
+        out = {}
+        for name, r in zip(batch.names, res_tuple):
             if name in tensors:
                 out[name] = r
         return out

@@ -35,6 +35,32 @@ def _threshold_bytes() -> int:
     return st.knobs.fusion_threshold_bytes
 
 
+def _active_wire():
+    """The process-wide wire spec, resolved ONCE per fusion plan (a
+    typo'd HOROVOD_COMPRESSION propagates loudly here rather than
+    silently training uncompressed — parse_wire's contract)."""
+    from ..optim.compression import resolve_wire
+
+    return resolve_wire()
+
+
+def _wire_key_for(dtype, spec) -> tuple:
+    """Bucket grouping key: (logical dtype, wire dtype). The compressed
+    data plane (optim/compression.py, HOROVOD_COMPRESSION) applies to
+    floating payloads only, so a bucket's members always share both the
+    logical dtype they are sliced back to AND the dtype they move as —
+    the invariant the executors' one-cast/one-quantize-per-bucket rule
+    rests on. With compression off the wire half is None and grouping
+    is byte-identical to the uncompressed plane. (Today the wire half
+    is derivable from the dtype — one process-wide spec — so grouping
+    boundaries never move; the key keeps that invariant explicit for
+    when per-bucket wire policies arrive.)"""
+    dt = np.dtype(dtype)
+    if spec is None or not np.issubdtype(dt, np.floating):
+        return (dt, None)
+    return (dt, spec.kind)
+
+
 def _record_fusion(n_tensors: int, n_buckets: int, threshold: int,
                    bucket_bytes: Sequence[int] = ()) -> None:
     """Timeline instant marking a (compile-time) fusion plan — the analog
@@ -71,12 +97,13 @@ def fuse_apply(
         threshold_bytes = _threshold_bytes()
 
     arrs = [jnp.asarray(t) for t in tensors]
+    wire = _active_wire()
     by_dtype: dict = {}
     for i, a in enumerate(arrs):
-        by_dtype.setdefault(a.dtype, []).append(i)
+        by_dtype.setdefault(_wire_key_for(a.dtype, wire), []).append(i)
 
     out: List = [None] * len(arrs)
-    for dtype, idxs in by_dtype.items():
+    for (dtype, _wire), idxs in by_dtype.items():
         itemsize = np.dtype(dtype).itemsize
         bucket: List[int] = []
         bucket_bytes = 0
@@ -188,13 +215,15 @@ def pytree_bucket_plan(tree, threshold_bytes: int | None = None,
         # mis-sized bucket of its own
         return np.dtype(jnp.result_type(leaf))
 
+    wire = _active_wire()
     by_dtype: dict = {}
     for i in order:
-        by_dtype.setdefault(_dtype(leaves[i]), []).append(i)
+        by_dtype.setdefault(
+            _wire_key_for(_dtype(leaves[i]), wire), []).append(i)
 
     plans = []
     plan_bytes: List[int] = []  # parallel to `plans` (metrics fill ratio)
-    for dtype, idxs in by_dtype.items():
+    for (dtype, _wire), idxs in by_dtype.items():
         itemsize = dtype.itemsize
         cur_plan, cur_bytes, off = [], 0, 0
 

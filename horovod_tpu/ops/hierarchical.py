@@ -29,6 +29,22 @@ Two forms:
 Numerics are identical to the flat psum (sum reassociation over a
 partition of the world); a structure test asserts the emitted HLO
 differs (reduce-scatter+all-gather vs one all-reduce).
+
+**Compression-aware routing** (`wire=` — optim/compression.py WireSpec,
+docs/compression.md): the ICI inner legs (reduce-scatter, all-gather)
+always run at full logical precision — ICI bandwidth is cheap and the
+inner reduce seeds the outer leg's values — while the bandwidth-bound
+DCN outer leg moves the compressed payload:
+
+  * cast wires (bf16/fp16): the outer psum runs in the cast dtype;
+  * int8: each slice quantizes its inner-reduced shard per block, the
+    outer leg all-gathers quantized shards + scales (~1/4 of the
+    full-precision bytes on the leg that dominates at scale), and each
+    rank dequant-accumulates locally. With ``residual`` the shard
+    payload is error-compensated and the new residual is returned
+    (error feedback; the residual lives on the first ``shard_len``
+    entries of the caller's flat buffer — the shard is rank-private, so
+    the layout is internal).
 """
 
 from __future__ import annotations
@@ -79,37 +95,127 @@ def resolve_block(world: int, block: int = 0) -> int:
     return block
 
 
-def hierarchical_psum(x, axes: Sequence[str], axis_sizes, block: int = 0):
+def _outer_wire_sum(rs, outer_ax, groups, n_outer: int, wire, residual):
+    """SUM of the inner-reduced shard `rs` over the outer (DCN) leg with
+    `wire` compression. Returns the summed shard, plus the new residual
+    when `residual` (f32, rs-shaped) was given (int8 only)."""
+    import jax.numpy as jnp
+
+    if wire.kind in ("fp16", "bf16"):
+        y = lax.psum(rs.astype(wire.wire_dtype), outer_ax,
+                     axis_index_groups=groups).astype(rs.dtype)
+        return (y, None) if residual is not None else y
+    if wire.kind != "int8":
+        raise HorovodInternalError(f"unknown wire kind {wire.kind}")
+    from ..optim import compression as _comp
+
+    flat = rs.astype(jnp.float32).reshape(-1)
+    L = flat.shape[0]
+    if residual is not None:
+        flat = flat + residual.astype(jnp.float32).reshape(-1)[:L]
+    padded = _comp._pad_flat(flat, wire.block)
+    q, s = _comp.quantize_blocks(padded, wire.block)
+    # the DCN leg: quantized shards + scales, gathered (not reduced) —
+    # each rank dequant-accumulates the n_outer contributions locally
+    qg = lax.all_gather(q, outer_ax, axis_index_groups=groups)
+    sg = lax.all_gather(s, outer_ax, axis_index_groups=groups)
+    deq = _comp.dequantize_blocks(
+        qg.reshape(-1), sg.reshape(-1), wire.block)
+    y = deq.reshape(n_outer, -1).sum(axis=0)[:L].reshape(
+        rs.shape).astype(rs.dtype)
+    if residual is None:
+        return y
+    new_res = (padded - _comp.dequantize_blocks(q, s, wire.block))[:L]
+    return y, new_res.reshape(rs.shape)
+
+
+def _stash_shard_residual(x, shard_res, shard_len: int):
+    """Park the rank-private shard residual in the head of an x-shaped
+    f32 buffer (shard_len <= x.size always: shard_len = ceil(L/k))."""
+    import jax.numpy as jnp
+
+    buf = jnp.zeros((int(np.prod(jnp.shape(x))) or 1,), jnp.float32)
+    buf = buf.at[:shard_len].set(shard_res.reshape(-1)[:shard_len])
+    return buf.reshape(jnp.shape(x))
+
+
+def hierarchical_psum(x, axes: Sequence[str], axis_sizes, block: int = 0,
+                      wire=None, residual=None):
     """Two-level sum of `x` over `axes`, equal in value to
-    ``lax.psum(x, axes)``.
+    ``lax.psum(x, axes)`` (exactly with ``wire=None``, to wire-
+    quantization tolerance otherwise).
 
     axes: 1 axis (split by `block` via groups) or 2+ axes (last axis =
     inner/ICI level, the rest = outer). axis_sizes: name -> extent.
+    wire: optional optim.compression.WireSpec — the DCN outer leg moves
+    the compressed payload (module docstring); inner ICI legs stay full
+    precision. residual (int8 error feedback): f32 array of x's shape;
+    the call then returns ``(y, new_residual)``.
     """
+    if residual is not None and (wire is None or wire.kind != "int8"):
+        raise HorovodInternalError(
+            "error-feedback residual requires an int8 wire")
     axes = tuple(axes)
     if len(axes) >= 2:
         inner_ax = axes[-1]
         outer_ax = axes[:-1] if len(axes) > 2 else axes[0]
         k = axis_sizes[inner_ax]
+        n_outer = 1
+        for ax in (axes[:-1] if len(axes) > 2 else (axes[0],)):
+            n_outer *= axis_sizes[ax]
         flat, n = _flatten_pad(x, k)
         rs = lax.psum_scatter(flat, inner_ax, scatter_dimension=0,
                               tiled=True)
-        ar = lax.psum(rs, outer_ax)
+        if wire is None:
+            ar = lax.psum(rs, outer_ax)
+        elif residual is not None:
+            shard_len = rs.shape[0]
+            ar, res_shard = _outer_wire_sum(
+                rs, outer_ax, None, n_outer, wire,
+                residual.reshape(-1)[:shard_len])
+        else:
+            ar = _outer_wire_sum(rs, outer_ax, None, n_outer, wire, None)
         out = lax.all_gather(ar, inner_ax, tiled=True)
-        return out[:n].reshape(x.shape)
+        y = out[:n].reshape(x.shape)
+        if residual is not None:
+            return y, _stash_shard_residual(x, res_shard, rs.shape[0])
+        return y
 
     axis = axes[0]
     world = axis_sizes[axis]
     block = resolve_block(world, block)
     if block == 1:
-        return lax.psum(x, axis)
+        if wire is None:
+            return lax.psum(x, axis)
+        # degenerate hierarchy (no inner domain): whole-wire compression
+        # for the flat world — the EQuARX two-phase form for int8, a
+        # cast-reduce-cast for the float wires
+        if wire.kind == "int8":
+            from ..optim import compression as _comp
+
+            return _comp.quantized_psum(x, axis, world, wire.block,
+                                        residual=residual)
+        y = lax.psum(x.astype(wire.wire_dtype), axis).astype(x.dtype)
+        return y
     inner, outer = _block_groups(world, block)
+    n_outer = world // block
     flat, n = _flatten_pad(x, block)
     rs = lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True,
                           axis_index_groups=inner)
-    ar = lax.psum(rs, axis, axis_index_groups=outer)
+    if wire is None:
+        ar = lax.psum(rs, axis, axis_index_groups=outer)
+    elif residual is not None:
+        shard_len = rs.shape[0]
+        ar, res_shard = _outer_wire_sum(
+            rs, axis, outer, n_outer, wire,
+            residual.reshape(-1)[:shard_len])
+    else:
+        ar = _outer_wire_sum(rs, axis, outer, n_outer, wire, None)
     out = lax.all_gather(ar, axis, tiled=True, axis_index_groups=inner)
-    return out[:n].reshape(x.shape)
+    y = out[:n].reshape(x.shape)
+    if residual is not None:
+        return y, _stash_shard_residual(x, res_shard, rs.shape[0])
+    return y
 
 
 def hierarchical_allgather(x, axes: Sequence[str], axis_sizes,

@@ -1,8 +1,13 @@
-from .compression import Compression  # noqa: F401
+from .compression import (  # noqa: F401
+    Compression,
+    Int8BlockCompressor,
+    WireSpec,
+)
 from .distributed import (  # noqa: F401
     DistributedGradientTape,
     DistributedOptimizer,
     distributed_value_and_grad,
+    error_feedback_specs,
 )
 from .functions import (  # noqa: F401
     allgather_object,

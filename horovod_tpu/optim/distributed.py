@@ -36,8 +36,76 @@ from ..core.state import global_state
 from ..ops import collectives
 from ..ops.adasum import adasum_allreduce
 from ..ops.collectives import ReduceOp
-from ..ops.fusion import flatten_pytree_buckets
-from .compression import Compression, NoneCompressor
+from ..ops.fusion import (flatten_pytree_buckets, pack_pytree_by_plan,
+                          pytree_bucket_plan)
+from .compression import (Compression, NoneCompressor, WireSpec,
+                          compressor_wire_spec, quantized_psum,
+                          wire_sent_bytes)
+
+
+def _int8_bucket_allreduce(bucket, live, wire: WireSpec, residual):
+    """SUM one fused bucket over the live axes with the int8 wire:
+    hierarchical routing (full-precision ICI reduce-scatter, quantized
+    DCN outer leg) when the hierarchy knob is on or the mesh factors the
+    world into 2+ axes, the flat EQuARX two-phase form otherwise.
+    Returns ``(reduced, new_residual)`` when `residual` is given."""
+    from ..core import basics
+    from ..ops import hierarchical
+
+    sizes = basics.bound_axis_sizes()
+    knobs = global_state().knobs
+    if (len(live) > 1
+            or hierarchical.hierarchy_enabled_for("allreduce", None)):
+        return hierarchical.hierarchical_psum(
+            bucket, live, sizes, knobs.hierarchical_local_size,
+            wire=wire, residual=residual)
+    return quantized_psum(bucket, live[0], sizes[live[0]], wire.block,
+                          residual=residual)
+
+
+_WIRE_MISMATCH_WARNED = [False]
+
+
+def _warn_wire_mismatch_once(requested: str, executor: str) -> None:
+    """An explicit `compression=` argument disagrees with the eager
+    executor's knob-resolved wire: on the native eager path the
+    EXECUTOR owns the wire, so the knob wins — make the conflict loud
+    once instead of silently training under a different wire than the
+    constructor asked for."""
+    if _WIRE_MISMATCH_WARNED[0]:
+        return
+    _WIRE_MISMATCH_WARNED[0] = True
+    from ..utils.logging import get_logger
+
+    get_logger().warning(
+        "DistributedOptimizer compression=%r does not match the eager "
+        "executor's HOROVOD_COMPRESSION wire (%r); the executor's wire "
+        "wins on the native eager path. Set HOROVOD_COMPRESSION=%s (or "
+        "drop the explicit compression argument) so both agree — "
+        "docs/compression.md.", requested, executor, requested)
+
+
+_STATELESS_EF_WARNED = [False]
+
+
+def _warn_stateless_ef_once() -> None:
+    """An error-feedback compressor reached a stateless reduce surface
+    (DistributedGradientTape / distributed_value_and_grad) on the SPMD
+    path: the quantized SUM runs un-debiased there (int8-raw
+    semantics). Say so once instead of silently accumulating bias."""
+    if _STATELESS_EF_WARNED[0]:
+        return
+    _STATELESS_EF_WARNED[0] = True
+    from ..utils.logging import get_logger
+
+    get_logger().warning(
+        "int8 wire compression is running WITHOUT error feedback on "
+        "this path: DistributedGradientTape/distributed_value_and_grad "
+        "carry no residual state, so quantization bias accumulates "
+        "across steps. Use hvd.DistributedOptimizer(compression="
+        "Compression.int8) (with hvd.error_feedback_specs inside "
+        "shard_map) for the unbiased wire — docs/compression.md."
+    )
 
 
 def _reduce_grad_tree(
@@ -47,12 +115,29 @@ def _reduce_grad_tree(
     process_set,
     axis_name,
     fusion_threshold_bytes: Optional[int],
+    residual=None,
 ):
-    """Fused, compressed all-reduce of a gradient pytree."""
+    """Fused, compressed all-reduce of a gradient pytree.
+
+    ``compression=None`` resolves the knob-selected compressor
+    (HOROVOD_COMPRESSION, docs/compression.md). With ``residual`` (an
+    error-feedback pytree congruent to `grads`, f32 leaves) the return
+    value is ``(reduced, new_residual)`` — only meaningful under the
+    int8 wire on the SPMD path; other paths pass the residual through
+    unchanged (the eager executors hold their own wire residuals).
+    """
+    if compression is None:
+        compression = Compression.from_knobs()
+
+    def _ret(red, new_res=None):
+        if residual is None:
+            return red
+        return red, (new_res if new_res is not None else residual)
+
     axes = collectives._resolve_axis(axis_name)
     live = collectives._bound_axes(axes)
     if not live and global_state().world_size() <= 1:
-        return grads  # single rank: nothing to reduce
+        return _ret(grads)  # single rank: nothing to reduce
 
     n = collectives._group_size(process_set, axis_name)
     if n <= 1:
@@ -60,11 +145,37 @@ def _reduce_grad_tree(
         # collective is an identity, so skip the fusion-bucket
         # pack/unpack too — the traced BERT step spent ~4% of device
         # time packing buckets nothing would ever ride (docs/benchmarks.md)
-        return grads
+        return _ret(grads)
 
-    buckets, unflatten = flatten_pytree_buckets(
-        grads, threshold_bytes=fusion_threshold_bytes
-    )
+    wire = compressor_wire_spec(compression)
+    int8_wire = wire is not None and wire.kind == "int8"
+    if int8_wire and (
+        op not in (ReduceOp.SUM, ReduceOp.AVERAGE)
+        or (live and process_set is not None
+            and process_set.process_set_id != 0)
+        or (not live and global_state().eager_runtime is None)
+    ):
+        # the quantized collective addresses whole axes with SUM
+        # semantics; exotic reduce ops (ADASUM/MIN/...), SPMD
+        # proper-subset process sets, and the single-controller eager
+        # simulation fall back to the uncompressed plane. The one case
+        # that keeps int8 alive without a live axis is the native eager
+        # runtime, whose EXECUTOR owns the wire (including subset
+        # batches over their sub-mesh).
+        compression = NoneCompressor
+        wire, int8_wire = None, False
+
+    if (int8_wire and live and residual is None
+            and getattr(compression, "error_feedback", False)):
+        _warn_stateless_ef_once()
+
+    plan = pytree_bucket_plan(grads, threshold_bytes=fusion_threshold_bytes)
+    buckets, unflatten = pack_pytree_by_plan(grads, plan)
+    res_buckets = res_unflatten = None
+    if residual is not None and int8_wire and live:
+        # residual rides the SAME bucket layout as the gradients, so a
+        # leaf's error lands back on that leaf at unflatten time
+        res_buckets, res_unflatten = pack_pytree_by_plan(residual, plan)
     # Native eager world (top-level update, no bound mesh axis): submit
     # the WHOLE per-step bucket set through one batched enqueue round
     # (EagerRuntime.enqueue_batch via grouped_allreduce_async) instead
@@ -76,9 +187,32 @@ def _reduce_grad_tree(
             and collectives._native_rt_for_async(process_set) is not None
             and op != ReduceOp.ADASUM
             and len(buckets) > 0):
+        rt_wire = getattr(global_state().eager_runtime,
+                          "_executor_wire", lambda: None)()
+        # whenever the executor carries ANY wire, it owns compression
+        # for these buckets (pre-casting would stack two lossy wires);
+        # a kind mismatch against an explicit compressor arg means the
+        # knob wins — say so instead of silently double/un-compressing
+        executor_owns_wire = wire is not None and rt_wire is not None
+        if (wire is not None and rt_wire is not None
+                and rt_wire.kind != wire.kind):
+            _warn_wire_mismatch_once(wire.kind, rt_wire.kind)
+        if int8_wire and rt_wire is None:
+            # the int8 collective needs executor support; without the
+            # knob the executor reduces at full precision
+            _warn_wire_mismatch_once(wire.kind, "none")
         wires, ctxs = [], []
         for b in buckets:
-            w, c = compression.compress(b)
+            if int8_wire or executor_owns_wire:
+                # the executor compresses once per fused batch (int8:
+                # quantize + runtime-held error-feedback residual;
+                # casts: one bucket-wide cast) — pre-compressing here
+                # would double-apply the wire and make the
+                # hvd_wire_bytes counters read an already-cast payload
+                # as the logical baseline (ratio 1x instead of 2x)
+                w, c = b, None
+            else:
+                w, c = compression.compress(b)
             wires.append(w)
             ctxs.append(c)
         h = collectives.grouped_allreduce_async(
@@ -89,7 +223,8 @@ def _reduce_grad_tree(
             name="hvd.grad", process_set=process_set,
         )
         reduced = [
-            compression.decompress(jnp.asarray(r), c)
+            jnp.asarray(r) if (int8_wire or executor_owns_wire)
+            else compression.decompress(jnp.asarray(r), c)
             for r, c in zip(collectives.synchronize(h), ctxs)
         ]
         from ..utils import metrics as _metrics
@@ -97,7 +232,7 @@ def _reduce_grad_tree(
         if _metrics.enabled():
             total = sum(int(b.size) * b.dtype.itemsize for b in buckets)
             _metrics.record_grad_reduction(total, len(buckets))
-        return unflatten(reduced)
+        return _ret(unflatten(reduced))
     # Ordered buckets (reference semantics: fused responses execute in
     # controller order, operations.cc PerformOperation): chain bucket k
     # on bucket k-1's result through an optimization_barrier. Without
@@ -110,19 +245,50 @@ def _reduce_grad_tree(
     # asserts this on the compiled schedule).
     ordered = global_state().knobs.ordered_buckets and len(buckets) > 1
     reduced = []
+    new_res_buckets = []
     prev = None
-    for b in buckets:
+    for i, b in enumerate(buckets):
         if ordered and prev is not None:
             b, _ = jax.lax.optimization_barrier((b, prev))
-        wire, ctx = compression.compress(b)
+        b_float = jnp.issubdtype(b.dtype, jnp.floating)
+        if int8_wire and b_float and live:
+            # quantized SUM over the live axes (flat EQuARX form or
+            # hierarchical DCN-outer-leg routing); AVERAGE divides the
+            # dequantized sum — the quantized payload itself always
+            # carries the SUM contribution
+            r_b = res_buckets[i] if res_buckets is not None else None
+            out = _int8_bucket_allreduce(b, live, wire, r_b)
+            if r_b is not None:
+                red, new_r = out
+                new_res_buckets.append(new_r)
+            else:
+                red = out
+            if op == ReduceOp.AVERAGE:
+                red = (red / n).astype(b.dtype)
+            prev = red
+            reduced.append(red)
+            continue
+        if res_buckets is not None:
+            # non-floating bucket under the int8 wire: full precision,
+            # residual unchanged
+            new_res_buckets.append(res_buckets[i])
+        if int8_wire:
+            # int8 never cast-reduces (an int8 SUM would overflow and
+            # mix per-rank scales): any bucket falling through here —
+            # non-floating, or an eager fallthrough that skipped the
+            # grouped enqueue — moves uncompressed
+            wire_b, ctx = b, None
+        else:
+            wire_b, ctx = compression.compress(b)
         if op == ReduceOp.ADASUM:
             if not live:
-                red = wire
+                red = wire_b
             else:
-                red = adasum_allreduce(wire, live[0], process_set=process_set)
+                red = adasum_allreduce(wire_b, live[0],
+                                       process_set=process_set)
         else:
             red = collectives.allreduce(
-                wire,
+                wire_b,
                 op=ReduceOp.SUM if op == ReduceOp.AVERAGE else op,
                 process_set=process_set,
                 axis_name=axis_name,
@@ -153,7 +319,24 @@ def _reduce_grad_tree(
                 ),
                 None,
             )
-    return unflatten(reduced)
+            # wire accounting: what this step's gradient set would move
+            # at logical precision vs what the compressed plane sends
+            sent = sum(
+                wire_sent_bytes(
+                    int(b.size), b.dtype.itemsize,
+                    wire if (wire is not None
+                             and jnp.issubdtype(b.dtype, jnp.floating))
+                    else None)
+                for b in buckets
+            )
+            io_callback(
+                functools.partial(
+                    _metrics.record_wire_bytes, total, sent),
+                None,
+            )
+    if res_unflatten is not None and residual is not None:
+        return _ret(unflatten(reduced), res_unflatten(new_res_buckets))
+    return _ret(unflatten(reduced))
 
 
 class _AccumState(NamedTuple):
@@ -162,10 +345,49 @@ class _AccumState(NamedTuple):
     counter: jnp.ndarray
 
 
+class _EFState(NamedTuple):
+    """DistributedOptimizer state under an error-feedback compressor:
+    the inner optimizer state plus the per-leaf quantization residual.
+    Residual leaves carry a leading world dimension — row r is rank r's
+    private residual — and must be sharded one-row-per-device inside
+    shard_map via :func:`error_feedback_specs` (the residual is
+    device-varying: each rank compensates ITS OWN contribution's
+    quantization error)."""
+
+    inner: Any
+    residual: Any
+
+
+def error_feedback_specs(state, axis_name=None):
+    """PartitionSpecs for a DistributedOptimizer state: residual leaves
+    shard their leading world dim over the data-parallel axis (one row
+    per rank, like ZeRO's sharded_state_specs); everything else
+    replicates. Pass as the state's in/out specs in shard_map when the
+    optimizer was built with an error-feedback compressor
+    (Compression.int8). Recurses through the gradient-accumulation
+    wrapper, so it works for any backward_passes_per_step."""
+    from jax.sharding import PartitionSpec as P
+
+    if isinstance(state, _AccumState):
+        return _AccumState(
+            inner=error_feedback_specs(state.inner, axis_name),
+            acc=jax.tree_util.tree_map(lambda _: P(), state.acc),
+            counter=P(),
+        )
+    if not isinstance(state, _EFState):
+        return jax.tree_util.tree_map(lambda _: P(), state)
+    axes = collectives._resolve_axis(axis_name)
+    ax = axes[0] if len(axes) == 1 else tuple(axes)
+    return _EFState(
+        inner=jax.tree_util.tree_map(lambda _: P(), state.inner),
+        residual=jax.tree_util.tree_map(lambda _: P(ax), state.residual),
+    )
+
+
 def DistributedOptimizer(
     optimizer,
     named_parameters=None,
-    compression=Compression.none,
+    compression=None,
     backward_passes_per_step: int = 1,
     op: ReduceOp = ReduceOp.AVERAGE,
     gradient_predivide_factor: float = 1.0,
@@ -181,14 +403,29 @@ def DistributedOptimizer(
     into pre/post scaling (optimizer.py:196-207): prescale = 1/(f·n)… here
     pre = 1/f applied before reduction, post = f/n after, matching the
     reference's numerics.
+
+    ``compression=None`` (default) resolves the HOROVOD_COMPRESSION knob
+    at construction — ``none`` reproduces the uncompressed plane bit for
+    bit. An error-feedback compressor (``Compression.int8``) wraps the
+    state in :class:`_EFState` carrying the per-leaf quantization
+    residual; inside shard_map pass :func:`error_feedback_specs` for the
+    state so each device keeps its own residual row (docs/compression.md).
     """
     del named_parameters
     import optax
 
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    if compression is None:
+        compression = Compression.from_knobs()
+    # error feedback exists to de-bias the quantized SUM; ops the int8
+    # wire never carries (ADASUM/MIN/...) run uncompressed and must not
+    # allocate residual state the reduce would never touch
+    ef = bool(getattr(compression, "error_feedback", False)) and op in (
+        ReduceOp.SUM, ReduceOp.AVERAGE)
 
-    def reduce_fn(grads):
+    def reduce_fn(grads, residual=None):
+        """-> reduced, or (reduced, new_residual) when residual given."""
         g = grads
         if gradient_predivide_factor != 1.0 and op == ReduceOp.AVERAGE:
             n = collectives._group_size(process_set, axis_name)
@@ -197,24 +434,81 @@ def DistributedOptimizer(
             g = jax.tree_util.tree_map(
                 lambda x: x * jnp.asarray(pre, x.dtype), g
             )
-            g = _reduce_grad_tree(
+            out = _reduce_grad_tree(
                 g, ReduceOp.SUM, compression, process_set, axis_name,
-                fusion_threshold_bytes,
+                fusion_threshold_bytes, residual=residual,
             )
-            return jax.tree_util.tree_map(
+            g, new_res = out if residual is not None else (out, None)
+            g = jax.tree_util.tree_map(
                 lambda x: x * jnp.asarray(post, x.dtype), g
             )
+            return (g, new_res) if residual is not None else g
         return _reduce_grad_tree(
             g, op, compression, process_set, axis_name,
-            fusion_threshold_bytes,
+            fusion_threshold_bytes, residual=residual,
         )
+
+    def _maybe_ef_init(params, inner):
+        if not ef:
+            return inner
+        n = collectives._group_size(process_set, axis_name)
+        if n <= 1:
+            return inner
+        if global_state().eager_runtime is not None:
+            # native eager world: the EXECUTOR holds the per-bucket
+            # wire residuals (docs/compression.md) — an optimizer-state
+            # copy would be n x model-size of f32 that nothing ever
+            # reads. (A native-eager process that also runs SPMD steps
+            # therefore gets int8 WITHOUT state error feedback on that
+            # path — documented tradeoff.)
+            return inner
+        residual = jax.tree_util.tree_map(
+            lambda p: jnp.zeros((n,) + tuple(jnp.shape(p)), jnp.float32),
+            params,
+        )
+        return _EFState(inner=inner, residual=residual)
+
+    def _ef_update(grads, state, params, update_inner, **extra):
+        """Shared EF step: squeeze this rank's residual row, reduce with
+        error feedback, restore the row. On the eager path the executors
+        own the wire residual, so the state rows pass through."""
+        live = collectives._bound_axes(
+            collectives._resolve_axis(axis_name))
+        if not live:
+            reduced = reduce_fn(grads)
+            updates, new_inner = update_inner(reduced, state.inner,
+                                              params, **extra)
+            return updates, _EFState(new_inner, state.residual)
+
+        def _row(r, g):
+            if (hasattr(r, "ndim") and r.ndim == jnp.ndim(g) + 1
+                    and r.shape[0] == 1):
+                return r[0]
+            raise ValueError(
+                "error-feedback residual leaf has shape "
+                f"{getattr(r, 'shape', None)} — expected a (1, ...) row "
+                "per device. Shard the optimizer state in your "
+                "shard_map in_specs with hvd.error_feedback_specs(state)"
+                " so each rank keeps its own residual row."
+            )
+
+        res_local = jax.tree_util.tree_map(_row, state.residual, grads)
+        reduced, new_res = reduce_fn(grads, res_local)
+        updates, new_inner = update_inner(reduced, state.inner, params,
+                                          **extra)
+        new_res = jax.tree_util.tree_map(
+            lambda r: r.astype(jnp.float32)[None], new_res)
+        return updates, _EFState(new_inner, new_res)
 
     if backward_passes_per_step == 1:
 
         def init_fn(params):
-            return optimizer.init(params)
+            return _maybe_ef_init(params, optimizer.init(params))
 
         def update_fn(grads, state, params=None, **extra):
+            if isinstance(state, _EFState):
+                return _ef_update(grads, state, params, optimizer.update,
+                                  **extra)
             reduced = reduce_fn(grads)
             return optimizer.update(reduced, state, params, **extra)
 
@@ -228,7 +522,7 @@ def DistributedOptimizer(
     def init_fn(params):
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
         return _AccumState(
-            inner=optimizer.init(params),
+            inner=_maybe_ef_init(params, optimizer.init(params)),
             acc=zeros,
             counter=jnp.zeros((), jnp.int32),
         )
@@ -241,6 +535,11 @@ def DistributedOptimizer(
         def sync_branch(operand):
             acc, inner = operand
             mean = jax.tree_util.tree_map(lambda a: a / k, acc)
+            if isinstance(inner, _EFState):
+                updates, new_inner = _ef_update(
+                    mean, inner, params, optimizer.update, **extra)
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                return updates, new_inner, zeros
             reduced = reduce_fn(mean)
             updates, new_inner = optimizer.update(
                 reduced, inner, params, **extra
@@ -274,7 +573,7 @@ class DistributedGradientTape:
     def __init__(
         self,
         value_and_grad_fn: Callable,
-        compression=Compression.none,
+        compression=None,
         op: ReduceOp = ReduceOp.AVERAGE,
         process_set=None,
         axis_name=None,
@@ -301,7 +600,7 @@ def distributed_value_and_grad(
     argnums=0,
     has_aux: bool = False,
     op: ReduceOp = ReduceOp.AVERAGE,
-    compression=Compression.none,
+    compression=None,
     process_set=None,
     axis_name=None,
     **vag_kwargs,

@@ -100,7 +100,8 @@ def _pad_rows(b, n):
 
 def ShardedOptimizer(optimizer, axis_name=None,
                      fusion_threshold_bytes=None,
-                     bucket_backward_order=None):
+                     bucket_backward_order=None,
+                     compression=None):
     """Wrap an elementwise optax optimizer so its state is sharded 1/N
     per rank (ZeRO stage 1). Returns an optax GradientTransformation
     whose `update()` reduce-scatters gradient buckets (backward-ordered,
@@ -108,7 +109,19 @@ def ShardedOptimizer(optimizer, axis_name=None,
     updates. `fusion_threshold_bytes` / `bucket_backward_order` default
     to the global knobs, like DistributedOptimizer — pin them
     explicitly when the state must be restorable in a process whose
-    knobs may differ (see reshard_state)."""
+    knobs may differ (see reshard_state).
+
+    `compression` (default: the HOROVOD_COMPRESSION knob) puts the
+    gradient reduce-scatter on the compressed wire
+    (docs/compression.md): cast wires (bf16/fp16) run the psum_scatter
+    in the cast dtype; the int8 wire block-quantizes each rank's rows
+    for the exchange (optim.compression.quantized_reduce_scatter_rows —
+    row padding is internal, so the sharded state LAYOUT is identical
+    to the uncompressed plane). The update all-gather stays full
+    precision (it carries the applied update, not a SUM), and the int8
+    reduce-scatter runs without error feedback — the residual would
+    need a state-layout change; use DistributedOptimizer for int8+EF.
+    ``none`` is bitwise-identical to the pre-compression behavior."""
     import optax
 
     def init_fn(params):
@@ -147,14 +160,34 @@ def ShardedOptimizer(optimizer, axis_name=None,
         # issues while backward for later buckets still computes —
         # the same structural overlap as optim/distributed.py's
         # all-reduce chain, asserted in tests/test_zero.py
+        from .compression import (compressor_wire_spec, Compression,
+                                  quantized_reduce_scatter_rows)
+
+        comp = (Compression.from_knobs() if compression is None
+                else compression)
+        wire = compressor_wire_spec(comp)
+
         g_shards, prev = [], None
         for b in gb:
             rows = _pad_rows(b, n)
             if ordered and prev is not None:
                 rows, _ = jax.lax.optimization_barrier((rows, prev))
-            s = jax.lax.psum_scatter(
-                rows.reshape(-1), ax, scatter_dimension=0,
-                tiled=True) / n
+            if (wire is not None and wire.kind == "int8"
+                    and jnp.issubdtype(rows.dtype, jnp.floating)):
+                # block-quantized exchange; the shard SUM comes back in
+                # f32 and averages exactly like the uncompressed path
+                s = (quantized_reduce_scatter_rows(
+                    rows, ax, wire.block) / n).astype(rows.dtype)
+            elif (wire is not None
+                    and jnp.issubdtype(rows.dtype, jnp.floating)):
+                s = (jax.lax.psum_scatter(
+                    rows.astype(wire.wire_dtype).reshape(-1), ax,
+                    scatter_dimension=0, tiled=True) / n
+                ).astype(rows.dtype)
+            else:
+                s = jax.lax.psum_scatter(
+                    rows.reshape(-1), ax, scatter_dimension=0,
+                    tiled=True) / n
             prev = s
             g_shards.append(s)
         p_shards = [

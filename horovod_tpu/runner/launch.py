@@ -79,6 +79,16 @@ def parse_args(argv: Optional[List[str]] = None):
                    help="Bayesian (GP + expected-improvement) autotune "
                         "search instead of coordinate descent")
     p.add_argument("--autotune-log", dest="autotune_log")
+    p.add_argument("--compression", dest="compression",
+                   choices=["none", "fp16", "bf16", "int8", "int8-raw"],
+                   help="compressed collective data plane "
+                        "(HOROVOD_COMPRESSION, docs/compression.md): "
+                        "cast wires halve gradient bytes, int8 "
+                        "block-quantizes them ~4x with error feedback")
+    p.add_argument("--compression-block", dest="compression_block",
+                   type=int,
+                   help="int8 quantization block (elements per scale, "
+                        "HOROVOD_COMPRESSION_BLOCK, default 256)")
     p.add_argument("--compression-wire-dtype",
                    dest="compression_wire_dtype",
                    choices=["bfloat16", "float16"])

@@ -21,6 +21,8 @@ ARG_TO_ENV = {
     "autotune_bayes": "HOROVOD_AUTOTUNE_BAYES",
     "autotune_log": "HOROVOD_AUTOTUNE_LOG",
     "compression_wire_dtype": "HOROVOD_COMPRESSION_WIRE_DTYPE",
+    "compression": "HOROVOD_COMPRESSION",
+    "compression_block": "HOROVOD_COMPRESSION_BLOCK",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
     "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
     "hierarchical_local_size": "HOROVOD_HIERARCHICAL_LOCAL_SIZE",

@@ -562,6 +562,8 @@ class StepStats:
         self.fusion_buckets = 0
         self.fusion_fill_sum = 0.0
         self.grad_bytes = 0
+        self.wire_logical = 0
+        self.wire_sent = 0
         self.queue_depth = 0
         self.elastic_events: List[str] = []
         self.retries: Dict[str, int] = {}       # point -> count
@@ -589,6 +591,11 @@ class StepStats:
     def add_grad_bytes(self, nbytes: int) -> None:
         with self._lock:
             self.grad_bytes += int(nbytes)
+
+    def add_wire(self, logical: int, sent: int) -> None:
+        with self._lock:
+            self.wire_logical += int(logical)
+            self.wire_sent += int(sent)
 
     def add_elastic_event(self, kind: str) -> None:
         with self._lock:
@@ -660,6 +667,11 @@ class StepStats:
                 "queue_depth": self.queue_depth,
                 "elastic_events": list(self.elastic_events),
             }
+            if self.wire_logical or self.wire_sent:
+                record["wire"] = {
+                    "logical_bytes": self.wire_logical,
+                    "sent_bytes": self.wire_sent,
+                }
             if self.retries:
                 record["retries"] = dict(self.retries)
             if self.retry_giveups:
@@ -805,6 +817,24 @@ def record_grad_reduction(nbytes: int, n_buckets: int) -> None:
     registry.counter(
         "hvd_grad_reductions_total", "Executed gradient reductions").inc()
     step_stats.add_grad_bytes(nbytes)
+
+
+def record_wire_bytes(logical: int, sent: int) -> None:
+    """One compressed-data-plane transfer (docs/compression.md): what
+    the payload occupies at logical precision vs what actually moves
+    under the HOROVOD_COMPRESSION wire (payload + scales). The two
+    counters are equal on the uncompressed plane; their ratio is the
+    live compression factor scripts/metrics_summary.py reports and
+    scripts/compression_check.py gates on."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_wire_bytes_logical_total",
+        "Collective payload bytes at logical precision").inc(int(logical))
+    registry.counter(
+        "hvd_wire_bytes_sent_total",
+        "Collective payload bytes on the compressed wire").inc(int(sent))
+    step_stats.add_wire(int(logical), int(sent))
 
 
 def record_timeline_activity(activity: str, seconds: float) -> None:

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Compressed data plane smoke gate: world-2 loopback, wire-byte ratios
++ none-parity (docs/compression.md).
+
+Sits next to the other check scripts (scripts/run_all_checks.py): two
+EagerRuntime processes (LoopbackExecutor, rank-different submit orders)
+run a training-shaped allreduce loop under each wire mode and assert,
+per rank:
+
+* ``int8``  — the hvd_wire_bytes_logical_total / _sent_total counter
+  ratio is >= 3.5x (payload + per-block scales vs full precision), the
+  reduced values sit within quantization tolerance of the exact sum,
+  and the steady-state plan cache still engages under the wire;
+* ``bf16``  — the counter ratio is ~2x;
+* ``none``  — sent bytes EQUAL logical bytes and the results are
+  **bitwise identical** to the exact sum — the HOROVOD_COMPRESSION=none
+  reproduces-the-uncompressed-plane contract.
+
+Exits 0 and prints a JSON summary on success; exits 1 with the first
+failed assertion otherwise.
+
+Usage:
+    python scripts/compression_check.py [--check] [--steps N]
+"""
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+TENSORS_PER_STEP = 4
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _wire_counters():
+    from horovod_tpu.utils import metrics
+
+    snap = metrics.registry.snapshot()
+
+    def total(name):
+        fam = snap.get(name, {})
+        return float(sum(fam.values())) if fam else 0.0
+
+    return (total("hvd_wire_bytes_logical_total"),
+            total("hvd_wire_bytes_sent_total"))
+
+
+def _worker(rank, size, port, steps, q):
+    import numpy as np
+
+    from horovod_tpu.ops.eager_runtime import EagerRuntime
+    from horovod_tpu.utils import metrics
+
+    metrics.enable()
+    rt = EagerRuntime(rank, size, "127.0.0.1", port, cycle_ms=1.0,
+                      fast_path=True, fast_path_warmup=2, wire="none")
+    try:
+        names = [f"g{i}" for i in range(TENSORS_PER_STEP)]
+        order = names if rank % 2 == 0 else list(reversed(names))
+        rng = np.random.RandomState(7)  # same inputs on every rank
+        inputs = [rng.randn(2048).astype(np.float32) for _ in names]
+        exact = [x * size for x in inputs]  # identical contributions
+
+        def run_mode(mode):
+            rt.set_wire(mode)
+            l0, s0 = _wire_counters()
+            outs = None
+            for _ in range(steps):
+                hs = {n: rt.allreduce_async(n, inputs[names.index(n)])
+                      for n in order}
+                outs = [np.asarray(rt.synchronize(hs[n], timeout_s=30.0))
+                        for n in names]
+            l1, s1 = _wire_counters()
+            return outs, (l1 - l0), (s1 - s0)
+
+        report = {}
+
+        # --- int8: ratio + tolerance + plan cache engages under wire
+        outs, logical, sent = run_mode("int8")
+        ratio = logical / max(sent, 1.0)
+        assert ratio >= 3.5, f"int8 wire ratio {ratio:.2f} < 3.5"
+        for x, y in zip(exact, outs):
+            tol = 4.0 * size * np.abs(x).max() / 127.0
+            err = np.abs(y - x).max()
+            assert err <= tol, f"int8 error {err} above tolerance {tol}"
+        fp = rt.fast_path_stats()
+        assert fp["active"], "plan cache did not engage under int8 wire"
+        assert fp["plan_wire_key"] and fp["plan_wire_key"][0] == "int8", (
+            f"plan frozen under wrong wire: {fp['plan_wire_key']}")
+        report["int8"] = {"ratio": round(ratio, 3),
+                          "plan_active": bool(fp["active"])}
+
+        # --- bf16: ~2x
+        outs, logical, sent = run_mode("bf16")
+        ratio = logical / max(sent, 1.0)
+        assert 1.9 <= ratio <= 2.1, f"bf16 wire ratio {ratio:.2f} != ~2"
+        for x, y in zip(exact, outs):
+            assert np.allclose(y, x, rtol=2e-2, atol=2e-2), "bf16 drift"
+        report["bf16"] = {"ratio": round(ratio, 3)}
+
+        # --- none: exact parity, bitwise results
+        outs, logical, sent = run_mode("none")
+        assert logical == sent, (
+            f"none wire sent {sent} != logical {logical}")
+        for x, y in zip(exact, outs):
+            assert np.array_equal(y, x), "none wire is not bitwise exact"
+        report["none"] = {"ratio": 1.0, "bitwise": True}
+
+        q.put((rank, "ok", report))
+    except Exception as e:
+        q.put((rank, "err", repr(e)))
+    finally:
+        rt.shutdown()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="run the smoke gate (default behavior)")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--world", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    port = _free_port()
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker, args=(r, args.world, port,
+                                          args.steps, q))
+        for r in range(args.world)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in procs:
+            rank, status, payload = q.get(timeout=180)
+            if status != "ok":
+                print(f"compression check FAILED on rank {rank}: "
+                      f"{payload}")
+                return 1
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+    print("compression check OK: "
+          + json.dumps({str(r): results[r] for r in sorted(results)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -447,6 +447,67 @@ def main(argv=None):
     except Exception as e:  # bench must survive a broken loopback env
         replication_block = {"error": repr(e)}
 
+    # ---- compression A/B (docs/compression.md acceptance gate): the
+    # same steady eager step under each wire mode — none vs bf16 vs
+    # int8 — reporting steady step time and the wire-byte counters
+    # (hvd_wire_bytes_{logical,sent}_total). Metrics stay enabled for
+    # all three arms so the instrumentation cost cancels; each arm
+    # re-reaches steady state first (set_wire flushes the plan cache).
+    compression_block = None
+    if rt is not None:
+        from horovod_tpu.utils import metrics as _metricsmod
+
+        _metrics_was = _metricsmod.enabled()
+        _wire_was = rt._executor_wire()  # restore the configured wire
+        try:
+            _metricsmod.enable()
+
+            def _wire_counters():
+                snap = _metricsmod.registry.snapshot()
+
+                def tot(name):
+                    fam = snap.get(name, {})
+                    return float(sum(fam.values())) if fam else 0.0
+
+                return (tot("hvd_wire_bytes_logical_total"),
+                        tot("hvd_wire_bytes_sent_total"))
+
+            compression_block = {}
+            for mode in ("none", "bf16", "int8"):
+                rt.set_wire(mode)
+                p6, s6 = params, opt.init(params)
+                for _ in range(max(args.warmup, 6)):
+                    p6, s6, l = eager_grouped_step(p6, s6)
+                    enqueues["n"] += n_leaves
+                float(l)
+                l0, b0 = _wire_counters()
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    p6, s6, l = eager_grouped_step(p6, s6)
+                    enqueues["n"] += n_leaves
+                float(l)
+                dt = (time.perf_counter() - t0) / args.steps
+                l1, b1 = _wire_counters()
+                logical, sent = l1 - l0, b1 - b0
+                compression_block[mode] = {
+                    "steady_step_ms": round(dt * 1e3, 3),
+                    "wire_bytes_logical": int(logical),
+                    "wire_bytes_sent": int(sent),
+                    "wire_ratio": round(logical / sent, 3) if sent else None,
+                }
+        except Exception as e:  # bench must survive a broken env
+            compression_block = {"error": repr(e)}
+        finally:
+            # the rest of the bench must measure the wire the user
+            # configured (HOROVOD_COMPRESSION), with the pre-A/B
+            # instrumentation state — also on the exception path
+            try:
+                rt.set_wire(_wire_was)
+            except Exception:
+                pass
+            if not _metrics_was:
+                _metricsmod.disable()
+
     fp1 = fp_snap()
     fast_path = None
     if fp1:
@@ -491,6 +552,7 @@ def main(argv=None):
         "fast_path": fast_path,
         "flight_recorder": flight_block,
         "replication": replication_block,
+        "compression": compression_block,
         "runtime_roundtrip_ms": round(rtt_s * 1e3, 2),
         "phase_breakdown_ms": breakdown,
     }

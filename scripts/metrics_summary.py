@@ -112,6 +112,15 @@ def summarize(records):
     if grad:
         print(f"gradient bytes reduced: {_human_bytes(grad)}")
 
+    wire_logical = sum(
+        r.get("wire", {}).get("logical_bytes", 0) for r in records)
+    wire_sent = sum(
+        r.get("wire", {}).get("sent_bytes", 0) for r in records)
+    if wire_logical and wire_sent:
+        print(f"wire compression: {_human_bytes(wire_logical)} logical "
+              f"-> {_human_bytes(wire_sent)} sent "
+              f"(ratio {wire_logical / wire_sent:.2f}x)")
+
     hits = sum(r.get("native", {}).get("cache_hits", 0) for r in records)
     n_coll = sum(v[0] for v in coll.values())
     if hits or n_coll:

@@ -19,7 +19,10 @@ locally before the full pytest tier:
   straggler named, dumps aggregated, rank-labeled /metrics);
 * ``recovery`` — ``scripts/recovery_check.py`` (world-2 loopback
   kill-and-recover: the respawned rank restores from the surviving
-  peer's replica through the recovery ladder).
+  peer's replica through the recovery ladder);
+* ``compression`` — ``scripts/compression_check.py`` (world-2 loopback
+  compressed data plane: int8 wire-byte ratio >= 3.5x, bf16 ~2x, and
+  HOROVOD_COMPRESSION=none bitwise-exact parity).
 
 Usage:
     python scripts/run_all_checks.py [--only NAME ...] [--skip NAME ...]
@@ -147,6 +150,13 @@ def check_recovery():
     ])
 
 
+def check_compression():
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "compression_check.py"),
+        "--check",
+    ])
+
+
 GATES = [
     ("metrics", check_metrics),
     ("chaos", check_chaos),
@@ -154,6 +164,7 @@ GATES = [
     ("serving", check_serving),
     ("flight", check_flight),
     ("recovery", check_recovery),
+    ("compression", check_compression),
 ]
 
 

@@ -252,6 +252,34 @@ def main():
             np.asarray(updates["w"]), -(s_world / size)))
     out["dist_opt_ok"] = bool(opt_ok)
 
+    # 6b''''. compressed wire over the REAL cross-process XLA executor
+    # (docs/compression.md): flip the data plane to the int8 wire at the
+    # same program point on every rank, allreduce rank-distinct values,
+    # expect the exact sum within quantization tolerance — then flip
+    # back to none and demand bitwise exactness. Exercises the
+    # quantized fused program + executor-held EF residual end to end.
+    wire_ok = True
+    try:
+        rng_c = np.random.RandomState(42)  # same base on every rank
+        base = rng_c.uniform(-1, 1, 513).astype(np.float32)
+        exact = base * sum(r + 1 for r in range(size))
+        st.eager_runtime.set_wire("int8")
+        for _ in range(2):
+            red = np.asarray(hvd.allreduce(
+                jnp.asarray(base * (rank + 1)), op=hvd.Sum,
+                name="wire_q"))
+            tol = 4.0 * size * np.abs(exact).max() / 127.0
+            wire_ok = wire_ok and bool(np.abs(red - exact).max() <= tol)
+            wire_ok = wire_ok and not bool(np.array_equal(red, exact))
+        st.eager_runtime.set_wire("none")
+        red = np.asarray(hvd.allreduce(jnp.asarray(base * (rank + 1)),
+                                       op=hvd.Sum, name="wire_n"))
+        wire_ok = wire_ok and bool(
+            np.allclose(red, exact, rtol=1e-6, atol=1e-6))
+    except Exception:
+        wire_ok = False
+    out["compression_wire_ok"] = bool(wire_ok)
+
     # 6c. process-set collectives through the negotiated path: every
     # rank registers the set (synchronized, reference process_sets.py:123),
     # members run subset ops over the set's sub-mesh, non-members run a

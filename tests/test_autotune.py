@@ -166,6 +166,31 @@ def test_spmd_tuner_hierarchical_dimension():
     assert knobs.hierarchical_allreduce in (True, False)
 
 
+def test_spmd_tuner_wire_dimension():
+    """The wire-dtype dimension times each HOROVOD_COMPRESSION candidate
+    through the factory (knobs.compression carries the candidate at
+    trace time) and pins a winner from the candidate set."""
+    knobs = Knobs()
+    calls = []
+
+    def factory(overrides):
+        calls.append(dict(overrides))
+        return lambda: jnp.zeros(())
+
+    tuner = SPMDStepTuner(
+        knobs=knobs, thresholds=[knobs.fusion_threshold_bytes],
+        warmup=0, measure=1, tune_ordered=False,
+        tune_wire=True, wire_candidates=["none", "bf16", "int8"])
+    winners = tuner.tune(factory)
+    # 1 threshold + 2 non-incumbent wire candidates ("none" is the
+    # incumbent and is already timed by the threshold dim)
+    assert len(calls) == 3
+    assert calls[1]["compression"] == "bf16"
+    assert calls[2]["compression"] == "int8"
+    assert winners["compression"] in ("none", "bf16", "int8")
+    assert knobs.compression == winners["compression"]  # pinned
+
+
 def test_parameter_manager_pins_best_threshold(tmp_path):
     knobs = Knobs()
     knobs.autotune = True
@@ -174,9 +199,12 @@ def test_parameter_manager_pins_best_threshold(tmp_path):
     knobs.autotune_log = str(tmp_path / "pm.csv")
     pm = ParameterManager(knobs)
     # walk every candidate; constant byte volume means earlier (smaller
-    # elapsed per sample is noise) — just assert it pins and logs
+    # elapsed per sample is noise) — just assert it pins and logs. Each
+    # candidate switch inserts one skipped (recompile/warmup) window
+    # before its scored window, so the walk takes ~2 windows per
+    # remaining candidate.
     n_candidates = 9
-    for _ in range(n_candidates + 2):
+    for _ in range(2 * n_candidates + 2):
         pm.record_bytes(1 << 20)
         pm.tick()
     assert pm._pinned
@@ -184,3 +212,40 @@ def test_parameter_manager_pins_best_threshold(tmp_path):
         1 << 20, 2 << 20, 4 << 20, 8 << 20, 16 << 20,
         32 << 20, 64 << 20, 128 << 20, 256 << 20]
     assert "# pinned" in (tmp_path / "pm.csv").read_text()
+
+
+def test_parameter_manager_drops_first_post_switch_window(tmp_path):
+    """The first sample window after a threshold switch carries the
+    candidate's recompile/warmup wall time; scoring it would bias the
+    bytes/sec comparison against every later candidate. The window must
+    be dropped: its bytes never appear in any logged score."""
+    knobs = Knobs()
+    knobs.autotune = True
+    knobs.autotune_warmup_samples = 0
+    knobs.autotune_steps_per_sample = 1
+    knobs.autotune_log = str(tmp_path / "pm.csv")
+    pm = ParameterManager(knobs)
+
+    # window 1: scored at the initial candidate (no switch yet)
+    first = pm.fusion_threshold_bytes()
+    pm.record_bytes(100)
+    pm.tick()
+    assert pm._log_rows == [(first, pm._log_rows[0][1])]
+    switched = pm.fusion_threshold_bytes()
+    assert switched != first
+    assert pm._skip_window
+
+    # window 2: the POISONED one — huge byte count that would dominate
+    # any score; it must vanish, not be credited to the new candidate
+    pm.record_bytes(10**12)
+    pm.tick()
+    assert len(pm._log_rows) == 1  # nothing scored
+    assert pm._bytes_in_sample == 0  # accumulators reset
+    assert not pm._skip_window
+
+    # window 3: scored normally for the new candidate
+    pm.record_bytes(200)
+    pm.tick()
+    assert len(pm._log_rows) == 2
+    assert pm._log_rows[1][0] == switched
+    assert pm._best[1] in (first, switched)

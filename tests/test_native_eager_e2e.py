@@ -84,7 +84,14 @@ def _run_world(size: int, timeout_s: float = 240.0):
     return results
 
 
-@pytest.mark.parametrize("size", [2, 3])
+# world-3 (28s of subprocess spawns — the process-set scenarios need
+# size >= 3) rides the slow tier so tier-1 stays inside its 870s
+# budget (PR-1/PR-5 precedent: the largest test moves, coverage
+# stays); world-2 keeps every other scenario in tier-1, and the
+# subset logic world-3 adds is unit-covered by test_process_sets /
+# test_native_runtime
+@pytest.mark.parametrize(
+    "size", [2, pytest.param(3, marks=pytest.mark.slow)])
 def test_native_eager_end_to_end(size):
     out = _run_world(size)
     for r in range(size):
@@ -94,7 +101,7 @@ def test_native_eager_end_to_end(size):
             "grouped_sync_ok",
             "grouped_allgather_ok", "grouped_reducescatter_ok",
             "sparse_ok", "fast_path_ok", "dist_opt_ok",
-            "process_set_ok", "join_ok",
+            "compression_wire_ok", "process_set_ok", "join_ok",
         ):
             assert out[r][key], f"rank {r}: {key} failed: {out[r]}"
         # the steady-state layer saw real traffic
