@@ -118,6 +118,7 @@ from .optim import (  # noqa: F401
 # hvd.elastic.* and hvd.start_timeline in the reference. Metrics is the
 # live-telemetry namespace (hvd.metrics.step(), hvd.metrics.scrape()).
 from . import callbacks  # noqa: F401
+from .ops import overlap  # noqa: F401  (hvd.overlap.staged_value_and_grad)
 from .utils import faults  # noqa: F401
 from .utils import metrics  # noqa: F401
 from .checkpoint import LoadedModel, load_model, save_model  # noqa: F401

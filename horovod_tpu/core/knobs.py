@@ -129,6 +129,21 @@ class Knobs:
     # per-block quantization granularity (elements per int8 scale)
     compression_block: int = 256
 
+    # --- backward-interleaved collective scheduler (ops/overlap.py) ---
+    # "off" (default): today's monolithic backward — the whole grad
+    # pytree exists before the bucket chain issues, and the scheduled
+    # overlap window is whatever XLA's memory-minimizing scheduler
+    # grants (0.26 on BERT-L, 0.016 on the ZeRO path, OVERLAP_r05.json).
+    # "stage": segment the backward into fusion-bucket-aligned stages
+    # and pin each bucket's collective BEFORE the next segment's compute
+    # via optimization_barrier on the inter-segment cotangent, so the
+    # schedule is forced to interleave (docs/overlap.md). "double":
+    # additionally defer the optimizer's consumption of early buckets
+    # until the last segment retires (double-buffered grads). Off must
+    # reproduce the unscheduled trace bit-for-bit (it takes the
+    # identical code path).
+    overlap_schedule: str = "off"
+
     # --- hierarchy (operations.cc:551-565) ---
     # On TPU: "hierarchical" = reduce-scatter over ICI within a slice, then
     # all-reduce across slices over DCN, then all-gather over ICI
@@ -277,6 +292,7 @@ class Knobs:
             compression_wire_dtype=_env("COMPRESSION_WIRE_DTYPE", "") or "",
             compression=_env("COMPRESSION", "") or "none",
             compression_block=_env_int("COMPRESSION_BLOCK", 256),
+            overlap_schedule=_env("OVERLAP_SCHEDULE", "") or "off",
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),

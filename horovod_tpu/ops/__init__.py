@@ -31,6 +31,7 @@ from .collectives import (  # noqa: F401
 from .adasum import adasum_allreduce, hierarchical_adasum  # noqa: F401
 from .autotune import ParameterManager, SPMDStepTuner  # noqa: F401
 from .fusion import flatten_pytree_buckets, fuse_apply  # noqa: F401
+from . import overlap  # noqa: F401  (backward-interleaved scheduler)
 # pallas kernel family (TPU-first hot ops; interpret-mode off-TPU)
 from .pallas_attention import (  # noqa: F401
     flash_attention,

@@ -248,6 +248,75 @@ def pytree_bucket_plan(tree, threshold_bytes: int | None = None,
     return treedef, plans
 
 
+def plan_bucket_lengths(plans) -> List[int]:
+    """Element count per bucket of a pytree_bucket_plan — the layout
+    widths ZeRO shard math and the staged scheduler both derive from."""
+    return [sum(n for (_, _, n, _) in bp) for bp in plans]
+
+
+def bucket_issue_schedule(plans, leaf_stages, backward_stage_order):
+    """When does each fusion bucket become issuable during a segmented
+    backward pass?
+
+    ``leaf_stages[i]`` lists the stage ids contributing gradient to
+    leaf ``i`` (tied embeddings list two: the head's early contribution
+    and the input lookup's final one). ``backward_stage_order`` is the
+    order the segments' backward runs (reverse of forward). Returns one
+    list per backward step: the bucket indices whose every leaf has
+    received ALL its contributions by the end of that step — the
+    compile-time mirror of the reference controller marking a fused
+    response ready once all its tensors arrived (controller.cc:830).
+    Pure bookkeeping (no device work); raises if any bucket never
+    completes, which means the stage decomposition does not cover its
+    leaves."""
+    remaining = [len(s) for s in leaf_stages]
+    stage_to_leaves: dict = {}
+    for i, sids in enumerate(leaf_stages):
+        for si in sids:
+            stage_to_leaves.setdefault(si, []).append(i)
+    pending = list(range(len(plans)))
+    schedule = []
+    for si in backward_stage_order:
+        for i in stage_to_leaves.get(si, ()):
+            remaining[i] -= 1
+        now = [bi for bi in pending
+               if all(remaining[i] == 0 for (i, _, _, _) in plans[bi])]
+        for bi in now:
+            pending.remove(bi)
+        schedule.append(now)
+    if pending:
+        raise ValueError(
+            f"buckets {pending} never complete under this stage "
+            "decomposition — some of their leaves receive no gradient "
+            "contribution from any stage")
+    return schedule
+
+
+def pack_buckets_by_plan(tree, plans):
+    """Bucket payloads of `tree`'s leaves under a pytree_bucket_plan's
+    per-bucket leaf layout (the pack half of pack_pytree_by_plan)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    buckets = []
+    for bplan in plans:
+        flats = [jnp.asarray(leaves[i]).reshape(-1)
+                 for (i, _, _, _) in bplan]
+        buckets.append(
+            jnp.concatenate(flats) if len(flats) > 1 else flats[0])
+    return buckets
+
+
+def unflatten_buckets_by_plan(buckets, treedef, plans, nleaves):
+    """Restore a pytree from per-bucket payloads laid out by a
+    pytree_bucket_plan (the unflatten half of pack_pytree_by_plan)."""
+    new_leaves = [None] * nleaves
+    for bucket, bplan in zip(buckets, plans):
+        for (i, off, n, shape) in bplan:
+            new_leaves[i] = jax.lax.dynamic_slice_in_dim(
+                bucket, off, n
+            ).reshape(shape)
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
 def pack_pytree_by_plan(tree, plan):
     """Pack `tree`'s leaves into buckets following a pytree_bucket_plan
     (possibly computed from a DIFFERENT tree of the same structure —
@@ -255,23 +324,12 @@ def pack_pytree_by_plan(tree, plan):
     never shift the bucket boundaries the optimizer state was laid out
     with). Returns (buckets, unflatten)."""
     treedef, plans = plan
-    leaves = jax.tree_util.tree_leaves(tree)
-
-    buckets = []
-    for bplan in plans:
-        flats = [jnp.asarray(leaves[i]).reshape(-1)
-                 for (i, _, _, _) in bplan]
-        buckets.append(
-            jnp.concatenate(flats) if len(flats) > 1 else flats[0])
+    nleaves = len(jax.tree_util.tree_leaves(tree))
+    buckets = pack_buckets_by_plan(tree, plans)
 
     def unflatten(reduced_buckets):
-        new_leaves = [None] * len(leaves)
-        for bucket, bplan in zip(reduced_buckets, plans):
-            for (i, off, n, shape) in bplan:
-                new_leaves[i] = jax.lax.dynamic_slice_in_dim(
-                    bucket, off, n
-                ).reshape(shape)
-        return jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return unflatten_buckets_by_plan(
+            reduced_buckets, treedef, plans, nleaves)
 
     return buckets, unflatten
 

@@ -1,0 +1,586 @@
+"""Backward-interleaved collective scheduler (HOROVOD_OVERLAP_SCHEDULE).
+
+The monolithic SPMD step hands XLA one backward pass and a chain of
+per-bucket collectives, and hopes the scheduler interleaves them. It
+doesn't: on the real BERT-Large step AOT-compiled for v5e, the first
+gradient all-reduce depends on only ~9% of backward compute
+(``overlappable_frac 0.91``) yet the memory-minimizing scheduler places
+just 26% of backward after it — and 1.6% on the ZeRO path
+(OVERLAP_r05.json). The reference never had this problem: its grad
+hooks fire *during* backward and the background loop launches each
+fused response as soon as its tensors arrive (torch/optimizer.py:176,
+controller.cc:830). This module is the compile-time equivalent of that
+runtime behavior:
+
+* the backward pass is traced as a sequence of **segments** (reverse
+  layer order — the order backward actually runs) via per-segment
+  ``jax.vjp`` over a stage decomposition of the forward;
+* each fusion bucket's collective is issued at the first segment
+  boundary where all of its gradients exist (the same
+  backward-availability bucket plan ``ops/fusion.py`` builds);
+* the issued collective is **pinned before the next segment's compute**
+  by routing the inter-segment cotangent through
+  ``lax.optimization_barrier`` with the collective's result — a real
+  dependency edge every scheduler must respect, so the scheduled
+  window can no longer collapse below the structural bound;
+* ``double`` mode additionally defers the optimizer's consumption of
+  early buckets until the last segment retires, so update arithmetic
+  cannot interleave into mid-backward and raise peak memory.
+
+The user-facing optimizer API is unchanged: ``DistributedOptimizer``/
+``ShardedOptimizer.update`` accept the staged gradients this module
+produces and skip their own reduction (the collectives already ran
+inside the backward, on the same compressed wire — int8
+quantize/dequantize rides inside the staged segment). With the knob
+off, callers keep their monolithic ``jax.value_and_grad`` path, which
+is bit-for-bit today's trace. See docs/overlap.md.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import collectives
+from .collectives import ReduceOp
+from .fusion import (bucket_issue_schedule, pack_buckets_by_plan,
+                     plan_bucket_lengths, pytree_bucket_plan,
+                     unflatten_buckets_by_plan)
+
+_MODES = ("off", "stage", "double")
+
+
+def normalize_mode(value) -> str:
+    """Map knob spellings onto the canonical mode names: ``off``
+    (default), ``stage`` (backward-interleaved issue), ``double``
+    (+ deferred optimizer consumption). Accepts 0/1/on/off aliases so
+    ``HOROVOD_OVERLAP_SCHEDULE=1`` does the expected thing."""
+    v = str(value or "off").strip().lower()
+    if v in ("", "0", "false", "no", "off", "none"):
+        return "off"
+    if v in ("1", "true", "yes", "on", "stage"):
+        return "stage"
+    if v in ("2", "double", "double-buffer", "double_buffer"):
+        return "double"
+    raise ValueError(
+        f"unknown overlap schedule {value!r} — expected one of "
+        f"{_MODES} (HOROVOD_OVERLAP_SCHEDULE, docs/overlap.md)")
+
+
+def schedule_mode(knobs=None) -> str:
+    """The process-wide schedule mode, knob-resolved."""
+    if knobs is None:
+        from ..core.state import global_state
+
+        knobs = global_state().knobs
+    return normalize_mode(getattr(knobs, "overlap_schedule", "off"))
+
+
+def active(knobs=None) -> bool:
+    """True when the backward-interleaved schedule is on — the branch
+    callers take between their monolithic step (off: bit-for-bit
+    today's trace) and :func:`staged_value_and_grad`."""
+    return schedule_mode(knobs) != "off"
+
+
+class Stage(NamedTuple):
+    """One forward segment: ``fwd(sub_params, carry) -> carry`` where
+    ``sub_params`` is ``{key: params[key]}`` for this stage's top-level
+    ``keys``. The first stage closes over the batch (its carry is a
+    dummy scalar); the last stage returns the scalar loss. Backward
+    runs the stages in reverse, one ``jax.vjp`` each."""
+
+    name: str
+    keys: tuple
+    fwd: Callable
+
+
+class StagedGrads:
+    """Gradients reduced *inside* the backward by the staged scheduler.
+    ``DistributedOptimizer.update`` unwraps this and skips its own
+    reduction. Same-trace carrier only — do not pass across a jit
+    boundary."""
+
+    __slots__ = ("tree", "new_residual")
+
+    def __init__(self, tree, new_residual=None):
+        self.tree = tree
+        self.new_residual = new_residual
+
+
+class StagedShards:
+    """Per-bucket averaged gradient shards produced by the staged
+    scheduler on the ZeRO path (already reduce-scattered).
+    ``ShardedOptimizer.update`` consumes the shards directly."""
+
+    __slots__ = ("shards",)
+
+    def __init__(self, shards):
+        self.shards = list(shards)
+
+
+# ---------------------------------------------------------------------------
+# reducer introspection
+# ---------------------------------------------------------------------------
+
+def _reducer_info(opt) -> dict:
+    """The reduction recipe attached by DistributedOptimizer /
+    ShardedOptimizer to their update fn (kind, op, compression, axes,
+    threshold...). Raising here — not deep in the trace — when the
+    optimizer can't ride the staged schedule."""
+    if opt is None:
+        from ..optim.compression import Compression
+
+        return dict(kind="allreduce", op=ReduceOp.AVERAGE,
+                    compression=Compression.from_knobs(),
+                    process_set=None, axis_name=None,
+                    fusion_threshold_bytes=None,
+                    gradient_predivide_factor=1.0,
+                    backward_passes_per_step=1, error_feedback=False,
+                    plain=True)
+    info = getattr(getattr(opt, "update", None), "_hvd_overlap_info",
+                   None)
+    if info is None:
+        raise ValueError(
+            "staged_value_and_grad needs an hvd.DistributedOptimizer or "
+            "hvd.ShardedOptimizer (or opt=None for a bare averaged "
+            "reduce); got an optimizer without overlap metadata — "
+            "docs/overlap.md")
+    info = dict(info)
+    info["plain"] = False
+    unsupported = check_supported(info)
+    if unsupported:
+        raise ValueError(
+            f"the backward-interleaved schedule does not support this "
+            f"optimizer configuration: {unsupported} (docs/overlap.md)")
+    return info
+
+
+def check_supported(info) -> Optional[str]:
+    """None when the staged schedule can drive this reducer; otherwise
+    a human-readable reason (used both to raise explicitly and to fall
+    back silently in auto-wiring like parallel/train.py)."""
+    if info is None:
+        return "optimizer carries no overlap metadata"
+    if info.get("backward_passes_per_step", 1) != 1:
+        return ("backward_passes_per_step > 1 accumulates locally "
+                "before reducing; the staged schedule reduces every "
+                "step")
+    if info["kind"] == "allreduce" and info["op"] not in (
+            ReduceOp.SUM, ReduceOp.AVERAGE):
+        return f"reduce op {info['op']} (only SUM/AVERAGE stage)"
+    ps = info.get("process_set")
+    if ps is not None and getattr(ps, "process_set_id", 0) != 0:
+        return "proper-subset process sets"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# stage decompositions
+# ---------------------------------------------------------------------------
+
+def transformer_lm_stages(model, tokens, loss_fn, positions=None,
+                          mask=None) -> List[Stage]:
+    """Decompose a ``models.transformer.Transformer`` forward + loss
+    into backward segments: embed → block_0..N → head(+loss). Built
+    from the SAME flax building blocks the monolithic ``model.apply``
+    uses (standalone ``Block``/``Embed``/norm applies over the
+    corresponding param subtrees), so composing the stages reproduces
+    the monolithic forward op-for-op — the property the bitwise
+    schedule-on/off parity tests rest on.
+
+    ``loss_fn(logits) -> scalar`` closes over the labels/targets.
+    """
+    import flax.linen as nn
+
+    from ..models.transformer import Block, _norm
+
+    cfg = model.cfg
+    attention_fn = model.attention_fn
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+
+    emb_mod = nn.Embed(
+        cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+        param_dtype=jnp.float32, name="tok_emb",
+        embedding_init=nn.initializers.normal(0.02),
+    )
+
+    def embed_fwd(sub, carry):
+        x = emb_mod.apply({"params": sub["tok_emb"]}, tokens)
+        if cfg.position == "learned":
+            x = x + sub["pos_emb"][positions].astype(cfg.dtype)
+        return x
+
+    embed_keys = ("tok_emb",) + (
+        ("pos_emb",) if cfg.position == "learned" else ())
+    stages = [Stage("embed", embed_keys, embed_fwd)]
+
+    block_cls = nn.remat(Block, static_argnums=()) if cfg.remat else Block
+    for i in range(cfg.num_layers):
+        key = f"block_{i}"
+
+        def blk_fwd(sub, carry, _key=key):
+            return block_cls(cfg, attention_fn=attention_fn).apply(
+                {"params": sub[_key]}, carry, positions, mask)
+
+        stages.append(Stage(key, (key,), blk_fwd))
+
+    def head_fwd(sub, carry):
+        x = _norm(cfg, "ln_final").apply({"params": sub["ln_final"]},
+                                         carry)
+        if cfg.tie_embeddings:
+            logits = emb_mod.apply({"params": sub["tok_emb"]}, x,
+                                   method=nn.Embed.attend)
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=jnp.float32, name="lm_head",
+                kernel_init=nn.initializers.normal(0.02),
+            ).apply({"params": sub["lm_head"]}, x)
+        return loss_fn(logits)
+
+    head_keys = ("ln_final",) + (
+        ("tok_emb",) if cfg.tie_embeddings else ("lm_head",))
+    stages.append(Stage("head", head_keys, head_fwd))
+    return stages
+
+
+def stack_stages(input_fn: Callable, layers: Sequence, head_fn: Callable,
+                 head_keys: tuple = ()) -> List[Stage]:
+    """Stage decomposition for a plain layer stack (the overlap gate's
+    MLP vehicle, or any hand-segmented model):
+
+    * ``input_fn() -> carry`` closes over the batch (a no-param stage);
+    * ``layers`` is a sequence of ``(key, fwd)`` where
+      ``fwd(layer_params, carry) -> carry`` receives ``params[key]``;
+    * ``head_fn(sub, carry) -> scalar loss`` receives ``{k: params[k]}``
+      for ``head_keys``.
+    """
+    stages = [Stage("input", (), lambda sub, c: input_fn())]
+    for key, fwd in layers:
+        stages.append(Stage(
+            key, (key,),
+            lambda sub, c, _f=fwd, _k=key: _f(sub[_k], c)))
+    stages.append(Stage("head", tuple(head_keys), head_fn))
+    return stages
+
+
+# ---------------------------------------------------------------------------
+# the staged value-and-grad
+# ---------------------------------------------------------------------------
+
+def _leaf_index_maps(params, stages):
+    """Full-tree leaf bookkeeping: (path->idx, per-leaf contributing
+    stage ids). A leaf referenced by several stages (tied embeddings)
+    accumulates one grad contribution per stage and becomes
+    bucket-ready only after its LAST contributing stage."""
+    paths_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    path_to_idx = {jax.tree_util.keystr(p): i
+                   for i, (p, _) in enumerate(paths_leaves)}
+    leaf_stages: List[list] = [[] for _ in paths_leaves]
+    top_keys = set()
+    for si, st in enumerate(stages):
+        top_keys.update(st.keys)
+        sub = {k: params[k] for k in st.keys}
+        for p, _ in jax.tree_util.tree_flatten_with_path(sub)[0]:
+            leaf_stages[path_to_idx[jax.tree_util.keystr(p)]].append(si)
+    missing = [k for k in params if k not in top_keys]
+    if missing:
+        raise ValueError(
+            f"stage decomposition covers no gradients for top-level "
+            f"param keys {missing} — the staged backward would drop "
+            f"them; add them to a stage or turn the overlap schedule "
+            f"off for this model")
+    return path_to_idx, leaf_stages
+
+
+def _stage_cost_bytes(params, stages):
+    """Backward-compute cost proxy per stage: bytes of the parameters
+    the stage's segment differentiates (transformer block backward
+    FLOPs scale with the block's weights). Drives the static pinned
+    fraction behind hvd_overlap_window_frac."""
+    costs = []
+    for st in stages:
+        sub = {k: params[k] for k in st.keys}
+        costs.append(sum(
+            int(np.prod(jnp.shape(l) or (1,))) *
+            np.dtype(jnp.result_type(l)).itemsize
+            for l in jax.tree_util.tree_leaves(sub)))
+    return costs
+
+
+def _pack_bucket(leaf_grads, bplan):
+    flats = [leaf_grads[i].reshape(-1) for (i, _, _, _) in bplan]
+    return jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+
+
+def _barrier_pair(a, b):
+    a2, _ = jax.lax.optimization_barrier((a, b))
+    return a2
+
+
+def _loss_seed_dtype(loss):
+    d = jnp.result_type(loss)
+    return d if jnp.issubdtype(d, jnp.inexact) else jnp.float32
+
+
+def staged_value_and_grad(stages_fn: Callable, opt=None,
+                          mode: Optional[str] = None):
+    """Build ``vag(params, *batch, opt_state=None) -> (loss, grads)``
+    tracing the backward in bucket-aligned segments with each bucket's
+    collective issued at its availability boundary and pinned before
+    the next segment's compute.
+
+    ``stages_fn(*batch) -> list[Stage]`` decomposes the forward (e.g.
+    :func:`transformer_lm_stages` partial-applied over the model and
+    loss). ``opt`` is the hvd optimizer whose ``update`` will consume
+    the result — its attached reduction recipe (op, wire, threshold,
+    ZeRO vs all-reduce) drives the staged collectives; ``opt=None``
+    reduces with the knob-resolved wire at AVERAGE and returns a plain
+    (already reduced) grad pytree.
+
+    Under an error-feedback compressor pass the optimizer state:
+    ``loss, g = vag(params, batch, opt_state=state)`` — the residual
+    rides the staged quantized collectives and the updated residual
+    returns inside the staged grads, exactly as the monolithic
+    ``_ef_update`` would have produced (bitwise, asserted in
+    tests/test_overlap_schedule.py).
+    """
+    info = _reducer_info(opt)
+
+    def vag(params, *batch, opt_state=None):
+        m = normalize_mode(mode) if mode is not None else schedule_mode()
+        if m == "off":
+            raise ValueError(
+                "staged_value_and_grad called with the overlap schedule "
+                "off — branch on hvd.overlap.active() and keep the "
+                "monolithic value_and_grad path when it is (off must "
+                "stay bit-for-bit today's trace)")
+        stages = stages_fn(*batch)
+        return _run_staged(stages, params, info, m, opt_state)
+
+    return vag
+
+
+def _run_staged(stages: Sequence[Stage], params, info: dict, mode: str,
+                opt_state):
+    from ..core.state import global_state
+    from ..optim import distributed as dist
+    from ..optim.compression import compressor_wire_spec
+
+    if not isinstance(params, dict):
+        params = dict(params)
+
+    kind = info["kind"]
+    axis_name = info.get("axis_name")
+    live = collectives._bound_axes(collectives._resolve_axis(axis_name))
+    if not live:
+        raise RuntimeError(
+            "the backward-interleaved schedule issues per-segment "
+            "collectives and must run inside shard_map/jit with the "
+            "data-parallel mesh axis bound (like ShardedOptimizer.update)"
+        )
+    n = collectives._group_size(info.get("process_set"), axis_name)
+    if n <= 1:
+        raise RuntimeError(
+            "overlap schedule on a size-1 group: nothing to overlap — "
+            "run with the schedule off on single-rank worlds")
+
+    treedef, plans = pytree_bucket_plan(
+        params, threshold_bytes=info.get("fusion_threshold_bytes"),
+        backward_order=info.get("bucket_backward_order"))
+    lens = plan_bucket_lengths(plans)
+
+    # ---- forward: one vjp per segment ----------------------------------
+    path_to_idx, leaf_stages = _leaf_index_maps(params, stages)
+    vjps = []
+    carry = jnp.zeros((), jnp.float32)  # dummy diffable carry, stage 0
+    for st in stages:
+        sub = {k: params[k] for k in st.keys}
+
+        def f(sub, carry, _st=st):
+            return _st.fwd(sub, carry)
+
+        carry, vjp = jax.vjp(f, sub, carry)
+        vjps.append(vjp)
+    loss = carry
+    if jnp.ndim(loss) != 0:
+        raise ValueError(
+            f"the last stage must return a scalar loss; got shape "
+            f"{jnp.shape(loss)}")
+
+    # ---- reducer setup --------------------------------------------------
+    ordered = global_state().knobs.ordered_buckets
+    pre = post = None
+    res_buckets = None
+    compression = wire = None
+    int8_wire = False
+    eff_op = None
+    ax = live[0]
+    if kind == "allreduce":
+        compression = info["compression"]
+        op = info["op"]
+        predivide = info.get("gradient_predivide_factor", 1.0)
+        wire = compressor_wire_spec(compression)
+        int8_wire = wire is not None and wire.kind == "int8"
+        eff_op = op
+        if predivide != 1.0 and op == ReduceOp.AVERAGE:
+            pre, post = 1.0 / predivide, predivide / n
+            eff_op = ReduceOp.SUM
+        if info.get("error_feedback") and int8_wire:
+            if opt_state is None:
+                raise ValueError(
+                    "this DistributedOptimizer carries error-feedback "
+                    "state; pass opt_state= to the staged "
+                    "value_and_grad so the residual rides the staged "
+                    "quantized collectives (docs/overlap.md)")
+            res_local = dist._residual_rows(opt_state, params)
+            if res_local is not None:
+                res_buckets = pack_buckets_by_plan(res_local, plans)
+    else:  # zero
+        from ..optim import zero as zero_mod
+        from ..optim.compression import Compression
+
+        comp = info.get("compression")
+        comp = Compression.from_knobs() if comp is None else comp
+        wire = compressor_wire_spec(comp)
+
+    # ---- backward: reverse segments, issue buckets at readiness --------
+    backward_stage_order = list(reversed(range(len(stages))))
+    schedule = bucket_issue_schedule(plans, leaf_stages,
+                                     backward_stage_order)
+    costs = _stage_cost_bytes(params, stages)
+    nleaves = len(leaf_stages)
+    leaf_grads: List[Any] = [None] * nleaves
+    reduced: List[Any] = [None] * len(plans)
+    new_res_buckets: List[Any] = [None] * len(plans)
+    bucket_meta: List[tuple] = [(0, 0, False)] * len(plans)
+    chain = None
+    last_bi = None
+    first_issue_step = None
+    ct = jnp.ones((), _loss_seed_dtype(loss))
+    for step_i, si in enumerate(backward_stage_order):
+        g_sub, ct_in = vjps[si](ct)
+        for p, g in jax.tree_util.tree_flatten_with_path(g_sub)[0]:
+            i = path_to_idx[jax.tree_util.keystr(p)]
+            leaf_grads[i] = g if leaf_grads[i] is None \
+                else leaf_grads[i] + g
+        for bi in schedule[step_i]:
+            bucket = _pack_bucket(leaf_grads, plans[bi])
+            bucket_meta[bi] = (
+                int(bucket.size), bucket.dtype.itemsize,
+                bool(jnp.issubdtype(bucket.dtype, jnp.floating)))
+            if pre is not None:
+                bucket = bucket * jnp.asarray(pre, bucket.dtype)
+            if ordered and chain is not None:
+                bucket = _barrier_pair(bucket, chain)
+            if kind == "allreduce":
+                r_b = res_buckets[bi] if res_buckets is not None else None
+                red, token, new_r = dist._reduce_bucket(
+                    bucket, eff_op, compression, wire, int8_wire, live,
+                    n, info.get("process_set"), axis_name,
+                    res_bucket=r_b)
+                new_res_buckets[bi] = new_r
+            else:
+                rows = zero_mod._pad_rows(bucket, n)
+                red = zero_mod._scatter_bucket(rows, ax, n, wire)
+                token = red
+            reduced[bi] = red
+            chain = token
+            last_bi = bi
+            if first_issue_step is None:
+                first_issue_step = step_i
+        # the pin: segment si-1's backward compute must schedule after
+        # every collective issued so far — a genuine dependency edge
+        # (not just collective-to-collective ordering), routed through
+        # the inter-segment cotangent
+        if si > 0 and chain is not None and hasattr(ct_in, "dtype") \
+                and jnp.issubdtype(ct_in.dtype, jnp.inexact):
+            ct_in = _barrier_pair(ct_in, chain)
+        ct = ct_in
+    missing = [bi for bi, r in enumerate(reduced) if r is None]
+    if missing:
+        raise AssertionError(
+            f"buckets {missing} never became available — stage "
+            f"decomposition does not cover their leaves")
+
+    if mode == "double" and chain is not None:
+        # double-buffered grads: the optimizer consumes nothing until
+        # the LAST segment's collective retires, so update arithmetic
+        # can't interleave into mid-backward
+        reduced = [r if bi == last_bi else _barrier_pair(r, chain)
+                   for bi, r in enumerate(reduced)]
+
+    # static pinned fraction: share of backward cost the schedule
+    # forces after the first issued collective (the lower bound any
+    # correct scheduler must grant the overlap window)
+    total_cost = float(sum(costs)) or 1.0
+    pinned_frac = sum(
+        costs[si] for step_i, si in enumerate(backward_stage_order)
+        if first_issue_step is not None and step_i > first_issue_step
+    ) / total_cost
+
+    _record_staged_step(bucket_meta, wire, pinned_frac)
+
+    if kind == "zero":
+        for shard, L in zip(reduced, lens):
+            k = -(-L // n)
+            if shard.shape != (k,):
+                raise AssertionError((shard.shape, k))
+        return loss, StagedShards(reduced)
+
+    if post is not None:
+        reduced = [r * jnp.asarray(post, r.dtype) for r in reduced]
+    tree = unflatten_buckets_by_plan(reduced, treedef, plans,
+                                    nleaves)
+    new_res = None
+    if res_buckets is not None:
+        filled = [nr if nr is not None else rb
+                  for nr, rb in zip(new_res_buckets, res_buckets)]
+        res_tree = unflatten_buckets_by_plan(filled, treedef,
+                                             plans, nleaves)
+        new_res = jax.tree_util.tree_map(
+            lambda r: r.astype(jnp.float32)[None], res_tree)
+    if info.get("plain"):
+        return loss, tree
+    return loss, StagedGrads(tree, new_res)
+
+
+def _record_staged_step(bucket_meta, wire, pinned_frac):
+    """Execution-time telemetry parity with the monolithic paths: the
+    autotuner observation, grad/wire byte counters, and the
+    hvd_overlap_window_frac gauge (the schedule's static pin).
+    ``bucket_meta`` is (elements, itemsize, is_floating) per bucket;
+    ``wire`` is the WireSpec the staged collectives actually move
+    (resolved once in _run_staged for both allreduce and ZeRO)."""
+    import functools
+
+    from ..core.state import global_state
+    from ..utils import metrics as _metrics
+
+    pm = global_state().parameter_manager
+    if pm is None and not _metrics.enabled():
+        return
+    from jax.experimental import io_callback
+
+    total = sum(e * it for e, it, _ in bucket_meta)
+    if pm is not None:
+        io_callback(functools.partial(pm.observe, total), None)
+    if _metrics.enabled():
+        io_callback(functools.partial(
+            _metrics.record_grad_reduction, total, len(bucket_meta)),
+            None)
+        from ..optim.compression import wire_sent_bytes
+
+        sent = sum(
+            wire_sent_bytes(e, it, wire if fl else None)
+            for e, it, fl in bucket_meta)
+        io_callback(functools.partial(
+            _metrics.record_wire_bytes, total, sent), None)
+        io_callback(functools.partial(
+            _metrics.record_overlap_window, float(pinned_frac)), None)

@@ -273,6 +273,18 @@ def resolve_wire(knobs=None) -> Optional[WireSpec]:
     return parse_wire(name, _env_int("COMPRESSION_BLOCK", DEFAULT_BLOCK))
 
 
+def wire_applies(spec: Optional[WireSpec], dtype) -> bool:
+    """True when `spec` transforms payloads of `dtype`: the compressed
+    plane only touches floating payloads (integer buckets always move
+    uncompressed), and ``None`` is the uncompressed plane everywhere.
+    The shared guard for the per-bucket reduce paths — the monolithic
+    chains and the backward-interleaved scheduler (ops/overlap.py)
+    dispatch on the same predicate, so a bucket can never compress on
+    one path and not the other."""
+    return spec is not None and jnp.issubdtype(jnp.dtype(dtype),
+                                               jnp.floating)
+
+
 def wire_sent_bytes(n_elements: int, logical_itemsize: int,
                     spec: Optional[WireSpec]) -> int:
     """Bytes one contribution of `n_elements` occupies on the wire under

@@ -63,6 +63,59 @@ def _int8_bucket_allreduce(bucket, live, wire: WireSpec, residual):
                           residual=residual)
 
 
+def _reduce_bucket(b, op, compression, wire: Optional[WireSpec],
+                   int8_wire: bool, live, n, process_set, axis_name,
+                   res_bucket=None):
+    """Reduce ONE fused 1-D bucket on the configured wire — the shared
+    per-bucket data plane of the monolithic chain (_reduce_grad_tree)
+    and the backward-interleaved scheduler (ops/overlap.py), extracted
+    verbatim so both trace identical collectives.
+
+    Returns ``(reduced, chain_token, new_residual)``: `reduced` is the
+    decompressed result, `chain_token` the value the ordered-bucket
+    barrier chain threads (the pre-decompress payload, preserving the
+    exact HLO the chain emitted before the extraction), `new_residual`
+    the updated error-feedback bucket (or `res_bucket` unchanged on
+    paths that don't consume it)."""
+    b_float = jnp.issubdtype(b.dtype, jnp.floating)
+    if int8_wire and b_float and live:
+        # quantized SUM over the live axes (flat EQuARX form or
+        # hierarchical DCN-outer-leg routing); AVERAGE divides the
+        # dequantized sum — the quantized payload itself always
+        # carries the SUM contribution
+        out = _int8_bucket_allreduce(b, live, wire, res_bucket)
+        if res_bucket is not None:
+            red, new_r = out
+        else:
+            red, new_r = out, None
+        if op == ReduceOp.AVERAGE:
+            red = (red / n).astype(b.dtype)
+        return red, red, new_r
+    if int8_wire:
+        # int8 never cast-reduces (an int8 SUM would overflow and
+        # mix per-rank scales): any bucket falling through here —
+        # non-floating, or an eager fallthrough that skipped the
+        # grouped enqueue — moves uncompressed (residual unchanged)
+        wire_b, ctx = b, None
+    else:
+        wire_b, ctx = compression.compress(b)
+    if op == ReduceOp.ADASUM:
+        if not live:
+            red = wire_b
+        else:
+            red = adasum_allreduce(wire_b, live[0],
+                                   process_set=process_set)
+    else:
+        red = collectives.allreduce(
+            wire_b,
+            op=ReduceOp.SUM if op == ReduceOp.AVERAGE else op,
+            process_set=process_set,
+            axis_name=axis_name,
+            postscale_factor=(1.0 / n) if op == ReduceOp.AVERAGE else 1.0,
+        )
+    return compression.decompress(red, ctx), red, res_bucket
+
+
 _WIRE_MISMATCH_WARNED = [False]
 
 
@@ -250,52 +303,13 @@ def _reduce_grad_tree(
     for i, b in enumerate(buckets):
         if ordered and prev is not None:
             b, _ = jax.lax.optimization_barrier((b, prev))
-        b_float = jnp.issubdtype(b.dtype, jnp.floating)
-        if int8_wire and b_float and live:
-            # quantized SUM over the live axes (flat EQuARX form or
-            # hierarchical DCN-outer-leg routing); AVERAGE divides the
-            # dequantized sum — the quantized payload itself always
-            # carries the SUM contribution
-            r_b = res_buckets[i] if res_buckets is not None else None
-            out = _int8_bucket_allreduce(b, live, wire, r_b)
-            if r_b is not None:
-                red, new_r = out
-                new_res_buckets.append(new_r)
-            else:
-                red = out
-            if op == ReduceOp.AVERAGE:
-                red = (red / n).astype(b.dtype)
-            prev = red
-            reduced.append(red)
-            continue
+        r_b = res_buckets[i] if res_buckets is not None else None
+        red, prev, new_r = _reduce_bucket(
+            b, op, compression, wire, int8_wire, live, n, process_set,
+            axis_name, res_bucket=r_b)
         if res_buckets is not None:
-            # non-floating bucket under the int8 wire: full precision,
-            # residual unchanged
-            new_res_buckets.append(res_buckets[i])
-        if int8_wire:
-            # int8 never cast-reduces (an int8 SUM would overflow and
-            # mix per-rank scales): any bucket falling through here —
-            # non-floating, or an eager fallthrough that skipped the
-            # grouped enqueue — moves uncompressed
-            wire_b, ctx = b, None
-        else:
-            wire_b, ctx = compression.compress(b)
-        if op == ReduceOp.ADASUM:
-            if not live:
-                red = wire_b
-            else:
-                red = adasum_allreduce(wire_b, live[0],
-                                       process_set=process_set)
-        else:
-            red = collectives.allreduce(
-                wire_b,
-                op=ReduceOp.SUM if op == ReduceOp.AVERAGE else op,
-                process_set=process_set,
-                axis_name=axis_name,
-                postscale_factor=(1.0 / n) if op == ReduceOp.AVERAGE else 1.0,
-            )
-        prev = red
-        reduced.append(compression.decompress(red, ctx))
+            new_res_buckets.append(new_r)
+        reduced.append(red)
     pm = global_state().parameter_manager
     from ..utils import metrics as _metrics
 
@@ -356,6 +370,65 @@ class _EFState(NamedTuple):
 
     inner: Any
     residual: Any
+
+
+def _ef_row(r, g):
+    """Squeeze one (1, ...) residual row (this device's shard of the
+    world-dim residual) to the leaf shape; raise at the cause when the
+    caller forgot error_feedback_specs."""
+    if (hasattr(r, "ndim") and r.ndim == jnp.ndim(g) + 1
+            and r.shape[0] == 1):
+        return r[0]
+    raise ValueError(
+        "error-feedback residual leaf has shape "
+        f"{getattr(r, 'shape', None)} — expected a (1, ...) row "
+        "per device. Shard the optimizer state in your "
+        "shard_map in_specs with hvd.error_feedback_specs(state)"
+        " so each rank keeps its own residual row."
+    )
+
+
+def _residual_rows(state, grads_template):
+    """This rank's error-feedback residual, squeezed to leaf shapes —
+    or None when `state` carries no residual. Shared by _ef_update and
+    the backward-interleaved scheduler (ops/overlap.py), so the staged
+    quantized collectives consume exactly the rows the monolithic path
+    would."""
+    if isinstance(state, _AccumState):
+        state = state.inner
+    if not isinstance(state, _EFState):
+        return None
+    return jax.tree_util.tree_map(_ef_row, state.residual,
+                                  grads_template)
+
+
+def _staged_apply(staged, state, params, update_inner, **extra):
+    """Consume gradients the backward-interleaved scheduler already
+    reduced (ops/overlap.py StagedGrads): skip this optimizer's own
+    reduction and run the inner update directly. Under error feedback
+    the staged machinery produced the updated residual alongside."""
+    if isinstance(state, _AccumState):
+        raise ValueError(
+            "staged (overlap-scheduled) gradients cannot drive a "
+            "backward_passes_per_step > 1 optimizer — local "
+            "accumulation reduces every k steps, the staged schedule "
+            "reduces every step (docs/overlap.md)")
+    if isinstance(state, _EFState):
+        if staged.new_residual is None:
+            raise ValueError(
+                "staged gradients arrived without an updated "
+                "error-feedback residual; pass opt_state= to the "
+                "staged value_and_grad (docs/overlap.md)")
+        updates, new_inner = update_inner(staged.tree, state.inner,
+                                          params, **extra)
+        return updates, _EFState(new_inner, staged.new_residual)
+    return update_inner(staged.tree, state, params, **extra)
+
+
+def _as_staged(grads):
+    from ..ops.overlap import StagedGrads
+
+    return grads if isinstance(grads, StagedGrads) else None
 
 
 def error_feedback_specs(state, axis_name=None):
@@ -480,19 +553,8 @@ def DistributedOptimizer(
                                               params, **extra)
             return updates, _EFState(new_inner, state.residual)
 
-        def _row(r, g):
-            if (hasattr(r, "ndim") and r.ndim == jnp.ndim(g) + 1
-                    and r.shape[0] == 1):
-                return r[0]
-            raise ValueError(
-                "error-feedback residual leaf has shape "
-                f"{getattr(r, 'shape', None)} — expected a (1, ...) row "
-                "per device. Shard the optimizer state in your "
-                "shard_map in_specs with hvd.error_feedback_specs(state)"
-                " so each rank keeps its own residual row."
-            )
-
-        res_local = jax.tree_util.tree_map(_row, state.residual, grads)
+        res_local = jax.tree_util.tree_map(_ef_row, state.residual,
+                                           grads)
         reduced, new_res = reduce_fn(grads, res_local)
         updates, new_inner = update_inner(reduced, state.inner, params,
                                           **extra)
@@ -500,18 +562,36 @@ def DistributedOptimizer(
             lambda r: r.astype(jnp.float32)[None], new_res)
         return updates, _EFState(new_inner, new_res)
 
+    overlap_info = dict(
+        kind="allreduce", op=op, compression=compression,
+        process_set=process_set, axis_name=axis_name,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        gradient_predivide_factor=gradient_predivide_factor,
+        backward_passes_per_step=backward_passes_per_step,
+        error_feedback=ef,
+    )
+
     if backward_passes_per_step == 1:
 
         def init_fn(params):
             return _maybe_ef_init(params, optimizer.init(params))
 
         def update_fn(grads, state, params=None, **extra):
+            staged = _as_staged(grads)
+            if staged is not None:
+                # the backward-interleaved scheduler already reduced
+                # these inside the backward (ops/overlap.py)
+                return _staged_apply(staged, state, params,
+                                     optimizer.update, **extra)
             if isinstance(state, _EFState):
                 return _ef_update(grads, state, params, optimizer.update,
                                   **extra)
             reduced = reduce_fn(grads)
             return optimizer.update(reduced, state, params, **extra)
 
+        # reduction recipe for the backward-interleaved scheduler
+        # (ops/overlap.py staged_value_and_grad introspects it)
+        update_fn._hvd_overlap_info = overlap_info
         return optax.GradientTransformationExtraArgs(init_fn, update_fn)
 
     # Local aggregation: accumulate k passes locally, reduce once
@@ -528,6 +608,11 @@ def DistributedOptimizer(
         )
 
     def update_fn(grads, state, params=None, **extra):
+        if _as_staged(grads) is not None:
+            raise ValueError(
+                "staged (overlap-scheduled) gradients cannot drive a "
+                "backward_passes_per_step > 1 optimizer "
+                "(docs/overlap.md)")
         acc = jax.tree_util.tree_map(lambda a, g: a + g, state.acc, grads)
         counter = state.counter + 1
         do_sync = counter >= k
@@ -558,6 +643,7 @@ def DistributedOptimizer(
         new_counter = jnp.where(do_sync, 0, counter)
         return updates, _AccumState(new_inner, new_acc, new_counter)
 
+    update_fn._hvd_overlap_info = overlap_info
     return optax.GradientTransformationExtraArgs(init_fn, update_fn)
 
 

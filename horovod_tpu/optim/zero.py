@@ -98,6 +98,34 @@ def _pad_rows(b, n):
     return out.reshape(n, k)
 
 
+def _scatter_bucket(rows, ax, n, wire):
+    """Reduce-scatter one padded (n, k) gradient bucket to this rank's
+    AVERAGED (k,) shard on the configured wire — the shared per-bucket
+    data plane of the monolithic chain (update_fn) and the
+    backward-interleaved scheduler (ops/overlap.py), extracted verbatim
+    so both trace identical collectives."""
+    from .compression import quantized_reduce_scatter_rows, wire_applies
+
+    if wire_applies(wire, rows.dtype) and wire.kind == "int8":
+        # block-quantized exchange; the shard SUM comes back in
+        # f32 and averages exactly like the uncompressed path
+        return (quantized_reduce_scatter_rows(
+            rows, ax, wire.block) / n).astype(rows.dtype)
+    if wire_applies(wire, rows.dtype):
+        return (jax.lax.psum_scatter(
+            rows.astype(wire.wire_dtype).reshape(-1), ax,
+            scatter_dimension=0, tiled=True) / n
+        ).astype(rows.dtype)
+    return jax.lax.psum_scatter(
+        rows.reshape(-1), ax, scatter_dimension=0, tiled=True) / n
+
+
+def _as_staged_shards(grads):
+    from ..ops.overlap import StagedShards
+
+    return grads if isinstance(grads, StagedShards) else None
+
+
 def ShardedOptimizer(optimizer, axis_name=None,
                      fusion_threshold_bytes=None,
                      bucket_backward_order=None,
@@ -135,6 +163,10 @@ def ShardedOptimizer(optimizer, axis_name=None,
     def update_fn(grads, state, params=None, **extra):
         n = _world(axis_name)
         if n <= 1:
+            if _as_staged_shards(grads) is not None:
+                raise RuntimeError(
+                    "staged gradient shards on a size-1 world — the "
+                    "overlap schedule cannot have produced these here")
             return optimizer.update(grads, state, params, **extra)
         if params is None:
             raise ValueError(
@@ -148,48 +180,53 @@ def ShardedOptimizer(optimizer, axis_name=None,
                 "psum_scatter/all_gather)")
         plan = _plan(params, fusion_threshold_bytes,
                      bucket_backward_order)
-        gb, unflatten = _pack(grads, plan)
-        pb, _ = _pack(params, plan)
+        staged = _as_staged_shards(grads)
         from ..core.state import global_state
 
-        ordered = global_state().knobs.ordered_buckets and len(gb) > 1
-        r = jax.lax.axis_index(ax)
+        if staged is not None:
+            r = jax.lax.axis_index(ax)
+            # the backward-interleaved scheduler (ops/overlap.py)
+            # already reduce-scattered each bucket inside the backward;
+            # consume its shards after validating they match THIS
+            # plan's layout (same params + threshold + ordering)
+            pb, unflatten = _pack(params, plan)
+            lens = [int(b.size) for b in pb]
+            g_shards = staged.shards
+            if len(g_shards) != len(lens) or any(
+                    s.shape != (-(-L // n),)
+                    for s, L in zip(g_shards, lens)):
+                raise ValueError(
+                    "staged gradient shards do not match this "
+                    "ShardedOptimizer's bucket layout — the staged "
+                    "value_and_grad must be built from the SAME "
+                    "optimizer (docs/overlap.md)")
+        else:
+            gb, unflatten = _pack(grads, plan)
+            pb, _ = _pack(params, plan)
+            lens = [int(b.size) for b in gb]
+            ordered = (global_state().knobs.ordered_buckets
+                       and len(gb) > 1)
+            r = jax.lax.axis_index(ax)
 
-        # chained per-bucket reduce-scatter: bucket j's collective
-        # depends only on ITS gradients (+ the chain edge), so it
-        # issues while backward for later buckets still computes —
-        # the same structural overlap as optim/distributed.py's
-        # all-reduce chain, asserted in tests/test_zero.py
-        from .compression import (compressor_wire_spec, Compression,
-                                  quantized_reduce_scatter_rows)
+            # chained per-bucket reduce-scatter: bucket j's collective
+            # depends only on ITS gradients (+ the chain edge), so it
+            # issues while backward for later buckets still computes —
+            # the same structural overlap as optim/distributed.py's
+            # all-reduce chain, asserted in tests/test_zero.py
+            from .compression import compressor_wire_spec, Compression
 
-        comp = (Compression.from_knobs() if compression is None
-                else compression)
-        wire = compressor_wire_spec(comp)
+            comp = (Compression.from_knobs() if compression is None
+                    else compression)
+            wire = compressor_wire_spec(comp)
 
-        g_shards, prev = [], None
-        for b in gb:
-            rows = _pad_rows(b, n)
-            if ordered and prev is not None:
-                rows, _ = jax.lax.optimization_barrier((rows, prev))
-            if (wire is not None and wire.kind == "int8"
-                    and jnp.issubdtype(rows.dtype, jnp.floating)):
-                # block-quantized exchange; the shard SUM comes back in
-                # f32 and averages exactly like the uncompressed path
-                s = (quantized_reduce_scatter_rows(
-                    rows, ax, wire.block) / n).astype(rows.dtype)
-            elif (wire is not None
-                    and jnp.issubdtype(rows.dtype, jnp.floating)):
-                s = (jax.lax.psum_scatter(
-                    rows.astype(wire.wire_dtype).reshape(-1), ax,
-                    scatter_dimension=0, tiled=True) / n
-                ).astype(rows.dtype)
-            else:
-                s = jax.lax.psum_scatter(
-                    rows.reshape(-1), ax, scatter_dimension=0,
-                    tiled=True) / n
-            prev = s
-            g_shards.append(s)
+            g_shards, prev = [], None
+            for b in gb:
+                rows = _pad_rows(b, n)
+                if ordered and prev is not None:
+                    rows, _ = jax.lax.optimization_barrier((rows, prev))
+                s = _scatter_bucket(rows, ax, n, wire)
+                prev = s
+                g_shards.append(s)
         p_shards = [
             jax.lax.dynamic_slice_in_dim(
                 _pad_rows(b, n).reshape(-1), r * _k(b, n), _k(b, n))
@@ -230,11 +267,19 @@ def ShardedOptimizer(optimizer, axis_name=None,
             ) else nl,
             new_local, state)
         reduced = [
-            jax.lax.all_gather(s, ax, tiled=True)[: b.size]
-            for s, b in zip(upd_shards, gb)
+            jax.lax.all_gather(s, ax, tiled=True)[: L]
+            for s, L in zip(upd_shards, lens)
         ]
         return unflatten(reduced), new_state
 
+    # reduction recipe for the backward-interleaved scheduler
+    # (ops/overlap.py staged_value_and_grad introspects it)
+    update_fn._hvd_overlap_info = dict(
+        kind="zero", compression=compression, axis_name=axis_name,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        bucket_backward_order=bucket_backward_order,
+        process_set=None, backward_passes_per_step=1,
+    )
     return optax.GradientTransformationExtraArgs(init_fn, update_fn)
 
 

@@ -116,8 +116,114 @@ def make_lm_train_step(
         return params, opt_state, loss
 
     donate_argnums = (0, 1) if donate else ()
+    staged_fn = _maybe_staged_step_fn(
+        model, optimizer, mesh, batch_spec, sequence_parallel, donate)
+    if staged_fn is not None:
+        return init_fn, staged_fn, batch_sharding
     step_fn = jax.jit(step, donate_argnums=donate_argnums)
     return init_fn, step_fn, batch_sharding
+
+
+def _maybe_staged_step_fn(model, optimizer, mesh, batch_spec,
+                          sequence_parallel, donate):
+    """When HOROVOD_OVERLAP_SCHEDULE is active and this step can ride
+    it — an hvd optimizer (DistributedOptimizer/ShardedOptimizer), a
+    pure data-parallel mesh, no sequence parallelism — build the step
+    through the backward-interleaved collective scheduler
+    (ops/overlap.py) inside shard_map over the data axes. Anything the
+    scheduler can't drive falls back to the monolithic auto-pjit step
+    unchanged (bit-for-bit today's trace), so flipping the knob is
+    always safe."""
+    from ..compat import shard_map as _shard_map
+    from ..models.transformer import causal_lm_loss
+    from ..ops import collectives as _coll
+    from ..ops import overlap as overlap_mod
+
+    if sequence_parallel is not None or not overlap_mod.active():
+        return None
+    info = getattr(getattr(optimizer, "update", None),
+                   "_hvd_overlap_info", None)
+    if info is None or overlap_mod.check_supported(info) is not None:
+        return None
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if any(s > 1 for a, s in sizes.items() if a != "dp"):
+        # tp/sp shard activations and fsdp shards params/opt state; the
+        # staged shard_map declares params replicated (in/out P()), so
+        # only a pure data-parallel world can ride it
+        return None
+    axes = data_axes(mesh)
+    if not axes:
+        return None
+    want = _coll._resolve_axis(info.get("axis_name"))
+    if set(want) != set(axes):
+        # the staged collectives must reduce over exactly the axes the
+        # batch is sharded over — a partial reduction would leave
+        # gradients diverging across an unreduced data axis
+        return None
+    n_world = 1
+    for a in want:
+        n_world *= sizes.get(a, 1)
+    if n_world <= 1:
+        return None
+
+    def stages_for(tokens):
+        # weight each shard's mean loss by its share of the global
+        # valid-token count so the AVERAGE-reduced gradients and the
+        # psum/n_world loss below reproduce the monolithic step's
+        # single global mean even when ignore_index padding is uneven
+        # across shards; with equal per-shard counts w == 1.0 exactly
+        # (power-of-two worlds) and the staged values are unchanged
+        # clamp only the global denominator: a zero-valid shard must
+        # contribute weight 0, not inflate the world count by 1
+        c = jnp.sum(tokens[:, 1:] != -1).astype(jnp.float32)
+        w = c * n_world / jnp.maximum(jax.lax.psum(c, want), 1.0)
+
+        def head_loss(logits, _tk=tokens, _w=w):
+            loss, _ = causal_lm_loss(logits, _tk)
+            return loss * _w
+
+        return overlap_mod.transformer_lm_stages(model, tokens,
+                                                 head_loss)
+
+    svag = overlap_mod.staged_value_and_grad(stages_for, opt=optimizer)
+
+    def staged_step(params, opt_state, tokens):
+        import optax
+
+        loss, grads = svag(params, tokens, opt_state=opt_state)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # count-weighted mean of shard means == the monolithic step's
+        # global mean over valid tokens (exact arithmetic; each shard's
+        # loss already carries its w from stages_for)
+        loss = jax.lax.psum(loss, want) / n_world
+        return params, opt_state, loss.reshape(())
+
+    cache = {}
+
+    def step_fn(params, opt_state, tokens):
+        key = jax.tree_util.tree_structure(opt_state)
+        if key not in cache:
+            if info["kind"] == "zero":
+                from ..optim.zero import sharded_state_specs
+
+                state_specs = sharded_state_specs(
+                    opt_state, info.get("axis_name"))
+            else:
+                from ..optim.distributed import error_feedback_specs
+
+                state_specs = error_feedback_specs(
+                    opt_state, info.get("axis_name"))
+            fn = _shard_map(
+                staged_step, mesh=mesh,
+                in_specs=(P(), state_specs, batch_spec),
+                out_specs=(P(), state_specs, P()),
+                check_vma=False)
+            cache[key] = jax.jit(
+                fn, donate_argnums=(0, 1) if donate else ())
+        return cache[key](params, opt_state, tokens)
+
+    return step_fn
 
 
 def _opt_state_shardings(opt_state, params, param_shardings, mesh):

@@ -89,6 +89,15 @@ def parse_args(argv: Optional[List[str]] = None):
                    type=int,
                    help="int8 quantization block (elements per scale, "
                         "HOROVOD_COMPRESSION_BLOCK, default 256)")
+    p.add_argument("--overlap-schedule", dest="overlap_schedule",
+                   choices=["off", "stage", "double"],
+                   help="backward-interleaved collective scheduler "
+                        "(HOROVOD_OVERLAP_SCHEDULE, docs/overlap.md): "
+                        "'stage' issues each fusion bucket's "
+                        "collective inside the backward, pinned before "
+                        "the next segment's compute; 'double' also "
+                        "defers optimizer consumption until the last "
+                        "segment retires; default off")
     p.add_argument("--compression-wire-dtype",
                    dest="compression_wire_dtype",
                    choices=["bfloat16", "float16"])

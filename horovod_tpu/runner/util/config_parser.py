@@ -23,6 +23,7 @@ ARG_TO_ENV = {
     "compression_wire_dtype": "HOROVOD_COMPRESSION_WIRE_DTYPE",
     "compression": "HOROVOD_COMPRESSION",
     "compression_block": "HOROVOD_COMPRESSION_BLOCK",
+    "overlap_schedule": "HOROVOD_OVERLAP_SCHEDULE",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
     "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
     "hierarchical_local_size": "HOROVOD_HIERARCHICAL_LOCAL_SIZE",

@@ -564,6 +564,7 @@ class StepStats:
         self.grad_bytes = 0
         self.wire_logical = 0
         self.wire_sent = 0
+        self.overlap_window = None  # staged-scheduler pin (0..1)
         self.queue_depth = 0
         self.elastic_events: List[str] = []
         self.retries: Dict[str, int] = {}       # point -> count
@@ -596,6 +597,10 @@ class StepStats:
         with self._lock:
             self.wire_logical += int(logical)
             self.wire_sent += int(sent)
+
+    def set_overlap_window(self, frac: float) -> None:
+        with self._lock:
+            self.overlap_window = float(frac)
 
     def add_elastic_event(self, kind: str) -> None:
         with self._lock:
@@ -672,6 +677,8 @@ class StepStats:
                     "logical_bytes": self.wire_logical,
                     "sent_bytes": self.wire_sent,
                 }
+            if self.overlap_window is not None:
+                record["overlap_window_frac"] = self.overlap_window
             if self.retries:
                 record["retries"] = dict(self.retries)
             if self.retry_giveups:
@@ -835,6 +842,22 @@ def record_wire_bytes(logical: int, sent: int) -> None:
         "hvd_wire_bytes_sent_total",
         "Collective payload bytes on the compressed wire").inc(int(sent))
     step_stats.add_wire(int(logical), int(sent))
+
+
+def record_overlap_window(frac: float) -> None:
+    """The backward-interleaved scheduler's per-step overlap pin
+    (ops/overlap.py): the fraction of backward compute the staged
+    schedule forces after the first gradient collective — the lower
+    bound any correct scheduler must grant the overlap window. Only
+    recorded when HOROVOD_OVERLAP_SCHEDULE is active; its absence in
+    the JSONL marks an unscheduled run (docs/overlap.md)."""
+    if not _enabled:
+        return
+    registry.gauge(
+        "hvd_overlap_window_frac",
+        "Backward fraction pinned after the first gradient collective "
+        "by the overlap schedule").set(float(frac))
+    step_stats.set_overlap_window(frac)
 
 
 def record_timeline_activity(activity: str, seconds: float) -> None:

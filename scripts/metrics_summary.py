@@ -121,6 +121,18 @@ def summarize(records):
               f"-> {_human_bytes(wire_sent)} sent "
               f"(ratio {wire_logical / wire_sent:.2f}x)")
 
+    # backward-interleaved collective scheduler (docs/overlap.md):
+    # steps carrying overlap_window_frac ran with the staged schedule
+    ow = [r["overlap_window_frac"] for r in records
+          if "overlap_window_frac" in r]
+    if ow:
+        print(f"overlap: scheduled — pinned window "
+              f"{sum(ow) / len(ow):.2f} of backward behind the first "
+              f"collective ({len(ow)}/{len(records)} steps)")
+    elif grad:
+        print("overlap: unscheduled (HOROVOD_OVERLAP_SCHEDULE off — "
+              "collectives placed at the compiler's discretion)")
+
     hits = sum(r.get("native", {}).get("cache_hits", 0) for r in records)
     n_coll = sum(v[0] for v in coll.values())
     if hits or n_coll:

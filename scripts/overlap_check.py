@@ -19,6 +19,8 @@ Usage:
     python scripts/overlap_check.py --model bert-large --out OVERLAP_r05.json
     python scripts/overlap_check.py --model gpt2-medium --topology v5e:16x16
     python scripts/overlap_check.py --model bert-large --sweep   # order x threshold
+    python scripts/overlap_check.py --schedule-ab --out SCHEDULE_AB_r06.json
+    python scripts/overlap_check.py --schedule-ab --cpu --model tiny --check
 """
 
 import argparse
@@ -27,23 +29,34 @@ import json
 import os
 import re
 import sys
+import time
+
+# the CPU A/B mode (--cpu) runs on an 8-device virtual host mesh; the
+# flag must be in place before any jax backend initializes
+if "--cpu" in sys.argv and "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+if "--cpu" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
 from horovod_tpu.compat import shard_map
 
 
-def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
-               zero=False):
-    """The REAL train step: same model config, loss, optimizer and
-    sharding as the corresponding examples/ benchmark. With ``zero``,
-    the ShardedOptimizer (bucketed reduce-scatter) path instead of the
-    all-reduce path."""
-    import horovod_tpu as hvd
+def _model_pieces(model_name, batch_per_chip):
+    """(cfg, model, loss_of_logits, batch_per_chip) for a benchmark
+    vehicle; loss_of_logits(logits, tok) -> scalar is shared by the
+    monolithic loss and the staged head stage so both trace the same
+    ops."""
     from horovod_tpu.models.transformer import (
         BERT_LARGE, GPT2_MEDIUM, Bert, Transformer, TransformerConfig,
         causal_lm_loss, mlm_loss,
@@ -52,11 +65,9 @@ def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
     if model_name == "bert-large":
         cfg = dataclasses.replace(BERT_LARGE, max_seq_len=512)
         model = Bert(cfg)
-        T = cfg.max_seq_len
         bpc = batch_per_chip or 8
 
-        def loss_fn(p, tok):
-            logits = model.apply({"params": p}, tok)
+        def loss_of_logits(logits, tok):
             loss, _ = mlm_loss(logits, tok, tok % 7 == 0)
             return loss
     elif model_name == "gpt2-medium":
@@ -67,11 +78,9 @@ def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
         cfg = dataclasses.replace(
             GPT2_MEDIUM, max_seq_len=1024, remat=True)
         model = Transformer(cfg)
-        T = cfg.max_seq_len
         bpc = batch_per_chip or 4
 
-        def loss_fn(p, tok):
-            logits = model.apply({"params": p}, tok)
+        def loss_of_logits(logits, tok):
             loss, _ = causal_lm_loss(logits, tok)
             return loss
     elif model_name == "toy":
@@ -79,14 +88,49 @@ def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
             vocab_size=512, num_layers=4, num_heads=8, hidden_size=512,
             max_seq_len=128, dtype=jnp.bfloat16)
         model = Transformer(cfg)
-        T = cfg.max_seq_len
         bpc = batch_per_chip or 2
 
-        def loss_fn(p, tok):
-            logits = model.apply({"params": p}, tok)
+        def loss_of_logits(logits, tok):
             return jnp.mean((logits.astype(jnp.float32) - 1.0) ** 2)
+    elif model_name == "tiny":
+        # MLP-sized vehicle for the CPU schedule-ab gate in
+        # run_all_checks.py: compiles in seconds, still 4 stacked
+        # blocks + tied embeddings (the tied-grad completion edge the
+        # scheduler must respect)
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=4, num_heads=2, hidden_size=32,
+            max_seq_len=16, dtype=jnp.float32)
+        model = Transformer(cfg)
+        bpc = batch_per_chip or 2
+
+        def loss_of_logits(logits, tok):
+            loss, _ = causal_lm_loss(logits, tok)
+            return loss
     else:
         raise ValueError(model_name)
+    return cfg, model, loss_of_logits, bpc
+
+
+def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
+               zero=False, schedule="off", compression=None):
+    """The REAL train step: same model config, loss, optimizer and
+    sharding as the corresponding examples/ benchmark. With ``zero``,
+    the ShardedOptimizer (bucketed reduce-scatter) path instead of the
+    all-reduce path. ``schedule`` != "off" reroutes the backward
+    through the backward-interleaved collective scheduler
+    (hvd.overlap, docs/overlap.md); "off" is byte-for-byte the
+    monolithic trace. ``compression`` names a wire ("int8", "bf16");
+    None keeps the knob default."""
+    import horovod_tpu as hvd
+
+    cfg, model, loss_of_logits, bpc = _model_pieces(
+        model_name, batch_per_chip)
+    T = cfg.max_seq_len
+
+    def loss_fn(p, tok):
+        return loss_of_logits(model.apply({"params": p}, tok), tok)
+
+    comp = hvd.Compression.lookup(compression) if compression else None
 
     toks_s = jax.ShapeDtypeStruct((bpc * nchips, T), jnp.int32)
     params = jax.eval_shape(
@@ -94,19 +138,40 @@ def build_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
                            jnp.ones((1, T), jnp.int32)))["params"]
     if zero:
         opt = hvd.ShardedOptimizer(
-            optax.adamw(1e-4), fusion_threshold_bytes=fusion_mb << 20)
+            optax.adamw(1e-4),
+            fusion_threshold_bytes=int(fusion_mb * (1 << 20)),
+            compression=comp)
     else:
         opt = hvd.DistributedOptimizer(
-            optax.adamw(1e-4), fusion_threshold_bytes=fusion_mb << 20)
+            optax.adamw(1e-4),
+            fusion_threshold_bytes=int(fusion_mb * (1 << 20)),
+            compression=comp)
     state = jax.eval_shape(lambda: opt.init(jax.tree.map(
         lambda s: jnp.zeros(s.shape, s.dtype), params)))
-    state_specs = hvd.sharded_state_specs(state) if zero else P()
+    if zero:
+        state_specs = hvd.sharded_state_specs(state)
+    else:
+        state_specs = hvd.error_feedback_specs(state)
 
-    def step(p, s, b):
-        l, g = jax.value_and_grad(loss_fn)(p, b)
-        upd, s = opt.update(g, s, p)
-        return optax.apply_updates(p, upd), s, jax.lax.psum(
-            l, "hvd").reshape(1)
+    if schedule != "off":
+        # the head loss closes over the batch, so stages rebuild per
+        # traced batch value
+        svag = hvd.overlap.staged_value_and_grad(
+            lambda b: hvd.overlap.transformer_lm_stages(
+                model, b, lambda lg, _b=b: loss_of_logits(lg, _b)),
+            opt=opt, mode=schedule)
+
+        def step(p, s, b):
+            l, g = svag(p, b, opt_state=s)
+            upd, s = opt.update(g, s, p)
+            return optax.apply_updates(p, upd), s, jax.lax.psum(
+                l, "hvd").reshape(1)
+    else:
+        def step(p, s, b):
+            l, g = jax.value_and_grad(loss_fn)(p, b)
+            upd, s = opt.update(g, s, p)
+            return optax.apply_updates(p, upd), s, jax.lax.psum(
+                l, "hvd").reshape(1)
 
     js = jax.jit(shard_map(
         step, mesh=mesh, in_specs=(P(), state_specs, P("hvd")),
@@ -127,7 +192,7 @@ def _ar_elems(line):
     return n
 
 
-def analyze(txt, collective="all-reduce"):
+def analyze(txt, collective="all-reduce", min_elems: int = 10_000):
     """Schedule + dependency analysis of an optimized
     (is_scheduled=true) module, restricted to the ENTRY computation so
     fusion-body instructions don't pollute the counts.
@@ -154,9 +219,9 @@ def analyze(txt, collective="all-reduce"):
     lines = all_lines[start:]
     coll_re = rf' {collective}(-start)?\('
     ars = [i for i, l in enumerate(lines)
-           if re.search(coll_re, l) and _ar_elems(l) >= 10_000]
+           if re.search(coll_re, l) and _ar_elems(l) >= min_elems]
     small_ars = [i for i, l in enumerate(lines)
-                 if re.search(coll_re, l) and _ar_elems(l) < 10_000]
+                 if re.search(coll_re, l) and _ar_elems(l) < min_elems]
     bwd = [i for i, l in enumerate(lines)
            if "op_name=" in l and "transpose" in l
            and re.search(r' (dot|fusion|convolution|custom-call)\(', l)]
@@ -201,11 +266,147 @@ def analyze(txt, collective="all-reduce"):
     }
 
 
+_PAT_LHS = re.compile(r'^\s*%?([\w.-]+) = ')
+_PAT_CALLS = re.compile(r'(?:to_apply|calls)=%?([\w.-]+)')
+
+
+def _split_computations(txt):
+    """Pre-opt HLO text → {computation name: body lines}. Computation
+    headers sit at column 0 and end with '{'; bodies are indented and
+    close with a column-0 '}'."""
+    comps, name, body = {}, None, []
+    for line in txt.splitlines():
+        if not line.startswith(" ") and line.rstrip().endswith("{"):
+            head = line.strip().rstrip("{").strip()
+            if head.startswith("ENTRY "):
+                head = head[len("ENTRY "):]
+            name = head.split(" ")[0].split("(")[0].lstrip("%")
+            body = comps.setdefault(name, [])
+        elif line.startswith("}"):
+            name = None
+        elif name is not None:
+            body.append(line)
+    return comps
+
+
+def _comp_dot_counts(comps):
+    """Per-computation dot/convolution count INCLUDING transitively
+    called computations (remat bodies are calls in the pre-opt module,
+    and their dots are the rematerialized backward compute)."""
+    own = {}
+    calls = {}
+    for name, body in comps.items():
+        own[name] = sum(1 for l in body
+                        if re.search(r' (dot|convolution)\(', l))
+        cs = set()
+        for l in body:
+            cs.update(_PAT_CALLS.findall(l))
+        calls[name] = cs
+    memo = {}
+
+    def total(name, visiting=()):
+        if name in memo:
+            return memo[name]
+        if name in visiting or name not in own:
+            return 0
+        t = own[name] + sum(total(c, visiting + (name,))
+                            for c in calls[name])
+        memo[name] = t
+        return t
+
+    return own, calls, total
+
+
+def analyze_preopt(txt, min_elems: int = 10_000):
+    """Structural analysis of the PRE-optimization HLO: how much
+    compute sits in the first gradient all-reduce's transitive
+    CONSUMER closure. Those ops must schedule after the collective
+    under ANY correct scheduler — the forced-overlap proof that
+    survives pipelines whose barrier expander erases
+    optimization_barrier post-opt (XLA CPU), where the scheduled-module
+    window is unreadable. With the backward-interleaved schedule the
+    closure holds the later backward segments (dots_pinned ≫ 0); the
+    monolithic chain's closure holds only barrier/update arithmetic
+    (dots_pinned == 0). Analysis runs inside the computation holding
+    the gradient collectives (the shard_map body), following
+    to_apply/calls edges so remat'd backward dots count."""
+    comps = _split_computations(txt)
+    own_dots, _calls, total_dots = _comp_dot_counts(comps)
+
+    def _grad_ars(body):
+        # all-reduce (plain), reduce-scatter (ZeRO), all-to-all (the
+        # int8 quantized wire's first exchange leg)
+        return [i for i, l in enumerate(body)
+                if re.search(r' (all-reduce|reduce-scatter|all-to-all)\(',
+                             l)
+                and _ar_elems(l) >= min_elems]
+
+    # the computation carrying the gradient collectives
+    best, ars = None, []
+    for name, body in comps.items():
+        a = _grad_ars(body)
+        if len(a) > len(ars):
+            best, ars = name, a
+    out = {
+        "gradient_all_reduces": len(ars),
+        "opt_barriers": 0,
+        "dots_total": 0,
+        "dots_pinned_after_first_all_reduce": 0,
+        "pinned_dot_frac": 0.0,
+    }
+    if best is None:
+        return out
+    body = comps[best]
+    out["opt_barriers"] = sum(1 for l in body if " opt-barrier(" in l)
+    dots_total = total_dots(best)
+    out["dots_total"] = dots_total
+    if not dots_total:
+        return out
+    defs, cons_of = {}, {}
+    for i, l in enumerate(body):
+        m = _PAT_LHS.match(l)
+        if not m:
+            continue
+        defs[m.group(1)] = i
+        # operand references: pre-opt instruction names are
+        # `word.number` tokens (Arg_67.1374, dot.1763, call.1703);
+        # to_apply=region targets match too but never resolve to an
+        # instruction def, so they add no edges
+        for ref in re.findall(r'([A-Za-z_][\w-]*\.\d+)',
+                              l.split(" = ", 1)[1]):
+            cons_of.setdefault(ref, []).append(i)
+    # consumer closure of the first gradient collective
+    names_by_line = {v: k for k, v in defs.items()}
+    seen = {ars[0]}
+    stack = [ars[0]]
+    while stack:
+        i = stack.pop()
+        name = names_by_line.get(i)
+        if name is None:
+            continue
+        for c in cons_of.get(name, ()):
+            if c not in seen:
+                seen.add(c)
+                stack.append(c)
+    pinned = 0
+    for i in sorted(seen):
+        l = body[i]
+        if re.search(r' (dot|convolution)\(', l):
+            pinned += 1
+        for callee in _PAT_CALLS.findall(l):
+            pinned += total_dots(callee)
+    out["dots_pinned_after_first_all_reduce"] = pinned
+    out["pinned_dot_frac"] = round(pinned / dots_total, 4)
+    return out
+
+
 def compile_and_analyze(model, mesh, nchips, fusion_mb, batch_per_chip,
-                        zero=False):
+                        zero=False, schedule="off", compression=None,
+                        preopt=False, min_elems=10_000):
     js, params, state, toks_s = build_step(
-        model, mesh, nchips, fusion_mb, batch_per_chip, zero=zero)
-    txt = js.lower(params, state, toks_s).compile().as_text()
+        model, mesh, nchips, fusion_mb, batch_per_chip, zero=zero,
+        schedule=schedule, compression=compression)
+    low = js.lower(params, state, toks_s)
     # the ZeRO path's gradient collectives are per-bucket
     # reduce-scatters in the lowered program, but this XLA TPU build
     # decomposes reduce-scatter into all-reduce + slice in the
@@ -213,7 +414,12 @@ def compile_and_analyze(model, mesh, nchips, fusion_mb, batch_per_chip,
     # all-reduces), so the schedule analysis reads all-reduces for
     # both paths; the post-update all-gathers are a separate op name
     # and never pollute the count
-    return analyze(txt)
+    r = analyze(low.compile().as_text(), min_elems=min_elems)
+    if preopt:
+        r["preopt"] = analyze_preopt(
+            low.compiler_ir(dialect="hlo").as_hlo_text(),
+            min_elems=min_elems)
+    return r
 
 
 _NOTE = (
@@ -228,6 +434,201 @@ _NOTE = (
     "observable overlap property."
 )
 
+_AB_NOTE = (
+    "schedule A/B: off = monolithic backward (today's trace, "
+    "bit-for-bit); on = backward-interleaved collective scheduler "
+    "(HOROVOD_OVERLAP_SCHEDULE, hvd.overlap) — backward traced in "
+    "fusion-bucket-aligned segments, each bucket's collective issued "
+    "at its availability boundary and pinned before the next "
+    "segment's compute through the inter-segment cotangent. "
+    "preopt.dots_pinned... counts compute in the first gradient "
+    "collective's transitive CONSUMER closure in the unoptimized "
+    "module: a dependency ANY correct scheduler must respect, so "
+    "pinned_dot_frac lower-bounds the achievable window on every "
+    "backend (including ones whose barrier expander hides the "
+    "post-opt evidence). step_time_ms rows appear only in --cpu mode "
+    "(AOT programs for v5e cannot execute here)."
+)
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def _cpu_exec_ab(model, mesh, nchips, fusion_mb, batch_per_chip, zero,
+                 schedule, compression, steps=4):
+    """Execute off/on steps on the CPU host mesh: bitwise parity of one
+    step + median wall step time for each mode."""
+    import numpy as np
+
+    cfg, m, _, bpc = _model_pieces(model, batch_per_chip)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (bpc * nchips, cfg.max_seq_len)),
+        jnp.int32)
+    out = {}
+    results = {}
+    for mode_name, sched in (("off", "off"), ("on", schedule)):
+        js, params_s, state_s, _ = build_step(
+            model, mesh, nchips, fusion_mb, batch_per_chip, zero=zero,
+            schedule=sched, compression=compression)
+        m2 = _model_pieces(model, batch_per_chip)[1]
+        params = m2.init(jax.random.PRNGKey(0), toks[:1])["params"]
+        import horovod_tpu as hvd
+        comp = hvd.Compression.lookup(compression) if compression else None
+        if zero:
+            opt = hvd.ShardedOptimizer(
+                optax.adamw(1e-4),
+                fusion_threshold_bytes=int(fusion_mb * (1 << 20)),
+                compression=comp)
+        else:
+            opt = hvd.DistributedOptimizer(
+                optax.adamw(1e-4),
+                fusion_threshold_bytes=int(fusion_mb * (1 << 20)),
+                compression=comp)
+        state = opt.init(params)
+        r = js(params, state, toks)
+        jax.block_until_ready(r)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            r2 = js(params, state, toks)
+            jax.block_until_ready(r2)
+            times.append(time.perf_counter() - t0)
+        results[mode_name] = r
+        out[f"step_time_ms_{mode_name}"] = round(_median(times) * 1e3, 2)
+    leaves_a = jax.tree_util.tree_leaves(results["off"][0])
+    leaves_b = jax.tree_util.tree_leaves(results["on"][0])
+    out["params_bitwise_equal"] = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(leaves_a, leaves_b))
+    out["loss_bitwise_equal"] = bool(np.array_equal(
+        np.asarray(results["off"][2]), np.asarray(results["on"][2])))
+    out["step_time_delta_frac"] = round(
+        (out["step_time_ms_on"] - out["step_time_ms_off"])
+        / max(out["step_time_ms_off"], 1e-9), 4)
+    return out
+
+
+def schedule_ab(args):
+    """--schedule-ab: scheduled-vs-unscheduled A/B over the benchmark
+    matrix into one JSON artifact (the 8th run_all_checks gate drives
+    the --cpu --check form)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.core.state import global_state
+
+    mode = hvd.overlap.normalize_mode(args.overlap_schedule or "stage")
+    if mode == "off":
+        raise SystemExit(
+            "--schedule-ab compares an active schedule against off; "
+            "pass --overlap-schedule stage|double (or omit it)")
+    paths = []
+    for p in args.paths.split(","):
+        p = p.strip()
+        if p == "plain":
+            paths.append(("allreduce", False, None))
+        elif p == "zero":
+            paths.append(("zero", True, None))
+        elif p == "int8":
+            paths.append(("allreduce+int8", False, "int8"))
+        elif p in ("bf16", "fp16"):
+            paths.append((f"allreduce+{p}", False, p))
+        elif p == "zero-int8":
+            paths.append(("zero+int8", True, "int8"))
+        else:
+            raise SystemExit(f"unknown --paths entry {p!r}")
+
+    if args.cpu:
+        hvd.shutdown()
+        hvd.init()
+        mesh = hvd.mesh()
+        nchips = len(jax.devices())
+        topo_name = f"cpu host mesh ({nchips} devices)"
+    else:
+        from jax.experimental import topologies
+
+        topology = args.topology.split(",")[0]
+        topo = topologies.get_topology_desc(
+            topology_name=topology, platform="tpu")
+        nchips = len(topo.devices)
+        mesh = topologies.make_mesh(topo, (nchips,), ("hvd",))
+        hvd.shutdown()
+        hvd.init(mesh=mesh)
+        topo_name = f"{topology} ({nchips} chips, AOT)"
+
+    rows = []
+    failures = []
+    for model in args.model.split(","):
+        for path_name, zero, wire in paths:
+            row = {
+                "model": model, "optimizer": path_name,
+                "wire": wire or "none", "schedule_mode": mode,
+                "topology": topo_name, "fusion_mb": args.fusion_mb,
+            }
+            t0 = time.perf_counter()
+            # small vehicles' buckets sit under the 10k-element
+            # gradient-AR floor real models use
+            min_elems = 256 if model in ("tiny", "toy") else 10_000
+            off = compile_and_analyze(
+                model, mesh, nchips, args.fusion_mb,
+                args.batch_per_chip, zero=zero, schedule="off",
+                compression=wire, preopt=True,
+                min_elems=min_elems)
+            on = compile_and_analyze(
+                model, mesh, nchips, args.fusion_mb,
+                args.batch_per_chip, zero=zero, schedule=mode,
+                compression=wire, preopt=True,
+                min_elems=min_elems)
+            row["off"] = off
+            row["on"] = on
+            row["window_delta"] = round(
+                on["overlap_window_frac"] - off["overlap_window_frac"],
+                4)
+            row["compile_wall_s"] = round(time.perf_counter() - t0, 1)
+            if args.cpu:
+                row["exec"] = _cpu_exec_ab(
+                    model, mesh, nchips, args.fusion_mb,
+                    args.batch_per_chip, zero, mode, wire)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+            if args.check:
+                pin_on = on.get("preopt", {}).get(
+                    "dots_pinned_after_first_all_reduce", 0)
+                pin_off = off.get("preopt", {}).get(
+                    "dots_pinned_after_first_all_reduce", 0)
+                if pin_on <= 0:
+                    failures.append(
+                        f"{model}/{path_name}: schedule-on pins no "
+                        f"backward compute behind the first collective")
+                if pin_off != 0:
+                    failures.append(
+                        f"{model}/{path_name}: schedule-off "
+                        f"unexpectedly pins compute ({pin_off} dots) — "
+                        f"off is no longer today's trace")
+                if args.cpu and not (
+                        row["exec"]["params_bitwise_equal"]
+                        and row["exec"]["loss_bitwise_equal"]):
+                    failures.append(
+                        f"{model}/{path_name}: schedule on/off params "
+                        f"or loss NOT bitwise equal")
+
+    doc = {"note": _AB_NOTE, "schedule_mode": mode, "runs": rows}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    if args.check:
+        if failures:
+            for fmsg in failures:
+                print("schedule-ab check FAILED:", fmsg)
+            return 1
+        print(f"schedule-ab check OK: {len(rows)} A/B rows, "
+              f"bitwise parity + pinned structure hold"
+              + (f", artifact {args.out}" if args.out else ""))
+    return 0
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
@@ -237,22 +638,46 @@ def main(argv=None):
                          "(8 chips) or v5e:16x16 (256 chips - the "
                          "BASELINE scale)")
     ap.add_argument("--model", default="bert-large",
-                    help="comma list of: toy, bert-large, gpt2-medium")
-    ap.add_argument("--fusion-mb", type=int, default=128,
-                    help="fusion threshold (default = the knob default)")
+                    help="comma list of: toy, tiny, bert-large, "
+                         "gpt2-medium")
+    ap.add_argument("--fusion-mb", type=float, default=128,
+                    help="fusion threshold in MB; fractions allowed "
+                         "for the small A/B vehicles (default = the "
+                         "knob default)")
     ap.add_argument("--batch-per-chip", type=int, default=0)
     ap.add_argument("--zero", action="store_true",
                     help="analyze the ShardedOptimizer (ZeRO-1 bucketed "
                          "reduce-scatter) step instead of all-reduce")
+    ap.add_argument("--overlap-schedule", default="",
+                    choices=["", "off", "stage", "double"],
+                    help="trace the step through the backward-"
+                         "interleaved collective scheduler "
+                         "(hvd.overlap, docs/overlap.md)")
+    ap.add_argument("--schedule-ab", action="store_true",
+                    help="scheduled-vs-unscheduled A/B over --model x "
+                         "--paths into one artifact (--out)")
+    ap.add_argument("--paths", default="plain,zero,int8",
+                    help="--schedule-ab optimizer paths: plain, zero, "
+                         "int8, bf16, zero-int8")
+    ap.add_argument("--cpu", action="store_true",
+                    help="run the A/B on the 8-device virtual CPU host "
+                         "mesh (executes steps: bitwise parity + step "
+                         "times) instead of AOT-compiling for v5e")
+    ap.add_argument("--check", action="store_true",
+                    help="gate mode for --schedule-ab: exit nonzero "
+                         "unless parity + pinned structure hold")
     ap.add_argument("--sweep", action="store_true",
                     help="bucket order x fusion threshold table instead "
                          "of a single artifact")
     args = ap.parse_args(argv)
 
-    from jax.experimental import topologies
-
     import horovod_tpu as hvd
     from horovod_tpu.core.state import global_state
+
+    if args.schedule_ab:
+        return schedule_ab(args)
+
+    from jax.experimental import topologies
 
     rows = []
     for topology in args.topology.split(","):
@@ -287,7 +712,8 @@ def main(argv=None):
         for model in args.model.split(","):
             r = compile_and_analyze(
                 model, mesh, nchips, args.fusion_mb,
-                args.batch_per_chip, zero=args.zero)
+                args.batch_per_chip, zero=args.zero,
+                schedule=args.overlap_schedule or "off")
             r.update({
                 "optimizer": "zero" if args.zero else "allreduce",
                 "model": model,
@@ -295,6 +721,7 @@ def main(argv=None):
                 "fusion_mb": args.fusion_mb,
                 "bucket_backward_order": knobs.bucket_backward_order,
                 "ordered_buckets_knob": knobs.ordered_buckets,
+                "overlap_schedule": args.overlap_schedule or "off",
             })
             rows.append(r)
             print(json.dumps(r), flush=True)
@@ -307,4 +734,4 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -22,7 +22,12 @@ locally before the full pytest tier:
   peer's replica through the recovery ladder);
 * ``compression`` — ``scripts/compression_check.py`` (world-2 loopback
   compressed data plane: int8 wire-byte ratio >= 3.5x, bf16 ~2x, and
-  HOROVOD_COMPRESSION=none bitwise-exact parity).
+  HOROVOD_COMPRESSION=none bitwise-exact parity);
+* ``overlap`` — ``scripts/overlap_check.py --schedule-ab --cpu`` on the
+  MLP-sized ``tiny`` vehicle (backward-interleaved scheduler: schedule
+  on/off bitwise parity over plain + ZeRO + int8, and the staged mode
+  provably pins backward compute behind the first gradient
+  collective).
 
 Usage:
     python scripts/run_all_checks.py [--only NAME ...] [--skip NAME ...]
@@ -157,6 +162,26 @@ def check_compression():
     ])
 
 
+def check_overlap():
+    """Schedule-on/off A/B on the CPU host mesh: bitwise parity + the
+    pinned-dependency structure (the 8th gate; the v5e AOT numbers come
+    from the same script without --cpu)."""
+    env = _env()
+    if "xla_force_host_platform_device_count" not in env.get(
+            "XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    with tempfile.TemporaryDirectory(prefix="hvd_overlap_") as d:
+        return _run([
+            sys.executable, os.path.join(_SCRIPTS, "overlap_check.py"),
+            "--schedule-ab", "--cpu", "--check", "--model", "tiny",
+            "--fusion-mb", "0.02",
+            "--out", os.path.join(d, "SCHEDULE_AB.json"),
+        ], env=env)
+
+
 GATES = [
     ("metrics", check_metrics),
     ("chaos", check_chaos),
@@ -165,6 +190,7 @@ GATES = [
     ("flight", check_flight),
     ("recovery", check_recovery),
     ("compression", check_compression),
+    ("overlap", check_overlap),
 ]
 
 

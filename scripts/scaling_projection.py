@@ -73,6 +73,11 @@ def main(argv=None):
                          "newest in repo root)")
     ap.add_argument("--overlap", default="OVERLAP_r05.json",
                     help="overlap artifact for the hideable fraction")
+    ap.add_argument("--schedule-artifact", default="",
+                    help="SCHEDULE_AB_*.json from overlap_check.py "
+                         "--schedule-ab: its measured scheduled window "
+                         "replaces the unscheduled one in a second "
+                         "projection (default: newest in repo root)")
     ap.add_argument("--out", default="SCALING_PROJECTION_r05.json")
     args = ap.parse_args(argv)
 
@@ -114,6 +119,39 @@ def main(argv=None):
                     f"{r.get('overlappable_frac')})")
                 break
 
+    # measured scheduled-vs-unscheduled windows (overlap_check.py
+    # --schedule-ab). Both windows are MEASURED inputs now — the
+    # unscheduled one replaces the former hard-coded 0.256, and the
+    # backward-interleaved schedule's window drives a second projection.
+    overlap_sched = None
+    sched_src = "none (schedule A/B artifact not found)"
+    sched_path = args.schedule_artifact
+    if not sched_path:
+        cands = sorted(f for f in os.listdir(root)
+                       if f.startswith("SCHEDULE_AB_")
+                       and f.endswith(".json"))
+        sched_path = os.path.join(root, cands[-1]) if cands else ""
+    if sched_path and os.path.exists(sched_path):
+        with open(sched_path) as f:
+            ab = json.load(f)
+        for r in ab.get("runs", []):
+            if (r.get("model") == "bert-large"
+                    and r.get("optimizer") == "allreduce"):
+                off_w = float(
+                    r.get("off", {}).get("overlap_window_frac", 0.0))
+                overlap_sched = float(
+                    r.get("on", {}).get("overlap_window_frac", 0.0))
+                overlap_frac = off_w  # measured, replaces OVERLAP row
+                overlap_src = (
+                    f"{os.path.basename(sched_path)}: measured "
+                    f"unscheduled window {off_w}")
+                sched_src = (
+                    f"{os.path.basename(sched_path)}: measured "
+                    f"scheduled window {overlap_sched} "
+                    f"(HOROVOD_OVERLAP_SCHEDULE="
+                    f"{ab.get('schedule_mode', 'stage')})")
+                break
+
     out = {
         "what": "analytic DP scaling projection over the v5e 2D torus "
                 "(all-ICI at 16x16 = 256 chips; no DCN hop)",
@@ -124,6 +162,7 @@ def main(argv=None):
             "ici_bytes_per_sec_per_link": ICI_GBPS_PER_LINK,
             "bench_source": os.path.basename(bench_path),
             "overlap_source": overlap_src,
+            "overlap_scheduled_source": sched_src,
             "wire_dtype": "float32 (no compression; bf16 wire would "
                           "halve G)",
         },
@@ -135,28 +174,33 @@ def main(argv=None):
                            "GPUs); BASELINE target >=90% at 256 chips",
     }
 
+    def _model_block(step_s, g):
+        block = {
+            "step_ms_per_chip": round(step_s * 1e3, 2),
+            "grad_bytes": int(g),
+            "projection": [project(step_s, g, overlap_frac, n)
+                           for n in (8, 32, 64, 256)],
+        }
+        if overlap_sched is not None:
+            # same roofline, the backward-interleaved scheduler's
+            # measured window in place of the unscheduled one
+            block["projection_scheduled"] = [
+                project(step_s, g, overlap_sched, n)
+                for n in (8, 32, 64, 256)]
+        return block
+
     # resnet50
     rate = float(bench["value"]) if MODELS["resnet50"]["rate_is_top"] \
         else float(extra[MODELS["resnet50"]["rate_key"]])
     step_s = MODELS["resnet50"]["batch_per_chip"] / rate
-    g = MODELS["resnet50"]["params"] * 4
-    out["models"]["resnet50"] = {
-        "step_ms_per_chip": round(step_s * 1e3, 2),
-        "grad_bytes": int(g),
-        "projection": [project(step_s, g, overlap_frac, n)
-                       for n in (8, 32, 64, 256)],
-    }
+    out["models"]["resnet50"] = _model_block(
+        step_s, MODELS["resnet50"]["params"] * 4)
 
     # bert-large
     rate = float(extra[MODELS["bert-large"]["rate_key"]])
     step_s = MODELS["bert-large"]["batch_tokens_per_chip"] / rate
-    g = MODELS["bert-large"]["params"] * 4
-    out["models"]["bert-large"] = {
-        "step_ms_per_chip": round(step_s * 1e3, 2),
-        "grad_bytes": int(g),
-        "projection": [project(step_s, g, overlap_frac, n)
-                       for n in (8, 32, 64, 256)],
-    }
+    out["models"]["bert-large"] = _model_block(
+        step_s, MODELS["bert-large"]["params"] * 4)
 
     txt = json.dumps(out, indent=1)
     print(txt)

@@ -149,6 +149,321 @@ def test_tpu_schedule_overlap_window_on_real_bert():
     assert a["overlappable_frac"] >= 0.85, a
 
 
+# ---------------------------------------------------------------------------
+# Backward-interleaved collective scheduler (HOROVOD_OVERLAP_SCHEDULE,
+# ops/overlap.py, docs/overlap.md)
+# ---------------------------------------------------------------------------
+
+TINY = TransformerConfig(
+    vocab_size=64, num_layers=2, num_heads=2, hidden_size=32,
+    max_seq_len=16, dtype=jnp.float32,
+)
+_TINY_THRESH = 8 << 10
+
+
+def _tiny_steps(staged, zero=False, compression=None, mode="stage",
+                metrics_on=False):
+    """(jitted step, params, state, tokens) for the tiny vehicle —
+    staged (schedule on) or monolithic (off, today's trace)."""
+    import optax
+
+    from horovod_tpu.models.transformer import causal_lm_loss
+
+    m = Transformer(TINY)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, TINY.vocab_size, (16, 16)),
+        jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), toks[:2])["params"]
+    if zero:
+        opt = hvd.ShardedOptimizer(
+            optax.adamw(1e-3), fusion_threshold_bytes=_TINY_THRESH,
+            compression=compression)
+    else:
+        opt = hvd.DistributedOptimizer(
+            optax.adamw(1e-3), fusion_threshold_bytes=_TINY_THRESH,
+            compression=compression)
+    state = opt.init(params)
+    specs = (hvd.sharded_state_specs(state) if zero
+             else hvd.error_feedback_specs(state))
+
+    def loss_fn(p, b):
+        return causal_lm_loss(m.apply({"params": p}, b), b)[0]
+
+    if staged:
+        svag = hvd.overlap.staged_value_and_grad(
+            lambda b: hvd.overlap.transformer_lm_stages(
+                m, b, lambda lg, _b=b: causal_lm_loss(lg, _b)[0]),
+            opt=opt, mode=mode)
+
+        def step(p, s, b):
+            l, g = svag(p, b, opt_state=s)
+            upd, s2 = opt.update(g, s, p)
+            import optax as _ox
+
+            return _ox.apply_updates(p, upd), s2, jax.lax.psum(
+                l, "hvd").reshape(1)
+    else:
+        def step(p, s, b):
+            l, g = jax.value_and_grad(loss_fn)(p, b)
+            upd, s2 = opt.update(g, s, p)
+            import optax as _ox
+
+            return _ox.apply_updates(p, upd), s2, jax.lax.psum(
+                l, "hvd").reshape(1)
+
+    js = jax.jit(shard_map(
+        step, mesh=hvd.mesh(), in_specs=(P(), specs, P("hvd")),
+        out_specs=(P(), specs, P()), check_vma=False))
+    return js, params, state, toks
+
+
+def _bitwise(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)))
+
+
+@pytest.mark.parametrize("zero,wire", [
+    (False, None),          # plain all-reduce
+    (True, None),           # ZeRO reduce-scatter
+    # int8's quantized collectives compile ~3x slower on the 1-core
+    # box; the run_all_checks `overlap` gate also asserts this parity,
+    # so the pytest variant rides the slow tier (tier-1 budget,
+    # PR-1/5/8 precedent)
+    pytest.param(False, "int8", marks=pytest.mark.slow),
+], ids=["plain", "zero", "int8-ef"])
+def test_staged_schedule_bitwise_parity(hvd8, zero, wire):
+    """The knob's numerics contract: schedule on vs off is BITWISE
+    identical — params, optimizer state (incl. the error-feedback
+    residual rows), and loss — so the schedule can never drift
+    training. The staged forward reuses the monolithic path's flax
+    blocks and the staged collectives reuse the monolithic per-bucket
+    reduce (`optim.distributed._reduce_bucket` /
+    `optim.zero._scatter_bucket`), which is what makes this hold
+    exactly rather than approximately."""
+    comp = hvd.Compression.int8 if wire == "int8" else None
+    js_off, params, s_off, toks = _tiny_steps(False, zero, comp)
+    js_on, _, s_on, _ = _tiny_steps(True, zero, comp)
+    out_off = js_off(params, s_off, toks)
+    out_on = js_on(params, s_on, toks)
+    assert _bitwise(out_off[0], out_on[0]), "params diverged"
+    assert _bitwise(out_off[1], out_on[1]), "optimizer state diverged"
+    assert _bitwise(out_off[2], out_on[2]), "loss diverged"
+
+
+def test_staged_schedule_pins_backward_compute(hvd8):
+    """The schedule property itself, on the pre-optimization module
+    (where the barrier edges live regardless of backend): with the
+    schedule ON the first gradient collective's transitive CONSUMER
+    closure contains backward matmuls — a dependency every scheduler
+    must respect — while the monolithic chain pins none (its barriers
+    only order collective-to-collective)."""
+    import sys
+
+    sys.path.insert(0, str(_REPO_ROOT))
+    from scripts.overlap_check import analyze_preopt
+
+    for staged, expect_pinned in ((True, True), (False, False)):
+        js, params, state, toks = _tiny_steps(staged)
+        hlo = js.lower(params, state, toks).compiler_ir(
+            dialect="hlo").as_hlo_text()
+        r = analyze_preopt(hlo, min_elems=256)
+        assert r["gradient_all_reduces"] >= 3, r
+        if expect_pinned:
+            assert r["dots_pinned_after_first_all_reduce"] > 0, r
+            assert r["pinned_dot_frac"] >= 0.2, r
+        else:
+            assert r["dots_pinned_after_first_all_reduce"] == 0, r
+
+
+def test_bucket_issue_schedule_bookkeeping():
+    """Pure availability bookkeeping (ops/fusion.bucket_issue_schedule):
+    buckets issue at the first backward step where every leaf has ALL
+    its contributions — a tied leaf (two stages) completes only at its
+    last stage."""
+    from horovod_tpu.ops.fusion import bucket_issue_schedule
+
+    # leaves: 0 head-only, 1 mid, 2 tied (stages 0 and 2)
+    plans = [[(0, 0, 4, (4,))], [(1, 0, 4, (4,))], [(2, 0, 4, (4,))]]
+    leaf_stages = [[2], [1], [0, 2]]
+    sched = bucket_issue_schedule(plans, leaf_stages, [2, 1, 0])
+    assert sched == [[0], [1], [2]]
+    # a leaf contributed by a stage that never runs backward -> loud
+    with pytest.raises(ValueError, match="never complete"):
+        bucket_issue_schedule(plans, [[2], [5], [0, 2]], [2, 1, 0])
+
+
+def test_staged_unsupported_configs_raise(hvd8):
+    """Configs the scheduler can't drive fail at build time with a
+    pointer to the docs, not deep in a trace."""
+    import optax
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                   backward_passes_per_step=2)
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        hvd.overlap.staged_value_and_grad(lambda b: [], opt=opt)
+    opt2 = hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum)
+    with pytest.raises(ValueError, match="SUM/AVERAGE"):
+        hvd.overlap.staged_value_and_grad(lambda b: [], opt=opt2)
+    with pytest.raises(ValueError, match="overlap metadata"):
+        hvd.overlap.staged_value_and_grad(lambda b: [],
+                                          opt=optax.sgd(0.1))
+
+
+def test_overlap_mode_normalization():
+    from horovod_tpu.ops.overlap import normalize_mode
+
+    assert normalize_mode("") == "off"
+    assert normalize_mode("0") == "off"
+    assert normalize_mode("1") == "stage"
+    assert normalize_mode("on") == "stage"
+    assert normalize_mode("stage") == "stage"
+    assert normalize_mode("double") == "double"
+    with pytest.raises(ValueError, match="overlap schedule"):
+        normalize_mode("bogus")
+    from horovod_tpu.core.knobs import Knobs
+
+    assert Knobs().overlap_schedule == "off"
+
+
+@pytest.mark.slow  # scheduling-edge variant; numerics already gated by
+# the parity matrix above and the run_all_checks overlap gate
+def test_staged_double_mode_parity(hvd8):
+    """The double-buffered variant (deferred optimizer consumption)
+    keeps the same numerics — only scheduling edges differ."""
+    js_off, params, s_off, toks = _tiny_steps(False)
+    js_dbl, _, s_dbl, _ = _tiny_steps(True, mode="double")
+    out_off = js_off(params, s_off, toks)
+    out_dbl = js_dbl(params, s_dbl, toks)
+    assert _bitwise(out_off[0], out_dbl[0])
+    assert _bitwise(out_off[2], out_dbl[2])
+
+
+def test_overlap_window_gauge_and_jsonl(hvd8, tmp_path):
+    """hvd_overlap_window_frac: recorded per executed step when the
+    schedule is active, absent otherwise (the scheduled/unscheduled
+    discriminator metrics_summary.py prints)."""
+    from horovod_tpu.utils import metrics
+
+    path = str(tmp_path / "m.jsonl")
+    metrics.enable()
+    metrics.step_stats.open_log(path)
+    try:
+        js, params, state, toks = _tiny_steps(True)
+        with metrics.step():
+            jax.block_until_ready(js(params, state, toks))
+        snap = metrics.registry.snapshot()
+        gauge = snap.get("hvd_overlap_window_frac")
+        assert gauge, sorted(snap)
+        assert 0.0 < list(gauge.values())[0] <= 1.0, gauge
+    finally:
+        metrics.step_stats.close_log()
+        metrics.reset()
+    import json as _json
+
+    recs = [_json.loads(l) for l in open(path)]
+    assert recs and "overlap_window_frac" in recs[0]
+    assert 0.0 < recs[0]["overlap_window_frac"] <= 1.0
+
+
+def test_make_lm_train_step_staged_matches_manual(hvd8):
+    """parallel/train.make_lm_train_step reroutes through the staged
+    scheduler on a pure-dp mesh when the knob is on (an hvd optimizer
+    + HOROVOD_OVERLAP_SCHEDULE=stage), and one training step matches a
+    hand-built shard_map step over the same mesh exactly. With the
+    knob off (or a plain optax optimizer) the monolithic auto-pjit
+    path is taken unchanged."""
+    import optax
+
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.models.transformer import causal_lm_loss
+    from horovod_tpu.parallel.mesh import make_mesh
+    from horovod_tpu.parallel.train import (_maybe_staged_step_fn,
+                                            make_lm_train_step)
+
+    dp_mesh = make_mesh(dp=8)
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.1), axis_name="dp",
+        fusion_threshold_bytes=_TINY_THRESH)
+    toks = jnp.asarray(
+        np.random.RandomState(1).randint(0, TINY.vocab_size, (16, 16)),
+        jnp.int32)
+    m = Transformer(TINY)
+    params = m.init(jax.random.PRNGKey(0), toks[:2])["params"]
+    state = opt.init(params)
+
+    knobs = global_state().knobs
+    old = knobs.overlap_schedule
+    knobs.overlap_schedule = "stage"
+    try:
+        # knob on + hvd optimizer -> the staged path engages...
+        init_fn, step_fn, _ = make_lm_train_step(TINY, opt, dp_mesh)
+        assert _maybe_staged_step_fn(
+            m, opt, dp_mesh, P("dp"), None, True) is not None
+        # ...and a plain optax optimizer still falls back
+        assert _maybe_staged_step_fn(
+            m, optax.sgd(0.1), dp_mesh, P("dp"), None, True) is None
+
+        # hand-built monolithic shard_map step over the same mesh/axis
+        # (run FIRST: the staged step_fn donates params/state)
+        def loss_fn(p, b):
+            return causal_lm_loss(m.apply({"params": p}, b), b)[0]
+
+        def ref_step(p, s, b):
+            l, g = jax.value_and_grad(loss_fn)(p, b)
+            upd, s2 = opt.update(g, s, p)
+            return (optax.apply_updates(p, upd), s2,
+                    (jax.lax.psum(l, ("dp",)) / 8).reshape(()))
+
+        js = jax.jit(shard_map(
+            ref_step, mesh=dp_mesh, in_specs=(P(), P(), P("dp")),
+            out_specs=(P(), P(), P()), check_vma=False))
+        p_ref, s_ref, loss_ref = js(params, state, toks)
+        jax.block_until_ready(p_ref)
+
+        p_on, s_on, loss_on = step_fn(params, state, toks)
+    finally:
+        knobs.overlap_schedule = old
+    assert _maybe_staged_step_fn(
+        m, opt, dp_mesh, P("dp"), None, True) is None  # knob off
+    assert _bitwise(p_ref, p_on)
+    np.testing.assert_allclose(np.asarray(loss_ref),
+                               np.asarray(loss_on), rtol=1e-6)
+
+
+@pytest.mark.slow  # BERT-Large AOT compile x2: ~10 min of XLA time
+def test_tpu_scheduled_window_on_real_bert_plain_and_zero():
+    """Acceptance floors for the backward-interleaved scheduler on the
+    REAL v5e schedule (SCHEDULE_AB_r06.json measured 0.9098 plain and
+    0.8902 ZeRO vs 0.2564 / 0.0157 unscheduled): >= 0.5 on the plain
+    all-reduce path and >= 0.15 on the ZeRO path — the 16x ZeRO
+    collapse is repaired, not just narrowed."""
+    try:
+        mesh = _tpu_topology_mesh()
+    except Exception as e:  # no TPU client in this environment
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    import sys
+
+    sys.path.insert(0, str(_REPO_ROOT))
+    from scripts.overlap_check import analyze, build_step
+
+    hvd.shutdown()
+    hvd.init(mesh=mesh)
+    try:
+        for zero, floor in ((False, 0.5), (True, 0.15)):
+            js, params, state, toks_s = build_step(
+                "bert-large", mesh, 8, 128, 0, zero=zero,
+                schedule="stage")
+            txt = js.lower(params, state, toks_s).compile().as_text()
+            a = analyze(txt)
+            assert a["scheduled"]
+            assert a["bucket_all_reduces_in_optimized_hlo"] >= 2, a
+            assert a["overlap_window_frac"] >= floor, (zero, a)
+    finally:
+        hvd.shutdown()
+
+
 @pytest.mark.slow  # GPT-2-medium AOT compile: minutes of XLA time
 def test_tpu_schedule_overlap_window_on_gpt2_medium():
     """Level 2 for the causal half of the transformer pair. GPT-2's
