@@ -121,6 +121,7 @@ from . import callbacks  # noqa: F401
 from .ops import overlap  # noqa: F401  (hvd.overlap.staged_value_and_grad)
 from .utils import faults  # noqa: F401
 from .utils import metrics  # noqa: F401
+from .utils import prof  # noqa: F401  (hvd.prof.set_step_flops, summary)
 from .checkpoint import LoadedModel, load_model, save_model  # noqa: F401
 from . import data  # noqa: F401
 from . import elastic  # noqa: F401

@@ -234,6 +234,14 @@ def init(
 
         flight.configure(st.knobs)
 
+        # continuous step profiler (utils/prof.py): registers the
+        # sampled-capture step wrapper with metrics.step() when
+        # HOROVOD_PROF_EVERY asks for it. After flight so the sidecar
+        # metadata sees the resolved rank + driver sink.
+        from ..utils import prof
+
+        prof.configure(st.knobs)
+
         # fault injection (utils/faults.py): the module already armed
         # itself from the env at import (worker processes need that);
         # an explicitly-knobbed spec re-compiles here so HVD_TPU_
@@ -329,8 +337,9 @@ def shutdown() -> None:
             st.eager_runtime.shutdown()
         if st.timeline is not None:
             st.timeline.close()
-        from ..utils import flight, metrics
+        from ..utils import flight, metrics, prof
 
+        prof.on_shutdown()  # before metrics: joins an in-flight parse
         metrics.on_shutdown()
         flight.on_shutdown()
         from ..elastic import replication

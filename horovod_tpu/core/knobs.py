@@ -224,6 +224,19 @@ class Knobs:
     # one rank-labeled cluster scrape (docs/metrics.md). 0 = no push.
     metrics_push_interval_s: float = 5.0
 
+    # --- continuous step profiler (utils/prof.py, docs/timeline.md) ---
+    # sample every N-th hvd.metrics.step() with jax.profiler device
+    # tracing, parse the xplane off-thread (utils/xplane.py) and export
+    # compute/exposed-wire/idle attribution + measured overlap gauges.
+    # 0 = off (the per-step hook is a single predicted branch).
+    prof_every: int = 0
+    # sample-capture root; "" = <tmpdir>/hvd_prof/rank<r>
+    prof_dir: str = ""
+    # duty-cycle bound on measured profiling overhead (capture + parse
+    # CPU): after a sample costing T the next waits T*(1/d - 1), the
+    # PR-6 replicator's model
+    prof_duty_cycle: float = 0.02
+
     # --- flight recorder (utils/flight.py, docs/flight.md) ---
     # bounded ring of control-plane events, dumped on stall abort /
     # executor error / SIGTERM / SIGUSR2 / crash and shipped to the
@@ -333,6 +346,9 @@ class Knobs:
             metrics_push_interval_s=_env_float(
                 "METRICS_PUSH_INTERVAL_S", 5.0
             ),
+            prof_every=_env_int("PROF_EVERY", 0),
+            prof_dir=_env("PROF_DIR", "") or "",
+            prof_duty_cycle=_env_float("PROF_DUTY_CYCLE", 0.02),
             flight_recorder=_env_bool("FLIGHT_RECORDER", True),
             flight_dir=_env("FLIGHT_DIR", "") or "",
             flight_capacity=_env_int("FLIGHT_CAPACITY", 4096),

@@ -173,6 +173,19 @@ def parse_args(argv: Optional[List[str]] = None):
                         "on-disk state snapshot; a restarted driver "
                         "pointed at the same directory resumes the "
                         "same job on the same port (docs/recovery.md).")
+    p.add_argument("--prof-every", dest="prof_every", type=int,
+                   help="Continuous step profiler: sample every N-th "
+                        "step with device tracing and export compute/"
+                        "exposed-wire/idle attribution + hvd_mfu "
+                        "(0 = off; docs/timeline.md).")
+    p.add_argument("--prof-dir", dest="prof_dir",
+                   help="Root directory for sampled profiler captures "
+                        "(default <tmpdir>/hvd_prof/rank<r>); feed it "
+                        "to scripts/trace_merge.py.")
+    p.add_argument("--prof-duty-cycle", dest="prof_duty_cycle",
+                   type=float,
+                   help="Cap on the fraction of wall time the sampled "
+                        "profiler may consume (default 0.02).")
     p.add_argument("--flight-recorder", dest="flight_recorder",
                    action="store_const", const="1", default=None,
                    help="Force the control-plane flight recorder on in "

@@ -49,6 +49,9 @@ ARG_TO_ENV = {
     # --no-flight-recorder stores "0" for the same reason
     "flight_recorder": "HOROVOD_FLIGHT_RECORDER",
     "flight_dir": "HOROVOD_FLIGHT_DIR",
+    "prof_every": "HOROVOD_PROF_EVERY",
+    "prof_dir": "HOROVOD_PROF_DIR",
+    "prof_duty_cycle": "HOROVOD_PROF_DUTY_CYCLE",
     "log_level": "HOROVOD_LOG_LEVEL",
     "mesh": "HOROVOD_MESH",
 }

@@ -28,14 +28,25 @@ metrics registry (docs/metrics.md).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Callable, List, Optional
 
 import numpy as np
 
-from ..utils import faults, metrics
+from ..utils import faults, flight, metrics
+from ..utils.timeline import active_timeline
+from . import tracing
 from .engine import serving_knobs
+
+SERVING_EXEC = "SERVING_EXEC"  # timeline activity around a batch run
+
+# per-batcher timeline span key: two batchers in one process (in-process
+# replicas, loopback tests) must not overwrite each other's open span
+# in the shared Timeline table — same collision server.py's request
+# span suffix guards against
+_batcher_seq = itertools.count(1)
 
 
 class QueueFull(RuntimeError):
@@ -51,8 +62,8 @@ class RequestTimeout(TimeoutError):
 
 
 class _Pending:
-    __slots__ = ("x", "n", "enqueue_t", "deadline_t", "_event",
-                 "_result", "_error")
+    __slots__ = ("x", "n", "enqueue_t", "deadline_t", "req_id",
+                 "_event", "_result", "_error")
 
     def __init__(self, x: np.ndarray, enqueue_t: float,
                  deadline_t: Optional[float]):
@@ -60,6 +71,10 @@ class _Pending:
         self.n = x.shape[0]
         self.enqueue_t = enqueue_t
         self.deadline_t = deadline_t
+        # trace id bound by the HTTP handler (serving/tracing.py);
+        # carried on the pending because the worker thread that
+        # executes the batch runs outside the request's context
+        self.req_id = tracing.current_request_id()
         self._event = threading.Event()
         self._result: Optional[np.ndarray] = None
         self._error: Optional[BaseException] = None
@@ -114,6 +129,7 @@ class DynamicBatcher:
             default_timeout_s = knobs.serving_request_timeout_seconds
         self._default_timeout_s = float(default_timeout_s)
         self._clock = clock
+        self._span_key = f"serving_batch#{next(_batcher_seq)}"
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: List[_Pending] = []
@@ -251,6 +267,10 @@ class DynamicBatcher:
             live: List[_Pending] = []
             for p in batch:
                 if p.deadline_t is not None and now > p.deadline_t:
+                    if p.req_id:
+                        flight.record("serving_timeout", p.req_id,
+                                      queued_s=round(
+                                          now - p.enqueue_t, 4))
                     p.set_error(RequestTimeout(
                         f"request expired after {now - p.enqueue_t:.3f}s "
                         "in the admission queue"))
@@ -261,12 +281,33 @@ class DynamicBatcher:
                 continue
             x = (live[0].x if len(live) == 1
                  else np.concatenate([p.x for p in live], axis=0))
+            # batch-level trace: which request ids rode this executable
+            # run — the hop that lets a slow /v1/predict be followed
+            # from its SERVING_REQUEST span into the batch that served
+            # it (docs/timeline.md). Assembly gated so the off state
+            # stays one branch per batch.
+            tl = active_timeline()
+            ids = ([p.req_id for p in live if p.req_id]
+                   if (tl is not None or flight.enabled()) else [])
+            if ids:
+                flight.record("serving_batch", ids[0],
+                              ids=ids, n=int(x.shape[0]))
+            if tl is not None:
+                tl.activity_start(self._span_key, SERVING_EXEC,
+                                  args={"ids": ids,
+                                        "n": int(x.shape[0])})
             try:
                 y = self._run(x)
             except BaseException as e:
+                if ids:
+                    flight.record("serving_batch_error", ids[0],
+                                  ids=ids, error=str(e)[:120])
                 for p in live:
                     p.set_error(e)
                 continue
+            finally:
+                if tl is not None:
+                    tl.activity_end(self._span_key, SERVING_EXEC)
             off = 0
             for p in live:
                 p.set_result(np.asarray(y)[off:off + p.n])

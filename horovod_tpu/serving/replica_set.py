@@ -44,8 +44,9 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..utils import faults, flight, metrics, retry
+from . import tracing
 from .batcher import RequestTimeout
-from .server import AUTH_HEADER, ServingServer, sign_body
+from .server import AUTH_HEADER, REQUEST_ID_HEADER, ServingServer, sign_body
 
 SERVING_KIND = "serving"
 
@@ -63,13 +64,18 @@ def _build_body(x: np.ndarray,
 
 
 def _post_body(addr: str, body: bytes, sock_timeout: float,
-               key: Optional[bytes] = None) -> np.ndarray:
+               key: Optional[bytes] = None,
+               request_id: str = "") -> np.ndarray:
     req = urllib.request.Request(
         f"http://{addr}/v1/predict", data=body, method="POST",
         headers={"Content-Type": "application/json"},
     )
     if key is not None:
         req.add_header(AUTH_HEADER, sign_body(key, body))
+    if request_id:
+        # the front door's trace id travels to the replica, so both
+        # tiers' flight/timeline events name the SAME request
+        req.add_header(REQUEST_ID_HEADER, request_id)
     with urllib.request.urlopen(req, timeout=sock_timeout) as resp:
         payload = json.loads(resp.read())
     return np.asarray(payload["outputs"],
@@ -204,6 +210,7 @@ class ReplicaSet:
         # serialize once; every failover attempt reuses the bytes
         body = _build_body(x, timeout_s)
         deadline = retry.Deadline(timeout_s)
+        rid = tracing.current_request_id()
 
         def _attempt() -> np.ndarray:
             if deadline.expired():
@@ -214,7 +221,8 @@ class ReplicaSet:
                     f"request budget {timeout_s}s exhausted during "
                     f"dispatch/failover")
             idx, addr = self._pick()
-            flight.record("serving_dispatch", str(idx), n=int(x.shape[0]))
+            flight.record("serving_dispatch", str(idx),
+                          n=int(x.shape[0]), req=rid)
             try:
                 faults.inject("serving.dispatch", replica=idx)
                 remaining = max(deadline.remaining(), 0.5)
@@ -226,7 +234,8 @@ class ReplicaSet:
                 att = (remaining / 2.0 if len(self._replicas) > 1
                        else remaining)
                 att = max(att, 0.5)
-                return _post_body(addr, body, att + 1.0, key=self._key)
+                return _post_body(addr, body, att + 1.0, key=self._key,
+                                  request_id=rid)
             except BaseException as e:
                 if _ejects_replica(e):
                     self._mark_dead(idx, e)

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import itertools
 import json
 import threading
 import time
@@ -39,11 +40,21 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
-from ..utils import metrics
+from ..utils import flight, metrics
+from ..utils.timeline import active_timeline
+from . import tracing
 from .batcher import Draining, QueueFull, RequestTimeout
 
 AUTH_HEADER = "X-Hvd-Auth"
+REQUEST_ID_HEADER = tracing.REQUEST_ID_HEADER
 MAX_BODY_BYTES = 64 << 20  # one request can't swallow the heap
+SERVING_REQUEST = "SERVING_REQUEST"  # timeline activity, tid = request id
+
+# timeline span keys get a process-unique suffix: the request id is
+# client-controlled, and two concurrent requests reusing one id would
+# collide in the open-span table (wrong phase latency) and interleave
+# B/E pairs on one trace track. "rid#7" still matches a search for rid.
+_span_seq = itertools.count(1)
 
 
 def sign_body(key: bytes, body: bytes) -> str:
@@ -54,6 +65,7 @@ def sign_body(key: bytes, body: bytes) -> str:
 
 class _ServingHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    _request_id = ""  # set per predict request; echoed on the reply
 
     # -- helpers ------------------------------------------------------------
 
@@ -62,6 +74,11 @@ class _ServingHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        if self._request_id:
+            # the client (or the front door retrying on its behalf)
+            # gets the trace id back — it names this request in the
+            # flight ring, the timeline and the merged trace
+            self.send_header(REQUEST_ID_HEADER, self._request_id)
         if self.close_connection:
             # tell HTTP/1.1 keep-alive clients the stream ends here
             # (set on paths that left request bytes unread, e.g. 413)
@@ -95,6 +112,17 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self._reply_json(404, {"error": "not found"})
             return
         t0 = time.perf_counter()
+        # request trace id: the client's X-Request-Id (sanitized) or a
+        # fresh one — bound to this handler thread's context so the
+        # batcher/dispatch tier downstream stamp the same id into their
+        # flight + timeline events (serving/tracing.py)
+        rid = tracing.sanitize(self.headers.get(REQUEST_ID_HEADER, ""))
+        self._request_id = rid
+        rid_token = tracing.set_request_id(rid)
+        span = f"{rid}#{next(_span_seq)}"
+        tl = active_timeline()
+        if tl is not None:
+            tl.activity_start(span, SERVING_REQUEST, args={"id": rid})
         # count ourselves in-flight BEFORE touching the body: body
         # read + parse of a large request takes real time, and drain()
         # must not report empty (and let SIGTERM os._exit) while a
@@ -169,9 +197,16 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 self._finish(code, resp, t0)
             finally:
                 srv._inflight_delta(-1)
+                if tl is not None:
+                    tl.activity_end(span, SERVING_REQUEST)
+                tracing.reset_request_id(rid_token)
+                self._request_id = ""
 
     def _finish(self, code: int, resp: Dict, t0: float) -> None:
-        metrics.record_serving_request(time.perf_counter() - t0, code)
+        dt = time.perf_counter() - t0
+        metrics.record_serving_request(dt, code)
+        flight.record("serving_request", self._request_id,
+                      code=code, ms=round(dt * 1e3, 3))
         self._reply_json(code, resp)
 
 

@@ -1,0 +1,60 @@
+"""Per-request trace ids for the serving tier.
+
+Every ``POST /v1/predict`` carries one id end-to-end: accepted from the
+client's ``X-Request-Id`` header (or minted at the front end), stamped
+into the handler thread's context, picked up by the dynamic batcher at
+admission, forwarded by the dispatch tier to the chosen replica, and
+recorded into the timeline (``SERVING_REQUEST`` / ``SERVING_EXEC``
+spans keyed by the id) and the flight recorder (``serving_request`` /
+``serving_batch`` / ``serving_dispatch`` events). A slow or failed
+request is then one grep — or one highlighted track in the merged
+Perfetto trace (``scripts/trace_merge.py``, docs/timeline.md) — away
+from the batch, replica and device window that served it.
+
+Propagation is a ``contextvars.ContextVar``: the HTTP handler sets it
+for the duration of the request, so everything on the synchronous call
+path (batcher admission, replica dispatch) reads it without plumbing a
+parameter through every signature; the batcher's worker thread runs
+outside that context and therefore carries the id on the pending
+request object instead.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import re
+import uuid
+
+REQUEST_ID_HEADER = "X-Request-Id"
+
+_request_id: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "hvd_serving_request_id", default="")
+
+_UNSAFE = re.compile(r"[^A-Za-z0-9._:\-]")
+_MAX_LEN = 64
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+def sanitize(rid: str) -> str:
+    """A usable id from a client-supplied header value: length-bounded,
+    shell/json/label-safe charset; empty or all-unsafe input gets a
+    fresh id (a client must not be able to blank out tracing)."""
+    rid = _UNSAFE.sub("", (rid or "").strip()[:_MAX_LEN])
+    return rid or new_request_id()
+
+
+def set_request_id(rid: str):
+    """Bind the id to the current context; returns the reset token."""
+    return _request_id.set(rid)
+
+
+def reset_request_id(token) -> None:
+    _request_id.reset(token)
+
+
+def current_request_id() -> str:
+    """The id bound to this context ('' outside a traced request)."""
+    return _request_id.get()

@@ -1,1 +1,1 @@
-from . import flight, logging, metrics, timeline  # noqa: F401
+from . import flight, logging, metrics, mfu, timeline  # noqa: F401

@@ -184,6 +184,13 @@ def _clock_probe() -> dict:
         return {}
 
 
+def clock_probe() -> dict:
+    """Public form of the dump-time clock probe (utils/prof.py sidecar
+    metadata, analyzer tooling): the offset mapping this rank's wall
+    clock onto the driver's, or {} with no reachable sink."""
+    return _clock_probe()
+
+
 _push_policy = None
 _push_outage = None
 

@@ -565,6 +565,8 @@ class StepStats:
         self.wire_logical = 0
         self.wire_sent = 0
         self.overlap_window = None  # staged-scheduler pin (0..1)
+        self.mfu = None             # model-FLOPs utilization (0..1)
+        self.attribution = None     # sampled device attribution dict
         self.queue_depth = 0
         self.elastic_events: List[str] = []
         self.retries: Dict[str, int] = {}       # point -> count
@@ -601,6 +603,19 @@ class StepStats:
     def set_overlap_window(self, frac: float) -> None:
         with self._lock:
             self.overlap_window = float(frac)
+
+    def set_mfu(self, mfu: float) -> None:
+        with self._lock:
+            self.mfu = float(mfu)
+
+    def set_attribution(self, attribution: dict) -> None:
+        """Latest sampled-step device attribution (utils/prof.py). The
+        sample parses asynchronously, so it lands in the record of the
+        step interval during which parsing finished — the record's
+        ``attribution.sampled_step`` names the step actually
+        measured."""
+        with self._lock:
+            self.attribution = dict(attribution)
 
     def add_elastic_event(self, kind: str) -> None:
         with self._lock:
@@ -679,6 +694,10 @@ class StepStats:
                 }
             if self.overlap_window is not None:
                 record["overlap_window_frac"] = self.overlap_window
+            if self.mfu is not None:
+                record["mfu"] = self.mfu
+            if self.attribution is not None:
+                record["attribution"] = self.attribution
             if self.retries:
                 record["retries"] = dict(self.retries)
             if self.retry_giveups:
@@ -723,18 +742,50 @@ class StepStats:
 step_stats = StepStats()
 
 
+# -- step wrapper hook (the continuous profiler rides step()) ---------------
+#
+# utils/prof.py registers an object with begin_step()/end_step(token)
+# here, so ``with hvd.metrics.step():`` is the single user-visible step
+# boundary for BOTH per-step stats and sampled device profiling — no
+# second context manager to adopt. None (the default) costs one load +
+# is-None check per step.
+
+_step_wrapper = None
+
+
+def set_step_wrapper(wrapper) -> None:
+    """Install/remove (None) the step wrapper. ``wrapper.begin_step()``
+    runs before the step body (returning an opaque token),
+    ``wrapper.end_step(token)`` after it but BEFORE the StepStats
+    record closes — anything it pushes into ``step_stats`` lands in
+    the current step's JSONL record."""
+    global _step_wrapper
+    _step_wrapper = wrapper
+
+
 @contextlib.contextmanager
 def step(extra: Optional[dict] = None):
     """Mark one training step: ``with hvd.metrics.step(): step_fn(...)``.
-    No-ops entirely when metrics are disabled and no step log is open."""
-    if not _enabled:
+    No-ops entirely when metrics are disabled, no step log is open and
+    no step wrapper (sampled profiler) is installed."""
+    # snapshot both gates once: a concurrent enable()/disable()/reset()
+    # mid-step must not split a begin from its end (lost JSONL record /
+    # bogus zero-length step)
+    w = _step_wrapper
+    en = _enabled
+    if not en and w is None:
         yield step_stats
         return
-    step_stats.begin_step()
+    token = w.begin_step() if w is not None else None
+    if en:
+        step_stats.begin_step()
     try:
         yield step_stats
     finally:
-        step_stats.end_step(extra)
+        if w is not None:
+            w.end_step(token)
+        if en:
+            step_stats.end_step(extra)
 
 
 # ---------------------------------------------------------------------------
@@ -858,6 +909,60 @@ def record_overlap_window(frac: float) -> None:
         "Backward fraction pinned after the first gradient collective "
         "by the overlap schedule").set(float(frac))
     step_stats.set_overlap_window(frac)
+
+
+def record_mfu(mfu: float) -> None:
+    """Model-FLOPs utilization for the step just closed: declared model
+    FLOPs / (step time x chips x peak chip FLOP/s) — utils/mfu.py peak
+    tables, computed by the continuous profiler (utils/prof.py) once
+    ``hvd.prof.set_step_flops`` declared the model's per-step cost."""
+    if not _enabled:
+        return
+    registry.gauge(
+        "hvd_mfu",
+        "Model-FLOPs utilization of the last completed step").set(
+            float(mfu))
+    step_stats.set_mfu(mfu)
+
+
+def record_step_attribution(attribution: dict) -> None:
+    """One sampled-step device attribution (utils/prof.py →
+    utils/xplane.attribute): where the step's wall time went —
+    compute, EXPOSED collective wire (collective time not hidden under
+    compute), idle. ``measured_overlap_frac`` is the measured twin of
+    the structural ``hvd_overlap_window_frac`` pin (docs/overlap.md):
+    structural says how much overlap the schedule permits, this says
+    how much the device actually achieved."""
+    if not _enabled:
+        return
+    if "compute_frac" in attribution:
+        registry.gauge(
+            "hvd_step_compute_frac",
+            "Compute fraction of the last sampled step's wall time",
+        ).set(float(attribution["compute_frac"]))
+    if "exposed_wire_frac" in attribution:
+        registry.gauge(
+            "hvd_step_exposed_wire_frac",
+            "Exposed (un-overlapped) collective fraction of the last "
+            "sampled step's wall time",
+        ).set(float(attribution["exposed_wire_frac"]))
+    if "idle_frac" in attribution:
+        registry.gauge(
+            "hvd_step_idle_frac",
+            "Device-idle fraction of the last sampled step's wall "
+            "time").set(float(attribution["idle_frac"]))
+    overlap = attribution.get("measured_overlap_frac")
+    # -1 = the sampled window held no collectives (overlap undefined);
+    # leaving the previous sample's value would pair a stale overlap
+    # with this sample's fresh compute/exposed/idle gauges
+    registry.gauge(
+        "hvd_overlap_window_measured_frac",
+        "Measured overlapped share of collective time in the last "
+        "sampled step (1.0 = wire fully hidden under compute; -1 = no "
+        "collectives in the sample; the measured twin of "
+        "hvd_overlap_window_frac)",
+    ).set(-1.0 if overlap is None else float(overlap))
+    step_stats.set_attribution(attribution)
 
 
 def record_timeline_activity(activity: str, seconds: float) -> None:
@@ -1362,6 +1467,7 @@ def reset() -> None:
     return to the disabled state."""
     global _configured, _push_policy, _push_outage
     _push_policy = _push_outage = None
+    set_step_wrapper(None)
     on_shutdown()
     disable()
     _configured = False

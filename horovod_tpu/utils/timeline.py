@@ -50,6 +50,12 @@ MEMCPY_OUT_FUSION_BUFFER = "MEMCPY_OUT_FUSION_BUFFER"
 XLA_COLLECTIVE = "XLA_COLLECTIVE"
 CYCLE_START = "CYCLE_START"
 
+# First event of every trace: maps the file's relative microsecond axis
+# onto the wall clock (and names the emitting rank), so
+# scripts/trace_merge.py can place per-rank host timelines, device op
+# lines and flight events on ONE aligned axis (docs/timeline.md).
+CLOCK_ANCHOR = "CLOCK_ANCHOR"
+
 
 class Timeline:
     """Chrome trace event JSON writer with a background writer thread.
@@ -84,6 +90,20 @@ class Timeline:
             target=self._writer, name="hvd_tpu_timeline", daemon=True
         )
         self._thread.start()
+        self._emit_anchor()
+
+    def _emit_anchor(self) -> None:
+        """The wall-clock anchor: an instant whose args carry the unix
+        time of its own ``ts`` stamp plus this process's rank, letting
+        offline tooling convert every event's relative microseconds to
+        wall time (wall = time_unix + (ts - anchor_ts)/1e6)."""
+        from . import flight as _flight
+
+        self.emit("i", CLOCK_ANCHOR, "clock", {
+            "time_unix": time.time(),
+            "rank": _flight.rank(),
+            "pid": os.getpid(),
+        })
 
     def stop(self) -> None:
         if not self._active:

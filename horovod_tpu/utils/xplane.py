@@ -1,0 +1,594 @@
+"""XPlane device-trace parsing: the library behind the unified profiler.
+
+``jax.profiler.trace`` leaves an ``.xplane.pb`` protobuf (the XLA
+profiler's XSpace container) under ``<logdir>/plugins/profile/...``.
+Until PR 10 the only reader was ``scripts/xplane_summary.py``, which
+hard-imported ``tensorflow.tsl.profiler.protobuf.xplane_pb2`` — a whole
+TensorFlow just to decode six message types. This module owns the
+parsing with **no TF dependency**: a minimal protobuf varint decoder
+covering exactly the XSpace schema (``xplane.proto``), cross-checked
+field-for-field against the TF proto when TF happens to be installed
+(tests/test_prof.py).
+
+Three layers:
+
+* **decode** — :func:`load_xspace` / :func:`find_xplane_pbs`: the raw
+  ``XSpace``/``XPlane``/``XLine``/``XEvent`` tree as plain dataclasses;
+* **extract** — :func:`op_events`: the device-op timeline flattened to
+  ``{name, cat, start_us, dur_us, plane, line}`` dicts, selecting the
+  XLA op lines on TPU/GPU device planes (skipping ``Async`` DMA lines,
+  which run concurrently and would double-book the device) and, on the
+  CPU backend, the ``tf_XLATfrtCpuClient`` execution-thread lines — so
+  loopback test worlds exercise the same pipeline as real TPU runs;
+* **attribute** — :func:`attribute`: interval arithmetic over the op
+  spans → compute / collective / **exposed** collective (collective
+  time not overlapped by compute — the wire time a training step
+  actually pays) / idle, the measured counterpart of the structural
+  overlap-window bound from ops/overlap.py (docs/overlap.md).
+
+``utils/prof.py`` drives this per sampled step; ``scripts/
+xplane_summary.py`` and ``scripts/trace_merge.py`` are the CLIs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import struct
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class XPlaneUnavailable(RuntimeError):
+    """No parseable ``.xplane.pb`` where one was expected — the message
+    says what to do (was the profiler actually started? did the run
+    point at the right logdir?)."""
+
+
+# ---------------------------------------------------------------------------
+# minimal protobuf wire-format decoder (varint + length-delimited),
+# covering the XSpace schema only. Field numbers transcribed from
+# tensorflow/tsl/profiler/protobuf/xplane.proto and verified against the
+# TF-generated parser on real captures (tests/test_prof.py).
+# ---------------------------------------------------------------------------
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+        if s > 70:
+            raise ValueError("varint overflow (corrupt xplane?)")
+
+
+def _fields(buf: bytes, start: int = 0, end: Optional[int] = None):
+    """Yield (field_number, wire_type, value) triples of one message."""
+    i, stop = start, len(buf) if end is None else end
+    while i < stop:
+        tag, i = _varint(buf, i)
+        fn, wt = tag >> 3, tag & 7
+        if wt == 0:  # varint
+            v, i = _varint(buf, i)
+        elif wt == 2:  # length-delimited
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:  # fixed32
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:  # fixed64
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        yield fn, wt, v
+
+
+@dataclasses.dataclass
+class XStat:
+    metadata_id: int = 0
+    double_value: float = 0.0
+    uint64_value: int = 0
+    int64_value: int = 0
+    str_value: str = ""
+    bytes_value: bytes = b""
+    ref_value: int = 0
+
+
+@dataclasses.dataclass
+class XEvent:
+    metadata_id: int = 0
+    offset_ps: int = 0
+    duration_ps: int = 0
+    num_occurrences: int = 0
+    stats: List[XStat] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class XLine:
+    id: int = 0
+    name: str = ""
+    display_name: str = ""
+    timestamp_ns: int = 0
+    duration_ps: int = 0
+    events: List[XEvent] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class XMeta:
+    id: int = 0
+    name: str = ""
+
+
+@dataclasses.dataclass
+class XPlane:
+    id: int = 0
+    name: str = ""
+    lines: List[XLine] = dataclasses.field(default_factory=list)
+    event_metadata: Dict[int, XMeta] = dataclasses.field(
+        default_factory=dict)
+    stat_metadata: Dict[int, XMeta] = dataclasses.field(
+        default_factory=dict)
+
+
+@dataclasses.dataclass
+class XSpace:
+    planes: List[XPlane] = dataclasses.field(default_factory=list)
+
+
+def _parse_stat(b: bytes) -> XStat:
+    st = XStat()
+    for fn, _, v in _fields(b):
+        if fn == 1:
+            st.metadata_id = v
+        elif fn == 2:
+            st.double_value = struct.unpack("<d", v)[0]
+        elif fn == 3:
+            st.uint64_value = v
+        elif fn == 4:
+            st.int64_value = v
+        elif fn == 5:
+            st.str_value = v.decode("utf-8", "replace")
+        elif fn == 6:
+            st.bytes_value = v
+        elif fn == 7:
+            st.ref_value = v
+    return st
+
+
+def _parse_event(b: bytes) -> XEvent:
+    ev = XEvent()
+    for fn, _, v in _fields(b):
+        if fn == 1:
+            ev.metadata_id = v
+        elif fn == 2:
+            ev.offset_ps = v
+        elif fn == 3:
+            ev.duration_ps = v
+        elif fn == 4:
+            ev.stats.append(_parse_stat(v))
+        elif fn == 5:
+            ev.num_occurrences = v
+    return ev
+
+
+def _parse_line(b: bytes) -> XLine:
+    ln = XLine()
+    for fn, _, v in _fields(b):
+        if fn == 1:
+            ln.id = v
+        elif fn == 2:
+            ln.name = v.decode("utf-8", "replace")
+        elif fn == 3:
+            ln.timestamp_ns = v
+        elif fn == 4:
+            ln.events.append(_parse_event(v))
+        elif fn == 9:
+            ln.duration_ps = v
+        elif fn == 11:
+            ln.display_name = v.decode("utf-8", "replace")
+    return ln
+
+
+def _parse_meta(b: bytes) -> XMeta:
+    m = XMeta()
+    for fn, _, v in _fields(b):
+        if fn == 1:
+            m.id = v
+        elif fn == 2:
+            m.name = v.decode("utf-8", "replace")
+    return m
+
+
+def _parse_map_entry(b: bytes) -> Tuple[int, XMeta]:
+    k, m = 0, XMeta()
+    for fn, _, v in _fields(b):
+        if fn == 1:
+            k = v
+        elif fn == 2:
+            m = _parse_meta(v)
+    return k, m
+
+
+def _parse_plane(b: bytes) -> XPlane:
+    p = XPlane()
+    for fn, _, v in _fields(b):
+        if fn == 1:
+            p.id = v
+        elif fn == 2:
+            p.name = v.decode("utf-8", "replace")
+        elif fn == 3:
+            p.lines.append(_parse_line(v))
+        elif fn == 4:
+            k, m = _parse_map_entry(v)
+            p.event_metadata[k] = m
+        elif fn == 5:
+            k, m = _parse_map_entry(v)
+            p.stat_metadata[k] = m
+    return p
+
+
+def parse_xspace(data: bytes) -> XSpace:
+    """Decode serialized XSpace bytes (the ``.xplane.pb`` content)."""
+    xs = XSpace()
+    for fn, _, v in _fields(data):
+        if fn == 1:
+            xs.planes.append(_parse_plane(v))
+    return xs
+
+
+# ---------------------------------------------------------------------------
+# loading
+# ---------------------------------------------------------------------------
+
+def find_xplane_pbs(logdir: str) -> List[str]:
+    """All ``.xplane.pb`` files under a profiler logdir (sorted — the
+    last is the most recent capture)."""
+    direct = sorted(glob.glob(
+        os.path.join(logdir, "plugins/profile/*/*.xplane.pb")))
+    if direct:
+        return direct
+    return sorted(glob.glob(
+        os.path.join(logdir, "**", "*.xplane.pb"), recursive=True))
+
+
+def load_xspace(path: str) -> Tuple[XSpace, str]:
+    """(XSpace, pb_path) from a profiler logdir or a direct ``.pb``
+    path. Raises :class:`XPlaneUnavailable` with an actionable message
+    when nothing parseable is there — the graceful replacement for the
+    old hard ``tensorflow.tsl`` import chain."""
+    if os.path.isfile(path):
+        pb = path
+    else:
+        pbs = find_xplane_pbs(path)
+        if not pbs:
+            raise XPlaneUnavailable(
+                f"no .xplane.pb under {path!r} — is this a "
+                "jax.profiler.trace logdir, and did the traced program "
+                "actually execute device work inside the trace window?"
+            )
+        pb = pbs[-1]
+    try:
+        with open(pb, "rb") as f:
+            data = f.read()
+        return parse_xspace(data), pb
+    except (OSError, ValueError, IndexError) as e:
+        raise XPlaneUnavailable(f"cannot parse {pb!r}: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# op-event extraction
+# ---------------------------------------------------------------------------
+
+#: substrings marking a cross-device collective / communication HLO.
+#: Covers both HLO op names (all-reduce.3, all-gather-start) and the
+#: profiler's category strings.
+_COLLECTIVE_MARKERS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective", "send", "recv", "psum",
+    "allreduce", "allgather", "alltoall",
+)
+
+#: event names on the CPU client lines that are bookkeeping, not HLO ops
+_NON_OP_PREFIXES = ("ThreadpoolListener", "$", "EigenDevice")
+
+
+def is_collective(name: str) -> bool:
+    """Does this HLO op/category name move bytes between ranks?"""
+    low = name.lower()
+    return any(m in low for m in _COLLECTIVE_MARKERS)
+
+
+def is_device_plane(plane_name: str) -> bool:
+    return "TPU" in plane_name or "GPU" in plane_name or (
+        "Device" in plane_name and "Host" not in plane_name)
+
+
+def _is_op_line(plane: XPlane, line: XLine) -> bool:
+    lname = line.name or line.display_name
+    if is_device_plane(plane.name):
+        # TPU/GPU device planes: only the "XLA Ops" lines carry the
+        # per-HLO timeline (summarize_plane's long-standing rule).
+        # "XLA Modules" / "XLA TraceMe" / framework lines span whole
+        # steps and would book the entire window as one giant op.
+        return "XLA Ops" in lname or lname == "Ops"
+    # CPU backend: XLA executes on client threads of the host plane
+    return lname.startswith("tf_XLATfrtCpuClient")
+
+
+def _event_category(name: str, ev: XEvent,
+                    stmeta: Dict[int, XMeta]) -> str:
+    """The profiler's category stat when present (LAST match wins —
+    an op carrying both 'equation' and 'hlo_category' categorizes by
+    the category, not the einsum string), else derived from the HLO op
+    name. One rule for both attribution and summary tables."""
+    cat = None
+    for st in ev.stats:
+        sname = stmeta.get(st.metadata_id)
+        if sname and sname.name in ("equation", "hlo_category",
+                                    "category"):
+            cat = st.str_value
+    if cat is not None:
+        return cat
+    return name.split(".")[0].split("-start")[0]
+
+
+def op_events(xspace: XSpace,
+              include_async: bool = False) -> List[dict]:
+    """Flatten the device-op timeline: one dict per executed HLO op,
+    with absolute microsecond start times (``line.timestamp_ns`` +
+    event offset — the profiler's own session clock)."""
+    out: List[dict] = []
+    for plane in xspace.planes:
+        evmeta = plane.event_metadata
+        stmeta = plane.stat_metadata
+        for line in plane.lines:
+            lname = line.name or line.display_name
+            if not _is_op_line(plane, line):
+                continue
+            async_line = "Async" in lname
+            if async_line and not include_async:
+                # overlapped DMA runs concurrently with the sync op
+                # line; counting both would double-book the device
+                continue
+            base_us = line.timestamp_ns / 1e3
+            for ev in line.events:
+                md = evmeta.get(ev.metadata_id)
+                name = md.name if md else str(ev.metadata_id)
+                if ev.duration_ps <= 0:
+                    continue
+                if name.startswith(_NON_OP_PREFIXES):
+                    continue
+                cat = _event_category(name, ev, stmeta)
+                out.append({
+                    "name": name,
+                    "cat": cat,
+                    "start_us": base_us + ev.offset_ps / 1e6,
+                    "dur_us": ev.duration_ps / 1e6,
+                    "plane": plane.name,
+                    "line": lname,
+                    "async": async_line,
+                    "collective": is_collective(name) or is_collective(
+                        cat),
+                })
+    out.sort(key=lambda e: e["start_us"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic + attribution
+# ---------------------------------------------------------------------------
+
+def merge_intervals(
+        spans: Iterable[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Union of (start, end) intervals, sorted and coalesced."""
+    spans = sorted((s, e) for s, e in spans if e > s)
+    if not spans:
+        return []
+    out = [spans[0]]
+    for s, e in spans[1:]:
+        ls, le = out[-1]
+        if s > le:
+            out.append((s, e))
+        else:
+            out[-1] = (ls, max(le, e))
+    return out
+
+
+def _total(intervals: List[Tuple[float, float]]) -> float:
+    return sum(e - s for s, e in intervals)
+
+
+def _intersect(a: List[Tuple[float, float]],
+               b: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    out: List[Tuple[float, float]] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            out.append((s, e))
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def attribute(ops: List[dict],
+              host_wall_us: Optional[float] = None) -> dict:
+    """Attribute a sampled window into compute / collective / exposed
+    collective / idle / host-gap buckets.
+
+    * ``compute_us`` — union of non-collective device op time;
+    * ``collective_us`` — union of collective op time;
+    * ``exposed_collective_us`` — collective time **not** overlapped by
+      compute: the wire time the step actually pays (the measured twin
+      of ops/overlap.py's structural window — a perfect schedule drives
+      this to ~0);
+    * ``idle_us`` — gaps in the device timeline inside the window;
+    * ``host_gap_us`` — host wall time beyond the device window (host
+      input pipeline / dispatch latency), only when ``host_wall_us``
+      is given.
+
+    Fractions normalize by the host wall when known, else device wall.
+    ``measured_overlap_frac`` is the overlapped share of collective
+    time (1.0 = fully hidden; None when the window has no collectives).
+    """
+    compute = merge_intervals(
+        (e["start_us"], e["start_us"] + e["dur_us"])
+        for e in ops if not e["collective"])
+    coll = merge_intervals(
+        (e["start_us"], e["start_us"] + e["dur_us"])
+        for e in ops if e["collective"])
+    busy = merge_intervals(compute + coll)
+    wall = (busy[-1][1] - busy[0][0]) if busy else 0.0
+    compute_us = _total(compute)
+    coll_us = _total(coll)
+    overlapped_us = _total(_intersect(compute, coll))
+    exposed_us = coll_us - overlapped_us
+    idle_us = max(wall - _total(busy), 0.0)
+    host_gap_us = None
+    denom = wall
+    if host_wall_us is not None:
+        host_gap_us = max(host_wall_us - wall, 0.0)
+        denom = max(host_wall_us, wall)
+    denom = denom or 1.0
+    out = {
+        "ops": len(ops),
+        "device_wall_us": round(wall, 3),
+        "compute_us": round(compute_us, 3),
+        "collective_us": round(coll_us, 3),
+        "exposed_collective_us": round(exposed_us, 3),
+        "idle_us": round(idle_us, 3),
+        "compute_frac": round(compute_us / denom, 6),
+        "exposed_wire_frac": round(exposed_us / denom, 6),
+        "idle_frac": round(idle_us / denom, 6),
+        "measured_overlap_frac": (
+            round(overlapped_us / coll_us, 6) if coll_us > 0 else None
+        ),
+    }
+    if host_gap_us is not None:
+        out["host_wall_us"] = round(host_wall_us, 3)
+        out["host_gap_us"] = round(host_gap_us, 3)
+        out["host_gap_frac"] = round(host_gap_us / denom, 6)
+    return out
+
+
+def attribute_by_plane(ops: List[dict],
+                       host_wall_us: Optional[float] = None) -> dict:
+    """:func:`attribute`, but computed per device plane and then
+    aggregated. One capture on a multi-chip host holds one plane per
+    chip; if their op spans shared a single interval axis, chip A's
+    compute would mask chip B's exposed collective wait — exactly the
+    straggler signal this instrument exists to surface — so each plane
+    is attributed on its own axis first. Per-plane fracs average with
+    equal weight (each chip owns the same wall);
+    ``measured_overlap_frac`` is the overlapped share of total
+    collective microseconds across chips. A single-plane capture
+    returns :func:`attribute`'s dict unchanged."""
+    planes: Dict[str, List[dict]] = {}
+    for e in ops:
+        planes.setdefault(e["plane"], []).append(e)
+    if len(planes) <= 1:
+        return attribute(ops, host_wall_us=host_wall_us)
+    per = {name: attribute(evs, host_wall_us=host_wall_us)
+           for name, evs in sorted(planes.items())}
+    vals = list(per.values())
+    n = len(vals)
+    coll_us = sum(a["collective_us"] for a in vals)
+    overlapped_us = sum(
+        a["collective_us"] - a["exposed_collective_us"] for a in vals)
+    out = {
+        "ops": len(ops),
+        "planes": n,
+        "device_wall_us": round(max(a["device_wall_us"] for a in vals), 3),
+        "compute_us": round(sum(a["compute_us"] for a in vals), 3),
+        "collective_us": round(coll_us, 3),
+        "exposed_collective_us": round(
+            sum(a["exposed_collective_us"] for a in vals), 3),
+        "idle_us": round(sum(a["idle_us"] for a in vals), 3),
+        "compute_frac": round(
+            sum(a["compute_frac"] for a in vals) / n, 6),
+        "exposed_wire_frac": round(
+            sum(a["exposed_wire_frac"] for a in vals) / n, 6),
+        "idle_frac": round(sum(a["idle_frac"] for a in vals) / n, 6),
+        "measured_overlap_frac": (
+            round(overlapped_us / coll_us, 6) if coll_us > 0 else None),
+        "per_plane": {
+            name: {k: a[k] for k in (
+                "device_wall_us", "compute_frac", "exposed_wire_frac",
+                "idle_frac", "measured_overlap_frac")}
+            for name, a in per.items()},
+    }
+    if host_wall_us is not None:
+        out["host_wall_us"] = round(host_wall_us, 3)
+        out["host_gap_us"] = round(
+            sum(a.get("host_gap_us", 0.0) for a in vals) / n, 3)
+        out["host_gap_frac"] = round(
+            sum(a.get("host_gap_frac", 0.0) for a in vals) / n, 6)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-plane summary (the xplane_summary.py engine)
+# ---------------------------------------------------------------------------
+
+def summarize_plane(plane: XPlane) -> Optional[dict]:
+    """Busy/idle + by-category/by-op totals for one device plane
+    (identical accounting to the pre-PR-10 xplane_summary.py)."""
+    by_op: Dict[str, float] = {}
+    by_cat: Dict[str, float] = {}
+    occur: Dict[str, int] = {}
+    spans: List[Tuple[int, int]] = []
+    evmeta, stmeta = plane.event_metadata, plane.stat_metadata
+    for line in plane.lines:
+        lname = line.name or line.display_name
+        if not _is_op_line(plane, line) or "Async" in lname:
+            # same op-line rule as attribution; Async DMA runs
+            # CONCURRENTLY with the sync op line and counting both
+            # double-books the device
+            continue
+        for ev in line.events:
+            md = evmeta.get(ev.metadata_id)
+            name = md.name if md else str(ev.metadata_id)
+            dur = ev.duration_ps / 1e6  # -> us
+            cat = _event_category(name, ev, stmeta)
+            by_op[name] = by_op.get(name, 0.0) + dur
+            by_cat[cat] = by_cat.get(cat, 0.0) + dur
+            occur[name] = occur.get(name, 0) + 1
+            spans.append((ev.offset_ps, ev.offset_ps + ev.duration_ps))
+    if not spans:
+        return None
+    merged = merge_intervals(spans)
+    total_busy = _total(merged)
+    wall = max(e for _, e in spans) - min(s for s, _ in spans)
+    return {
+        "plane": plane.name,
+        "wall_us": wall / 1e6,
+        "busy_us": total_busy / 1e6,
+        "idle_frac": 1.0 - total_busy / max(wall, 1),
+        "by_cat": by_cat,
+        "by_op": by_op,
+        "occur": occur,
+    }
+
+
+def summarize(path: str) -> List[dict]:
+    """Per-device-plane summaries for a logdir/pb (empty when the
+    capture has no device op lines — e.g. a CPU-only capture, whose op
+    events still flow through :func:`op_events`)."""
+    xs, _ = load_xspace(path)
+    out = []
+    for plane in xs.planes:
+        if not is_device_plane(plane.name):
+            continue
+        s = summarize_plane(plane)
+        if s is not None:
+            out.append(s)
+    return out

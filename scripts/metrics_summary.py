@@ -133,6 +133,25 @@ def summarize(records):
         print("overlap: unscheduled (HOROVOD_OVERLAP_SCHEDULE off — "
               "collectives placed at the compiler's discretion)")
 
+    # continuous profiler (utils/prof.py, docs/timeline.md): hvd_mfu is
+    # per-step once set_step_flops declared the model cost; attribution
+    # rides the steps whose sampled capture finished parsing
+    mfus = [r["mfu"] for r in records if "mfu" in r]
+    if mfus:
+        print(f"mfu: mean {sum(mfus) / len(mfus):.4f}  "
+              f"last {mfus[-1]:.4f}  ({len(mfus)}/{len(records)} steps)")
+    attrs = [r["attribution"] for r in records if "attribution" in r]
+    if attrs:
+        a = attrs[-1]
+        overlap = a.get("measured_overlap_frac")
+        print(f"device attribution ({len(attrs)} sampled, last = step "
+              f"#{a.get('sampled_step', '?')}): "
+              f"compute {a.get('compute_frac', 0):.1%}  "
+              f"exposed wire {a.get('exposed_wire_frac', 0):.1%}  "
+              f"idle {a.get('idle_frac', 0):.1%}"
+              + (f"  measured overlap {overlap:.1%}"
+                 if overlap is not None else ""))
+
     hits = sum(r.get("native", {}).get("cache_hits", 0) for r in records)
     n_coll = sum(v[0] for v in coll.values())
     if hits or n_coll:

@@ -27,7 +27,15 @@ locally before the full pytest tier:
   MLP-sized ``tiny`` vehicle (backward-interleaved scheduler: schedule
   on/off bitwise parity over plain + ZeRO + int8, and the staged mode
   provably pins backward compute behind the first gradient
-  collective).
+  collective);
+* ``perf`` — ``scripts/perf_baseline.py --check`` (the perf-regression
+  gate: structural invariants — fast-path engaged, zero steady
+  negotiated bytes, profiler sampled + attributed inside its duty
+  cycle, off-path step hook a no-op, hvd_mfu exported — plus step-time
+  p50 vs the committed ``PERF_BASELINE.json`` under
+  ``HOROVOD_PERF_TOLERANCE``), then ``--trace-smoke`` (world-2
+  loopback merged Perfetto trace holds host + device + flight events
+  from both ranks on one aligned clock).
 
 Usage:
     python scripts/run_all_checks.py [--only NAME ...] [--skip NAME ...]
@@ -182,6 +190,22 @@ def check_overlap():
         ], env=env)
 
 
+def check_perf():
+    """The perf-regression gate + the merged-trace smoke (one gate:
+    both run the unified-observability stack end-to-end)."""
+    rc, out = _run([
+        sys.executable, os.path.join(_SCRIPTS, "perf_baseline.py"),
+        "--check",
+    ])
+    if rc != 0:
+        return rc, out
+    rc2, out2 = _run([
+        sys.executable, os.path.join(_SCRIPTS, "perf_baseline.py"),
+        "--trace-smoke",
+    ])
+    return rc2, out + out2
+
+
 GATES = [
     ("metrics", check_metrics),
     ("chaos", check_chaos),
@@ -191,6 +215,7 @@ GATES = [
     ("recovery", check_recovery),
     ("compression", check_compression),
     ("overlap", check_overlap),
+    ("perf", check_perf),
 ]
 
 

@@ -1,0 +1,347 @@
+#!/usr/bin/env python
+"""Merge per-rank host timelines, device op traces and flight dumps
+into ONE clock-aligned Chrome/Perfetto trace.
+
+Before PR 10 the three recorders were disjoint views: the host timeline
+(utils/timeline.py, Chrome JSON per rank, relative microseconds), the
+device profiler (jax.profiler xplane per rank, its own session clock)
+and the flight recorder (JSONL dumps per rank, wall clock). This tool
+fuses them:
+
+* every host timeline opens with a ``CLOCK_ANCHOR`` instant (PR 10)
+  mapping its relative axis to the rank's wall clock;
+* every flight dump header and every profiler sample sidecar
+  (``hvd_prof_meta.json``) carries the rank's ``/clock`` offset to the
+  driver (the PR-5 rendezvous probe), so per-rank wall clocks map onto
+  one driver axis;
+* device ops are placed by their sample's wall-clock capture window.
+
+Output is standard Chrome trace JSON (``traceEvents``): open it in
+Perfetto / chrome://tracing. One *process* per rank, with ``host:*``,
+``device:*`` and ``flight`` threads; host spans stay B/E pairs, device
+ops become X complete events, flight events become thread-scoped
+instants.
+
+Usage:
+    python scripts/trace_merge.py --out merged.json \\
+        --timeline /tmp/t_rank0.json --timeline /tmp/t_rank1.json \\
+        --flight /tmp/hvd_flight \\
+        --xplane /tmp/hvd_prof/rank0 --xplane /tmp/hvd_prof/rank1
+
+Exit 0 when at least one source merged; the printed report counts
+events per rank and source (``--json`` writes it machine-readably).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from horovod_tpu.utils import xplane as _xplane  # noqa: E402
+from horovod_tpu.utils.flight import parse_dump  # noqa: E402
+
+
+def _rank_from_name(path: str) -> int:
+    m = re.search(r"rank(\d+)", os.path.basename(path))
+    if m is None:
+        m = re.search(r"rank(\d+)", path)
+    if m is None:
+        # multiple unknown-rank sources would silently collapse onto
+        # one pid track and mis-nest their spans — say so
+        print(f"trace_merge: {path}: no rank in source metadata or "
+              "filename — assuming rank 0", file=sys.stderr)
+        return 0
+    return int(m.group(1))
+
+
+# ---------------------------------------------------------------------------
+# source loaders — each returns (rank, events_on_wall_unix_seconds, meta)
+# ---------------------------------------------------------------------------
+
+def load_timeline(path: str) -> Optional[dict]:
+    """One host timeline JSON → {rank, clock_offset?, events:[(t_unix,
+    chrome_event), ...]}. Needs the CLOCK_ANCHOR instant; timelines
+    from pre-PR-10 builds (no anchor) are refused with a warning."""
+    try:
+        with open(path) as f:
+            evs = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"trace_merge: cannot read timeline {path}: {e}",
+              file=sys.stderr)
+        return None
+    anchor = next((e for e in evs if e.get("name") == "CLOCK_ANCHOR"), None)
+    if anchor is None:
+        print(f"trace_merge: {path} has no CLOCK_ANCHOR (pre-unified "
+              "timeline?) — skipped; re-record with this build",
+              file=sys.stderr)
+        return None
+    args = anchor.get("args", {})
+    rank = int(args.get("rank", -1))
+    if rank < 0:
+        rank = _rank_from_name(path)
+    t0_unix = float(args["time_unix"])
+    ts0 = float(anchor["ts"])
+    out = []
+    for e in evs:
+        if e.get("name") == "CLOCK_ANCHOR":
+            continue
+        t_unix = t0_unix + (float(e["ts"]) - ts0) / 1e6
+        out.append((t_unix, e))
+    return {"rank": rank, "events": out, "source": path}
+
+
+def load_flight(path: str) -> Optional[dict]:
+    """One flight dump JSONL → rank, clock offset, wall-stamped
+    events."""
+    try:
+        with open(path) as f:
+            header, events = parse_dump(f.read())
+    except OSError as e:
+        print(f"trace_merge: cannot read flight dump {path}: {e}",
+              file=sys.stderr)
+        return None
+    rank = int(header.get("rank", -1))
+    if rank < 0:
+        rank = _rank_from_name(path)
+    offset = header.get("clock_offset_s")
+    out = [(float(ev.get("t_wall", 0.0)), ev)
+           for ev in events if ev.get("t_wall")]
+    return {"rank": rank, "clock_offset_s": offset, "events": out,
+            "source": path}
+
+
+def find_prof_samples(root: str) -> List[str]:
+    """Profiler sample dirs under a root: any directory holding the
+    ``hvd_prof_meta.json`` sidecar utils/prof.py writes per capture."""
+    if os.path.isfile(os.path.join(root, "hvd_prof_meta.json")):
+        return [root]
+    return sorted(
+        os.path.dirname(p) for p in glob.glob(
+            os.path.join(root, "**", "hvd_prof_meta.json"),
+            recursive=True)
+    )
+
+
+def load_xplane_sample(sample_dir: str) -> Optional[dict]:
+    """One profiler capture → rank, clock offset, device ops placed in
+    the sample's wall-clock window."""
+    meta_path = os.path.join(sample_dir, "hvd_prof_meta.json")
+    meta = {}
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        pass
+    try:
+        xs, _ = _xplane.load_xspace(sample_dir)
+    except _xplane.XPlaneUnavailable as e:
+        print(f"trace_merge: {e}", file=sys.stderr)
+        return None
+    ops = _xplane.op_events(xs)
+    if not ops:
+        return None
+    rank = int(meta.get("rank", -1))
+    if rank < 0:
+        rank = _rank_from_name(sample_dir)
+    try:
+        t_start = float(meta["t_start_unix"])
+    except (KeyError, TypeError, ValueError):
+        # no wall anchor → the ops would land at the 1970 epoch and
+        # stretch the merged axis by decades; skip loudly instead
+        print(f"trace_merge: {sample_dir} has no usable "
+              "hvd_prof_meta.json wall anchor (torn sidecar?) — "
+              "sample skipped", file=sys.stderr)
+        return None
+    base_us = min(o["start_us"] for o in ops)
+    out = []
+    for o in ops:
+        t_unix = t_start + (o["start_us"] - base_us) / 1e6
+        out.append((t_unix, o))
+    return {
+        "rank": rank,
+        "clock_offset_s": meta.get("clock_offset_s"),
+        "step": meta.get("step"),
+        "events": out,
+        "source": sample_dir,
+    }
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+def merge(timelines: List[dict], flights: List[dict],
+          samples: List[dict]) -> Tuple[dict, dict]:
+    """(chrome_trace, report). Every source's wall stamps shift by its
+    rank's /clock offset (flight header / prof sidecar; a rank with no
+    probed offset uses 0 — same-host loopback worlds share a clock
+    anyway), then the merged axis rebases to the earliest event."""
+    offsets: Dict[int, float] = {}
+    for src in flights + samples:
+        off = src.get("clock_offset_s")
+        if off is not None and src["rank"] not in offsets:
+            offsets[src["rank"]] = float(off)
+
+    aligned: List[Tuple[float, int, str, dict]] = []  # (t, rank, kind, ev)
+    for tl in timelines:
+        off = offsets.get(tl["rank"], 0.0)
+        for t, e in tl["events"]:
+            aligned.append((t + off, tl["rank"], "host", e))
+    for fl in flights:
+        off = offsets.get(fl["rank"], 0.0)
+        for t, e in fl["events"]:
+            aligned.append((t + off, fl["rank"], "flight", e))
+    for sm in samples:
+        off = offsets.get(sm["rank"], 0.0)
+        for t, e in sm["events"]:
+            aligned.append((t + off, sm["rank"], "device", e))
+
+    report = {
+        "what": "cross-rank merged trace",
+        "ranks": sorted({r for _, r, _, _ in aligned}),
+        "events": len(aligned),
+        "by_source": {},
+        "clock_offsets_s": {str(r): v for r, v in sorted(offsets.items())},
+    }
+    for _, r, kind, _ in aligned:
+        key = f"rank{r}/{kind}"
+        report["by_source"][key] = report["by_source"].get(key, 0) + 1
+    if not aligned:
+        return {"traceEvents": []}, report
+
+    t_base = min(t for t, _, _, _ in aligned)
+    report["t_base_unix"] = round(t_base, 6)
+    report["span_s"] = round(
+        max(t for t, _, _, _ in aligned) - t_base, 6)
+
+    trace: List[dict] = []
+    for rank in report["ranks"]:
+        trace.append({"ph": "M", "name": "process_name", "pid": rank,
+                      "args": {"name": f"rank {rank}"}})
+    seen_tids = set()
+
+    def _tid(rank: int, tid: str) -> str:
+        key = (rank, tid)
+        if key not in seen_tids:
+            seen_tids.add(key)
+            trace.append({"ph": "M", "name": "thread_name", "pid": rank,
+                          "tid": tid, "args": {"name": tid}})
+        return tid
+
+    for t, rank, kind, e in sorted(aligned, key=lambda x: x[0]):
+        ts = (t - t_base) * 1e6  # us on the merged axis
+        if kind == "host":
+            ev = {
+                "ph": e.get("ph", "i"),
+                "name": e.get("name", ""),
+                "ts": round(ts, 3),
+                "pid": rank,
+                "tid": _tid(rank, f"host:{e.get('tid', '')}"),
+            }
+            if e.get("args"):
+                ev["args"] = e["args"]
+            if ev["ph"] == "i":
+                ev["s"] = "t"
+            trace.append(ev)
+        elif kind == "device":
+            trace.append({
+                "ph": "X",
+                "name": e["name"],
+                "cat": ("collective" if e.get("collective")
+                        else str(e.get("cat", "op"))),
+                "ts": round(ts, 3),
+                "dur": round(e["dur_us"], 3),
+                "pid": rank,
+                "tid": _tid(rank, f"device:{e.get('line', '')}"),
+            })
+        else:  # flight
+            name = e.get("kind", "event")
+            if e.get("name"):
+                name = f"{name}:{e['name']}"
+            args = {k: v for k, v in e.items()
+                    if k not in ("t_mono", "t_wall", "seq")}
+            trace.append({
+                "ph": "i",
+                "s": "t",
+                "name": name,
+                "ts": round(ts, 3),
+                "pid": rank,
+                "tid": _tid(rank, "flight"),
+                "args": args,
+            })
+    chrome = {
+        "traceEvents": trace,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "tool": "horovod_tpu scripts/trace_merge.py",
+            "t_base_unix": report["t_base_unix"],
+        },
+    }
+    return chrome, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--timeline", action="append", default=[],
+                    metavar="FILE",
+                    help="host timeline JSON (repeatable; globs ok)")
+    ap.add_argument("--flight", action="append", default=[],
+                    metavar="FILE_OR_DIR",
+                    help="flight dump JSONL or a dump directory "
+                         "(repeatable)")
+    ap.add_argument("--xplane", action="append", default=[],
+                    metavar="DIR",
+                    help="profiler capture dir — a single sample or a "
+                         "rank root of samples (repeatable)")
+    ap.add_argument("--out", required=True,
+                    help="merged Chrome trace JSON path")
+    ap.add_argument("--json", dest="json_out", default="",
+                    help="also write the merge report JSON here")
+    args = ap.parse_args(argv)
+
+    timelines: List[dict] = []
+    for pat in args.timeline:
+        for path in (sorted(glob.glob(pat)) or [pat]):
+            tl = load_timeline(path)
+            if tl is not None:
+                timelines.append(tl)
+    flights: List[dict] = []
+    for item in args.flight:
+        paths = (sorted(glob.glob(os.path.join(item, "flight_rank*.jsonl")))
+                 if os.path.isdir(item) else (sorted(glob.glob(item))
+                                              or [item]))
+        for path in paths:
+            fl = load_flight(path)
+            if fl is not None:
+                flights.append(fl)
+    samples: List[dict] = []
+    for root in args.xplane:
+        for d in find_prof_samples(root):
+            sm = load_xplane_sample(d)
+            if sm is not None:
+                samples.append(sm)
+
+    chrome, report = merge(timelines, flights, samples)
+    if not chrome["traceEvents"]:
+        print("trace_merge: no events from any source", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(chrome, f)
+        f.write("\n")
+    report["out"] = args.out
+    print(json.dumps(report, indent=1))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,129 +3,125 @@
 device idle fraction.
 
 Reads the ``.xplane.pb`` written by ``jax.profiler.trace`` (via
-scripts/profile_cnn.py) and prints, for each TPU device plane:
+scripts/profile_cnn.py, scripts/profile_bert.py, or the continuous step
+profiler — ``HOROVOD_PROF_EVERY``, docs/timeline.md) and prints, for
+each TPU device plane:
   - total wall span vs. sum of op durations (idle = gaps in the op line)
   - time grouped by op category (convolution / fusion / copy / etc.)
   - the top-N individual ops by total self time
 
+Parsing lives in ``horovod_tpu/utils/xplane.py`` — a self-contained
+protobuf decoder, so this tool no longer needs TensorFlow installed.
+``--json`` emits one machine-readable summary object (to stdout or a
+file) for gates and tooling; ``--attribute`` adds the compute /
+exposed-collective / idle attribution over the whole op timeline.
+
 Usage:
     python scripts/xplane_summary.py /tmp/xplane_resnet [--top 30]
+    python scripts/xplane_summary.py /tmp/xplane_resnet --json out.json
 """
 
 import argparse
-import collections
-import glob
-import gzip
 import json
 import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
 
-def load_xspace(logdir):
-    pbs = sorted(glob.glob(os.path.join(
-        logdir, "plugins/profile/*/*.xplane.pb")))
-    if not pbs:
-        sys.exit(f"no .xplane.pb under {logdir}")
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-    xs = xplane_pb2.XSpace()
-    with open(pbs[-1], "rb") as f:
-        xs.ParseFromString(f.read())
-    return xs, pbs[-1]
+from horovod_tpu.utils import xplane  # noqa: E402
 
 
-def summarize_plane(plane, top):
-    evmeta = {m.id: m for m in plane.event_metadata.values()}
-    stmeta = {m.id: m.name for m in plane.stat_metadata.values()}
-    by_op = collections.Counter()
-    by_cat = collections.Counter()
-    occur = collections.Counter()
-    spans = []
-    for line in plane.lines:
-        # XLA op lines on TPU planes are named e.g. "XLA Ops"; step lines
-        # and others are skipped for the busy/idle accounting
-        lname = line.name or line.display_name
-        if "XLA Ops" not in lname and "Ops" != lname:
-            continue
-        if "Async" in lname:
-            # 'Async XLA Ops' = overlapped DMA (slices/copies); its spans
-            # run CONCURRENTLY with the sync 'XLA Ops' timeline, so
-            # counting them both double-books the device and buries the
-            # compute categories under %copy/%slice
-            continue
-        for ev in line.events:
-            md = evmeta.get(ev.metadata_id)
-            name = md.name if md else str(ev.metadata_id)
-            dur = ev.duration_ps / 1e6  # -> us
-            cat = None
-            for st in ev.stats:
-                sname = stmeta.get(st.metadata_id, "")
-                if sname in ("equation", "hlo_category", "category"):
-                    cat = st.str_value
-            if cat is None:
-                # fall back: leading token of the hlo op name
-                cat = name.split(".")[0].split("-")[0]
-            by_op[name] += dur
-            by_cat[cat] += dur
-            occur[name] += 1
-            spans.append((ev.offset_ps, ev.offset_ps + ev.duration_ps))
-    if not spans:
-        return None
-    spans.sort()
-    total_busy = 0.0
-    cur_s, cur_e = spans[0]
-    for s, e in spans[1:]:
-        if s > cur_e:
-            total_busy += cur_e - cur_s
-            cur_s, cur_e = s, e
-        else:
-            cur_e = max(cur_e, e)
-    total_busy += cur_e - cur_s
-    wall = max(e for _, e in spans) - spans[0][0]
-    return {
-        "wall_us": wall / 1e6,
-        "busy_us": total_busy / 1e6,
-        "idle_frac": 1.0 - total_busy / max(wall, 1),
-        "by_cat": by_cat,
-        "by_op": by_op,
-        "occur": occur,
-    }
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("logdir")
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logdir", help="profiler logdir or .xplane.pb path")
     ap.add_argument("--top", type=int, default=30)
-    ap.add_argument("--json", action="store_true",
-                    help="emit machine-readable summary")
-    args = ap.parse_args()
-    xs, path = load_xspace(args.logdir)
+    ap.add_argument("--json", nargs="?", const="-", default=None,
+                    metavar="FILE",
+                    help="emit a machine-readable summary (to FILE, or "
+                         "stdout with no argument) instead of only the "
+                         "human tables")
+    ap.add_argument("--attribute", action="store_true",
+                    help="also print the compute/exposed-collective/"
+                         "idle attribution over the op timeline")
+    args = ap.parse_args(argv)
+
+    try:
+        xs, path = xplane.load_xspace(args.logdir)
+    except xplane.XPlaneUnavailable as e:
+        print(f"xplane_summary: {e}", file=sys.stderr)
+        return 1
+
     print(f"# {path}")
+    summaries = []
     for plane in xs.planes:
-        if "TPU" not in plane.name and "Device" not in plane.name:
+        if not xplane.is_device_plane(plane.name):
             continue
-        s = summarize_plane(plane, args.top)
+        s = xplane.summarize_plane(plane)
         if s is None:
             continue
+        summaries.append(s)
         print(f"\n== plane: {plane.name} ==")
         print(f"wall {s['wall_us']:.0f}us  busy {s['busy_us']:.0f}us  "
               f"idle {s['idle_frac']:.1%}")
         total = sum(s["by_cat"].values()) or 1.0
         print("\n-- by category --")
-        for cat, us in s["by_cat"].most_common():
+        for cat, us in sorted(s["by_cat"].items(),
+                              key=lambda kv: -kv[1]):
             print(f"{us:12.0f}us  {us / total:6.1%}  {cat}")
         print(f"\n-- top {args.top} ops --")
-        for name, us in s["by_op"].most_common(args.top):
-            print(f"{us:12.0f}us  {us / total:6.1%}  x{s['occur'][name]:<4d} "
-                  f"{name[:110]}")
-        if args.json:
-            print(json.dumps({
-                "plane": plane.name,
-                "wall_us": s["wall_us"],
-                "idle_frac": s["idle_frac"],
-                "by_cat": {k: v for k, v in s["by_cat"].most_common()},
-            }))
+        top = sorted(s["by_op"].items(), key=lambda kv: -kv[1])
+        for name, us in top[:args.top]:
+            print(f"{us:12.0f}us  {us / total:6.1%}  "
+                  f"x{s['occur'][name]:<4d} {name[:110]}")
+
+    ops = xplane.op_events(xs)
+    want_attr = args.attribute or args.json is not None
+    attribution = (xplane.attribute_by_plane(ops)
+                   if ops and want_attr else None)
+    if not summaries and ops:
+        print(f"(no device planes; {len(ops)} XLA op events on host "
+              "execution lines — CPU backend capture)")
+    if args.attribute and attribution:
+        overlap = attribution["measured_overlap_frac"]
+        print("\n-- attribution (whole op timeline) --")
+        print(f"compute {attribution['compute_frac']:.1%}  "
+              f"exposed wire {attribution['exposed_wire_frac']:.1%}  "
+              f"idle {attribution['idle_frac']:.1%}  "
+              f"overlap of collectives: "
+              + (f"{overlap:.1%}" if overlap is not None
+                 else "n/a (no collectives)"))
+    if not summaries and not ops:
+        print("xplane_summary: capture holds no XLA op events",
+              file=sys.stderr)
+        return 1
+
+    if args.json is not None:
+        obj = {
+            "what": "xplane device-trace summary",
+            "pb": path,
+            "planes": [
+                {
+                    "plane": s["plane"],
+                    "wall_us": s["wall_us"],
+                    "busy_us": s["busy_us"],
+                    "idle_frac": s["idle_frac"],
+                    "by_cat": dict(sorted(s["by_cat"].items(),
+                                          key=lambda kv: -kv[1])),
+                }
+                for s in summaries
+            ],
+            "op_events": len(ops),
+            "attribution": attribution,
+        }
+        if args.json == "-":
+            print(json.dumps(obj))
+        else:
+            with open(args.json, "w") as f:
+                json.dump(obj, f, indent=1)
+                f.write("\n")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

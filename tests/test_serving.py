@@ -576,3 +576,81 @@ def test_serving_e2e_sigterm_drains_inflight_then_exits_83(tmp_path):
             proc.kill()
             proc.wait(timeout=10)
         svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# request tracing (PR 10): one id from the front door through the
+# batcher into the flight ring — a slow /v1/predict is one grep away
+# ---------------------------------------------------------------------------
+
+def test_request_id_sanitization_units():
+    from horovod_tpu.serving import tracing
+
+    assert tracing.sanitize("abc-123_x.Y:z") == "abc-123_x.Y:z"
+    # unsafe chars stripped, length bounded
+    assert tracing.sanitize("réq/abc-123!!") == "rqabc-123"
+    assert len(tracing.sanitize("a" * 500)) == 64
+    # a client must not be able to blank out tracing
+    minted = tracing.sanitize("//${}")
+    assert minted and minted.isalnum()
+    assert tracing.sanitize("") != tracing.sanitize("")
+
+
+def test_request_id_propagates_front_door_to_replica_traces():
+    """The client's X-Request-Id travels front door -> dispatch ->
+    replica -> batcher, every tier stamping the SAME (sanitized) id
+    into its flight events, and the reply echoes it."""
+    from horovod_tpu.utils import flight
+
+    flight.reset()
+    flight.configure(enabled_override=True, rank=0, handlers=False)
+    bat = DynamicBatcher(lambda x: x * 2.0, max_batch=4, max_wait_ms=0.0,
+                         queue_limit=16).start()
+    replica = ServingServer(bat.__call__)
+    rp = replica.start()
+    rs = ReplicaSet({0: f"127.0.0.1:{rp}"})
+    front = ServingServer(rs.predict)
+    fp = front.start()
+    try:
+        x = np.ones((2, 3), np.float32)
+        body = json.dumps({"inputs": x.tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fp}/v1/predict", data=body,
+            method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "träce/me-42!"})
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            rid = resp.headers.get("X-Request-Id")
+            payload = json.loads(resp.read())
+        np.testing.assert_allclose(
+            np.asarray(payload["outputs"], np.float32), x * 2.0)
+        assert rid == "trceme-42"  # sanitized form of the client id
+
+        events = flight.snapshot()
+        # both HTTP tiers logged the request under the same id
+        reqs = [e for e in events
+                if e[3] == "serving_request" and e[4] == rid]
+        assert len(reqs) == 2, events
+        assert all(e[5]["code"] == 200 for e in reqs)
+        # the dispatch hop names the id it forwarded
+        disp = [e for e in events if e[3] == "serving_dispatch"]
+        assert disp and disp[-1][5]["req"] == rid
+        # the batch that served it carries the id in its member list
+        batches = [e for e in events if e[3] == "serving_batch"]
+        assert batches and rid in batches[-1][5]["ids"]
+
+        # no client header -> a fresh id is minted, never blank
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{fp}/v1/predict", data=body,
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=10.0) as resp:
+            minted = resp.headers.get("X-Request-Id")
+        assert minted  # front door minted one
+    finally:
+        front.shutdown()
+        replica.shutdown()
+        bat.close()
+        # reset() alone: a bare configure() would re-ENABLE the ring
+        # and install signal handlers for the rest of the session
+        flight.reset()

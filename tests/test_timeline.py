@@ -80,3 +80,97 @@ def test_timeline_json_is_well_formed_after_stop(hvd8, tmp_path):
     hvd.stop_timeline()
     evs = _events(trace)  # json.load raises on malformed output
     assert all(isinstance(e["ts"], (int, float)) for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# writer-level coverage (PR 10): the Timeline class itself, without the
+# collective layers driving it — drain ordering, restart, the clock
+# anchor and the bounded span-start table
+# ---------------------------------------------------------------------------
+
+
+def test_writer_opens_with_clock_anchor_and_drains_in_order(tmp_path):
+    import time
+
+    from horovod_tpu.utils.timeline import CLOCK_ANCHOR, Timeline
+
+    trace = str(tmp_path / "unit.json")
+    t_before = time.time()
+    tl = Timeline(trace)
+    for i in range(500):
+        tl.instant("t", f"ev{i}", {"i": i})
+    tl.stop()
+
+    evs = _events(trace)
+    # the anchor is the FIRST event: tools reading the stream can map
+    # the relative axis to wall time before any other event arrives
+    assert evs[0]["name"] == CLOCK_ANCHOR
+    anchor = evs[0]["args"]
+    assert t_before <= anchor["time_unix"] <= time.time()
+    assert isinstance(anchor["rank"], int)
+    # every queued event survives stop() (the None sentinel lands
+    # BEHIND them in the queue) and keeps emit order
+    names = [e["name"] for e in evs[1:]]
+    assert names == [f"ev{i}" for i in range(500)]
+    # relative stamps are monotone within one producer thread
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_restart_after_stop_writes_a_fresh_trace(tmp_path):
+    from horovod_tpu.utils.timeline import CLOCK_ANCHOR, Timeline
+
+    first = str(tmp_path / "first.json")
+    second = str(tmp_path / "second.json")
+    tl = Timeline(first)
+    tl.instant("t", "only_in_first")
+    tl.stop()
+    assert not tl.active
+    # events emitted while stopped are dropped, not queued for later
+    tl.instant("t", "dropped_while_stopped")
+    tl.start(second)
+    assert tl.active
+    tl.instant("t", "only_in_second")
+    tl.stop()
+
+    evs1 = _events(first)
+    evs2 = _events(second)
+    assert [e["name"] for e in evs1] == [CLOCK_ANCHOR, "only_in_first"]
+    # the restarted trace re-anchors itself — each file is
+    # independently mergeable by scripts/trace_merge.py
+    assert [e["name"] for e in evs2] == [CLOCK_ANCHOR, "only_in_second"]
+
+
+def test_span_start_table_evicts_oldest_at_8192(tmp_path):
+    from horovod_tpu.utils import metrics
+    from horovod_tpu.utils.timeline import Timeline
+
+    metrics.reset()
+    metrics.enable()
+    try:
+        tl = Timeline(str(tmp_path / "evict.json"))
+        # open 8192 spans whose E never arrives (auto-named tensors,
+        # executor failures), then one more: the table must evict its
+        # oldest 1024 instead of growing forever
+        for i in range(8192):
+            tl.activity_start(f"t{i}", "PHASE")
+        assert len(tl._span_starts) == 8192
+        tl.activity_start("t8192", "PHASE")
+        assert len(tl._span_starts) == 8192 - 1024 + 1
+        assert ("t0", "PHASE") not in tl._span_starts
+        assert ("t8192", "PHASE") in tl._span_starts
+
+        # closing an evicted span neither crashes nor records a
+        # latency; closing a surviving span still feeds the histogram
+        tl.activity_end("t0", "PHASE")
+        tl.activity_end("t8192", "PHASE")
+        snap = metrics.registry.snapshot()
+        hist = [v for k, v in snap.items()
+                if k == "hvd_timeline_activity_seconds"]
+        assert hist, "surviving span never reached the metrics bridge"
+        (fam,) = hist
+        counts = [v["count"] for v in fam.values()]
+        assert sum(counts) == 1  # the evicted span contributed nothing
+        tl.stop()
+    finally:
+        metrics.reset()
