@@ -103,9 +103,11 @@ from .optim import (  # noqa: F401
     Compression,
     DistributedGradientTape,
     DistributedOptimizer,
+    FullyShardedOptimizer,
     Int8BlockCompressor,
     ShardedOptimizer,
     error_feedback_specs,
+    fsdp_layout,
     allgather_object,
     broadcast_object,
     broadcast_optimizer_state,
@@ -119,6 +121,7 @@ from .optim import (  # noqa: F401
 # live-telemetry namespace (hvd.metrics.step(), hvd.metrics.scrape()).
 from . import callbacks  # noqa: F401
 from .ops import overlap  # noqa: F401  (hvd.overlap.staged_value_and_grad)
+from .optim import fsdp  # noqa: F401  (hvd.fsdp.shard_params / layout)
 from .utils import faults  # noqa: F401
 from .utils import metrics  # noqa: F401
 from .utils import prof  # noqa: F401  (hvd.prof.set_step_flops, summary)

@@ -177,6 +177,135 @@ def orbax_rung(path: str, attrs: Optional[Dict[str, str]] = None):
     return _restore
 
 
+_FSDP_SPEC_FILE = "horovod_tpu_fsdp.json"
+
+
+def save_fsdp(path: str, rows, layout, opt_state: Any = None,
+              metadata: Optional[Dict[str, Any]] = None) -> None:
+    """Save FSDP-sharded parameter rows (+ the sharded optimizer
+    state) WITHOUT materializing a full replica on any host: the row
+    dict's leaves are jax.Arrays sharded one row per device over the
+    data axis (optim/fsdp.py), and orbax writes each host's addressable
+    shards directly — the save is keyed by the shard spec, never
+    gathered (docs/recovery.md documents the on-disk layout).
+
+    ``layout`` is the FsdpLayout the rows were sharded with; its
+    world/bucket geometry is serialized to ``horovod_tpu_fsdp.json`` so
+    :func:`load_fsdp` can rebuild the restore template (and refuse a
+    mismatched world loudly instead of de-padding garbage). Call on
+    every host (orbax coordinates the multi-host write); restore with
+    ``load_fsdp`` on every host.
+    """
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    import numpy as np
+
+    spec: Dict[str, Any] = {
+        "format": 1,
+        "kind": "fsdp_rows",
+        "world": int(layout.world),
+        "has_opt_state": opt_state is not None,
+        "buckets": [
+            {
+                "index": i,
+                "len": int(L),
+                "k": int(k),
+                "dtype": np.dtype(d).name,
+            }
+            for i, (L, k, d) in enumerate(
+                zip(layout.lens, layout.ks, layout.dtypes))
+        ],
+        "metadata": metadata or {},
+    }
+    with open(os.path.join(path, _FSDP_SPEC_FILE), "w") as f:
+        json.dump(spec, f, indent=2, sort_keys=True)
+    tree: Dict[str, Any] = {"params_rows": dict(rows)}
+    if opt_state is not None:
+        tree["opt_state"] = opt_state
+    ckptr = _checkpointer()
+    tree_path = os.path.join(path, _TREE_DIR)
+
+    def _save():
+        ckptr.save(tree_path, tree, force=True)
+        ckptr.wait_until_finished()
+
+    _ckpt_io("checkpoint.save", _save)
+
+
+def load_fsdp(path: str, mesh, axis_name: Optional[str] = None,
+              abstract_state: Any = None):
+    """Restore FSDP-sharded parameter rows saved by :func:`save_fsdp`,
+    placed DIRECTLY into their `P(ax)` shardings — each host reads only
+    the shards it owns, so no full replica ever exists in host or
+    device memory (the property the FSDP scale story rests on).
+
+    ``abstract_state`` (e.g. ``jax.eval_shape(optimizer.init,
+    abs_params)``) supplies the optimizer-state restore template when
+    the checkpoint carries one; its `(world, k)` leaves restore sharded
+    one row per device, everything else replicated. Returns
+    ``(rows, opt_state, metadata)`` — ``opt_state`` is None when the
+    save carried none or no template was given.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .optim.fsdp import bucket_name
+
+    path = os.path.abspath(path)
+    with open(os.path.join(path, _FSDP_SPEC_FILE)) as f:
+        spec = json.load(f)
+    axes = [a for a, s in zip(mesh.axis_names, mesh.devices.shape)
+            if s > 1] if axis_name is None else [axis_name]
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    ax = axes[0] if axes else mesh.axis_names[0]
+    world = int(spec["world"])
+    if sizes.get(ax, 1) != world:
+        raise ValueError(
+            f"checkpoint at {path} was sharded for world {world} but "
+            f"mesh axis {ax!r} has size {sizes.get(ax, 1)} — restore "
+            "on the matching mesh, or restore there and re-slice with "
+            "hvd.fsdp.reshard_rows (docs/recovery.md)")
+    row_sh = NamedSharding(mesh, P(ax))
+    rep = NamedSharding(mesh, P())
+    rows_tmpl = {
+        bucket_name(b["index"]): jax.ShapeDtypeStruct(
+            (world, b["k"]), np.dtype(b["dtype"]), sharding=row_sh)
+        for b in spec["buckets"]
+    }
+    template: Dict[str, Any] = {"params_rows": rows_tmpl}
+    has_state = bool(spec.get("has_opt_state"))
+    ckptr = _checkpointer()
+    tree_path = os.path.join(path, _TREE_DIR)
+
+    def leaf_tmpl(l):
+        shape = tuple(np.shape(l))
+        sh = row_sh if (len(shape) == 2 and shape[0] == world) else rep
+        return jax.ShapeDtypeStruct(
+            shape, np.dtype(getattr(l, "dtype", np.float32)),
+            sharding=sh)
+
+    if has_state:
+        if abstract_state is None:
+            # no structure template: shapes from checkpoint metadata
+            # (no array bytes), restored in orbax's own tree shape —
+            # pass abstract_state for the optimizer's exact structure
+            meta = ckptr.metadata(tree_path)
+            meta_tree = (meta.item_metadata.tree
+                         if hasattr(meta, "item_metadata") else meta)
+            abstract_state = meta_tree["opt_state"]
+        template["opt_state"] = jax.tree_util.tree_map(
+            leaf_tmpl, abstract_state)
+    restored = _ckpt_io(
+        "checkpoint.restore", ckptr.restore, tree_path, template,
+    )
+    return (
+        restored["params_rows"],
+        restored.get("opt_state") if has_state else None,
+        dict(spec.get("metadata", {})),
+    )
+
+
 def load_params(path: str):
     """Params-only restore: (params, metadata) as host arrays, no
     optimizer rebuild. The inference-side counterpart of load_model —

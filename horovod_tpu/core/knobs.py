@@ -144,6 +144,21 @@ class Knobs:
     # identical code path).
     overlap_schedule: str = "off"
 
+    # --- fully-sharded parameters (optim/fsdp.py, docs/fsdp.md) ---
+    # Routing gate for FullyShardedOptimizer train steps: on (default),
+    # parallel/train.make_lm_train_step routes an fsdp-kind optimizer
+    # through the prefetch-interleaved FSDP step; off, such a step
+    # raises instead of silently taking a wrong path. The knob never
+    # perturbs non-FSDP configurations — with no FullyShardedOptimizer
+    # in play every existing path lowers bit-for-bit the same HLO
+    # regardless of its value (scripts/fsdp_check.py hashes this).
+    fsdp: bool = True
+    # Forward all-gather look-ahead in stages: bucket k+1's parameter
+    # gather issues at segment k's boundary (pinned behind the
+    # activation entering it) so it overlaps segment k's compute. 0
+    # serializes each gather at its need boundary (debugging).
+    fsdp_prefetch: int = 1
+
     # --- hierarchy (operations.cc:551-565) ---
     # On TPU: "hierarchical" = reduce-scatter over ICI within a slice, then
     # all-reduce across slices over DCN, then all-gather over ICI
@@ -306,6 +321,8 @@ class Knobs:
             compression=_env("COMPRESSION", "") or "none",
             compression_block=_env_int("COMPRESSION_BLOCK", 256),
             overlap_schedule=_env("OVERLAP_SCHEDULE", "") or "off",
+            fsdp=_env_bool("FSDP", True),
+            fsdp_prefetch=_env_int("FSDP_PREFETCH", 1),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),

@@ -292,6 +292,35 @@ def bucket_issue_schedule(plans, leaf_stages, backward_stage_order):
     return schedule
 
 
+def bucket_prefetch_schedule(plans, leaf_first_stage, n_stages: int):
+    """When must each fusion bucket's parameter all-gather COMPLETE
+    during a segmented forward pass? The mirror of
+    :func:`bucket_issue_schedule` for the FSDP prefetch direction
+    (ops/overlap.py, docs/fsdp.md): a bucket is *needed* at the first
+    forward stage that touches ANY of its leaves — where the backward
+    direction waits for the LAST contribution, the forward direction
+    must be ready for the FIRST use. The tied-embedding bucket is the
+    canonical asymmetry: it completes last on backward (the input
+    lookup's gradient closes at the final segment) but is needed first
+    on forward (the embedding stage reads it at step 0).
+
+    ``leaf_first_stage[i]`` is the first forward stage using leaf ``i``
+    (``min`` of its contributing stages). Returns one list per forward
+    stage: the bucket indices first needed at that stage — gather them
+    no later than that stage's boundary; gather them one stage earlier
+    to prefetch.
+
+    Implemented by driving :func:`bucket_issue_schedule` itself in the
+    forward (prefetch) direction: traversing the stages in REVERSE
+    forward order, a bucket "completes" exactly when its smallest
+    first-use stage is reached, so the issue schedule read backwards is
+    the need schedule."""
+    rev = bucket_issue_schedule(
+        plans, [[s] for s in leaf_first_stage],
+        list(reversed(range(n_stages))))
+    return list(reversed(rev))
+
+
 def pack_buckets_by_plan(tree, plans):
     """Bucket payloads of `tree`'s leaves under a pytree_bucket_plan's
     per-bucket leaf layout (the pack half of pack_pytree_by_plan)."""

@@ -46,9 +46,9 @@ import numpy as np
 
 from . import collectives
 from .collectives import ReduceOp
-from .fusion import (bucket_issue_schedule, pack_buckets_by_plan,
-                     plan_bucket_lengths, pytree_bucket_plan,
-                     unflatten_buckets_by_plan)
+from .fusion import (bucket_issue_schedule, bucket_prefetch_schedule,
+                     pack_buckets_by_plan, plan_bucket_lengths,
+                     pytree_bucket_plan, unflatten_buckets_by_plan)
 
 _MODES = ("off", "stage", "double")
 
@@ -113,13 +113,19 @@ class StagedGrads:
 
 class StagedShards:
     """Per-bucket averaged gradient shards produced by the staged
-    scheduler on the ZeRO path (already reduce-scattered).
-    ``ShardedOptimizer.update`` consumes the shards directly."""
+    scheduler on the ZeRO/FSDP paths (already reduce-scattered).
+    ``ShardedOptimizer.update`` / ``FullyShardedOptimizer.update``
+    consume the shards directly. ``new_residuals`` carries the updated
+    rank-private error-feedback rows on the FSDP int8 wire (None
+    elsewhere — ZeRO-1 runs the int8 exchange without a residual,
+    docs/zero.md)."""
 
-    __slots__ = ("shards",)
+    __slots__ = ("shards", "new_residuals")
 
-    def __init__(self, shards):
+    def __init__(self, shards, new_residuals=None):
         self.shards = list(shards)
+        self.new_residuals = (None if new_residuals is None
+                              else list(new_residuals))
 
 
 # ---------------------------------------------------------------------------
@@ -549,6 +555,253 @@ def _run_staged(stages: Sequence[Stage], params, info: dict, mode: str,
     if info.get("plain"):
         return loss, tree
     return loss, StagedGrads(tree, new_res)
+
+
+# ---------------------------------------------------------------------------
+# the FSDP (fully-sharded parameter) staged value-and-grad
+# ---------------------------------------------------------------------------
+
+def fsdp_staged_value_and_grad(stages_fn: Callable, opt,
+                               layout=None, prefetch=None):
+    """Build ``vag(rows, *batch, opt_state=None) -> (loss,
+    StagedShards)`` over fully-sharded parameter rows
+    (optim/fsdp.py): the forward's per-bucket parameter all-gathers
+    are prefetch-interleaved with compute — the mirror of the staged
+    backward — and the backward's reduce-scatters ride the existing
+    staged path.
+
+    The forward pin is the inverse of the backward's: where the
+    backward pins each issued collective BEFORE the next segment's
+    compute (so the schedule cannot serialize collectives after
+    backward), the forward pins each prefetched gather BEHIND the
+    activation entering the current segment (so the schedule cannot
+    hoist every gather to t=0 and hold a replicated copy of the model
+    — the memory property that makes FSDP fit models replication
+    can't). Gather bucket k+1 issues at segment k's boundary, overlaps
+    segment k's compute, and its buffer is dropped after its last
+    forward use, so the gather working set stays ~one bucket above the
+    sharded size. ``prefetch`` (default the HOROVOD_FSDP_PREFETCH
+    knob) is the gather look-ahead in stages; 0 serializes each gather
+    at its need boundary.
+
+    ``opt`` must be a FullyShardedOptimizer; its
+    ``update(staged, state, params=shards)`` consumes the result. Under
+    the int8 error-feedback wire pass ``opt_state=`` so the residual
+    rides the staged quantized reduce-scatters (bitwise contract and
+    A/B evidence: docs/fsdp.md, scripts/fsdp_check.py).
+    """
+    info = _reducer_info(opt)
+    if info["kind"] != "fsdp":
+        raise ValueError(
+            "fsdp_staged_value_and_grad needs a FullyShardedOptimizer "
+            "(ShardedOptimizer(params_sharded=True)); got kind "
+            f"{info['kind']!r} — docs/fsdp.md")
+    if layout is None:
+        raise ValueError(
+            "fsdp_staged_value_and_grad requires the FsdpLayout the "
+            "parameter rows were sharded with (optim.fsdp.fsdp_layout)")
+
+    def vag(rows, *batch, opt_state=None):
+        stages = stages_fn(*batch)
+        return _run_fsdp_staged(stages, layout, rows, info, opt_state,
+                                prefetch)
+
+    return vag
+
+
+def _run_fsdp_staged(stages: Sequence[Stage], layout, rows, info: dict,
+                     opt_state, prefetch):
+    from ..core.state import global_state
+    from ..optim import fsdp as fsdp_mod
+    from ..optim import zero as zero_mod
+
+    axis_name = info.get("axis_name")
+    live = collectives._bound_axes(collectives._resolve_axis(axis_name))
+    if len(live) != 1:
+        raise RuntimeError(
+            "the FSDP staged step shards parameters over exactly one "
+            f"live data-parallel axis; got live axes {live} — run "
+            "inside shard_map with the fsdp/dp mesh axis bound")
+    ax = live[0]
+    n = collectives._group_size(info.get("process_set"), axis_name)
+    if n != layout.world:
+        raise ValueError(
+            f"parameter rows were sharded for world {layout.world} but "
+            f"the live group size is {n} — reshard with "
+            "fsdp.reshard_rows before re-entering the train loop")
+    wire = info.get("wire")
+    ef = bool(info.get("error_feedback"))
+    if prefetch is None:
+        prefetch = int(getattr(global_state().knobs, "fsdp_prefetch", 1))
+    depth = max(int(prefetch), 0)
+
+    shards = fsdp_mod.local_shards(rows, layout)
+    plans = list(layout.plans)
+    lens = list(layout.lens)
+    abs_params = fsdp_mod.abstract_params(layout)
+    path_to_idx, leaf_stages = _leaf_index_maps(abs_params, stages)
+    S = len(stages)
+    need = bucket_prefetch_schedule(plans, [min(s) for s in leaf_stages],
+                                    S)
+    leaf_loc = {}
+    for bi, bp in enumerate(plans):
+        for (i, off, sz, shp) in bp:
+            leaf_loc[i] = (bi, off, sz, shp)
+    # last forward stage touching any leaf of each bucket — the point
+    # after which its gathered buffer is dropped
+    last_use = [
+        max(max(leaf_stages[i]) for (i, _, _, _) in bp) for bp in plans
+    ]
+
+    # ---- forward: prefetch-interleaved per-bucket all-gathers ----------
+    gathered = {}
+
+    def _gather(bi, pin):
+        row = shards[bi]
+        if pin is not None and hasattr(pin, "dtype") and \
+                jnp.issubdtype(pin.dtype, jnp.inexact):
+            # the anti-hoist pin: this gather depends on the activation
+            # entering the CURRENT segment, so no scheduler may issue
+            # it before the previous segment retired — yet the current
+            # segment's compute does not depend on it, so they overlap
+            row = _barrier_pair(row, pin)
+        full = jax.lax.all_gather(row, ax, tiled=True)
+        return full[: lens[bi]]
+
+    carry = jnp.zeros((), jnp.float32)
+    vjps = []
+    for s, st in enumerate(stages):
+        for bi in need[s]:
+            if bi not in gathered:  # the fill (or depth 0): need it NOW
+                gathered[bi] = _gather(bi, carry if s else None)
+        for d in range(1, depth + 1):
+            if s + d >= S:
+                break
+            for bi in need[s + d]:
+                if bi not in gathered:
+                    gathered[bi] = _gather(bi, carry if s else None)
+        sub_abs = {k: abs_params[k] for k in st.keys}
+        paths, sub_def = jax.tree_util.tree_flatten_with_path(sub_abs)
+        leaves = []
+        for p, _sds in paths:
+            bi, off, sz, shp = leaf_loc[
+                path_to_idx[jax.tree_util.keystr(p)]]
+            leaves.append(jax.lax.dynamic_slice_in_dim(
+                gathered[bi], off, sz).reshape(shp))
+        sub = jax.tree_util.tree_unflatten(sub_def, leaves)
+
+        def f(sub, carry, _st=st):
+            return _st.fwd(sub, carry)
+
+        carry, vjp = jax.vjp(f, sub, carry)
+        vjps.append(vjp)
+        # drop gathered buffers past their last forward use — the
+        # bounded working set (backward re-reads the per-stage sub
+        # leaves the vjp residuals captured, not these buffers)
+        for bi in [b for b in list(gathered) if last_use[b] == s]:
+            del gathered[bi]
+    loss = carry
+    if jnp.ndim(loss) != 0:
+        raise ValueError(
+            f"the last stage must return a scalar loss; got shape "
+            f"{jnp.shape(loss)}")
+
+    # ---- backward: staged reduce-scatters at availability boundaries ---
+    res_mats = None
+    if ef:
+        if opt_state is None:
+            raise ValueError(
+                "this FullyShardedOptimizer carries error-feedback "
+                "state; pass opt_state= to the staged value_and_grad "
+                "so the residual rides the staged quantized "
+                "reduce-scatters (docs/fsdp.md)")
+        res_mats = fsdp_mod._residual_mats(opt_state, layout, wire.block)
+        if res_mats is None:
+            raise ValueError(
+                "opt_state carries no FsdpEFState residual but the "
+                "optimizer was built on the int8 error-feedback wire")
+    ordered = global_state().knobs.ordered_buckets
+    backward_stage_order = list(reversed(range(S)))
+    schedule = bucket_issue_schedule(plans, leaf_stages,
+                                     backward_stage_order)
+    costs = _stage_cost_bytes(abs_params, stages)
+    leaf_grads: List[Any] = [None] * layout.nleaves
+    reduced: List[Any] = [None] * len(plans)
+    new_res: List[Any] = [None] * len(plans)
+    bucket_meta: List[tuple] = [(0, 0, False)] * len(plans)
+    chain = None
+    first_issue_step = None
+    ct = jnp.ones((), _loss_seed_dtype(loss))
+    for step_i, si in enumerate(backward_stage_order):
+        g_sub, ct_in = vjps[si](ct)
+        for p, g in jax.tree_util.tree_flatten_with_path(g_sub)[0]:
+            i = path_to_idx[jax.tree_util.keystr(p)]
+            leaf_grads[i] = g if leaf_grads[i] is None \
+                else leaf_grads[i] + g
+        for bi in schedule[step_i]:
+            bucket = _pack_bucket(leaf_grads, plans[bi])
+            bucket_meta[bi] = (
+                int(bucket.size), bucket.dtype.itemsize,
+                bool(jnp.issubdtype(bucket.dtype, jnp.floating)))
+            if ordered and chain is not None:
+                bucket = _barrier_pair(bucket, chain)
+            rows_b = zero_mod._pad_rows(bucket, n)
+            if ef:
+                red, nr = zero_mod._scatter_bucket(
+                    rows_b, ax, n, wire, residual=res_mats[bi])
+                new_res[bi] = nr.reshape(1, -1)
+            else:
+                red = zero_mod._scatter_bucket(rows_b, ax, n, wire)
+            reduced[bi] = red
+            chain = red
+            if first_issue_step is None:
+                first_issue_step = step_i
+        if si > 0 and chain is not None and hasattr(ct_in, "dtype") \
+                and jnp.issubdtype(ct_in.dtype, jnp.inexact):
+            ct_in = _barrier_pair(ct_in, chain)
+        ct = ct_in
+    missing = [bi for bi, r in enumerate(reduced) if r is None]
+    if missing:
+        raise AssertionError(
+            f"buckets {missing} never became available — stage "
+            f"decomposition does not cover their leaves")
+
+    total_cost = float(sum(costs)) or 1.0
+    pinned_frac = sum(
+        costs[si] for step_i, si in enumerate(backward_stage_order)
+        if first_issue_step is not None and step_i > first_issue_step
+    ) / total_cost
+    _record_staged_step(bucket_meta, wire, pinned_frac)
+    gather_bytes = sum(
+        n * k * np.dtype(d).itemsize
+        for k, d in zip(layout.ks, layout.dtypes))
+    _record_fsdp_step(layout.shard_bytes, gather_bytes)
+
+    for shard, L in zip(reduced, lens):
+        k = -(-L // n)
+        if shard.shape != (k,):
+            raise AssertionError((shard.shape, k))
+    return loss, StagedShards(reduced,
+                              new_residuals=new_res if ef else None)
+
+
+def _record_fsdp_step(param_bytes: int, gather_bytes: int):
+    """Execution-time FSDP telemetry: the per-device resident parameter
+    bytes (the HBM win) and the full-precision bytes the forward
+    all-gathers re-materialize each step (the wire rent paid for it) —
+    hvd_hbm_param_bytes / hvd_fsdp_gather_bytes_total plus the StepStats
+    JSONL fields (docs/metrics.md)."""
+    import functools
+
+    from ..utils import metrics as _metrics
+
+    if not _metrics.enabled():
+        return
+    from jax.experimental import io_callback
+
+    io_callback(functools.partial(
+        _metrics.record_fsdp_step, int(param_bytes), int(gather_bytes)),
+        None)
 
 
 def _record_staged_step(bucket_meta, wire, pinned_frac):

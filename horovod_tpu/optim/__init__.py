@@ -20,3 +20,7 @@ from .zero import (  # noqa: F401
     reshard_state,
     sharded_state_specs,
 )
+from .fsdp import (  # noqa: F401
+    FullyShardedOptimizer,
+    fsdp_layout,
+)

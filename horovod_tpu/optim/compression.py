@@ -348,25 +348,41 @@ def quantized_psum(x, axis: str, n: int, block: int = DEFAULT_BLOCK,
 
 
 def quantized_reduce_scatter_rows(rows, axis: str,
-                                  block: int = DEFAULT_BLOCK):
+                                  block: int = DEFAULT_BLOCK,
+                                  residual=None):
     """SUM-reduce-scatter of a ``(n, k)`` row stack over mesh axis
     `axis`: rank r receives ``sum_ranks(rows[r])`` as a float32 ``(k,)``
     shard, with each row block-quantized for the exchange (the ZeRO
     reduce-scatter wire, optim/zero.py). Rows are padded to the block
     internally, so `k` — and therefore the sharded optimizer-state
-    layout — is unchanged by compression."""
+    layout — is unchanged by compression.
+
+    With ``residual`` (float32 ``(n, ceil(k/block)*block)``, this
+    rank's previous-step quantization error over its WHOLE padded row
+    stack — the rank-private error-feedback shard the FSDP path
+    carries, optim/fsdp.py) the payload is error-compensated before
+    quantizing and the call returns ``(shard, new_residual)`` so the
+    compressed reduce-scatter stays unbiased across steps. The residual
+    is rank-private by construction: each rank compensates only the
+    contribution it quantizes, never a peer's."""
     n, k = rows.shape
     k2 = -(-k // block) * block
     if k2 != k:
         rows = jnp.pad(rows, ((0, 0), (0, k2 - k)))
-    q, s = quantize_blocks(rows.astype(jnp.float32).reshape(-1), block)
+    rows_f = rows.astype(jnp.float32)
+    if residual is not None:
+        rows_f = rows_f + residual.astype(jnp.float32).reshape(n, k2)
+    q, s = quantize_blocks(rows_f.reshape(-1), block)
     # row-major layout: row r occupies [r*k2, (r+1)*k2) and block
     # divides k2, so blocks never straddle rows and the tiled all_to_all
     # (chunk r = row r, scales likewise) keeps payload/scales aligned
     qg = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
     sg = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
     shard = dequantize_blocks(qg, sg, block).reshape(n, k2).sum(axis=0)
-    return shard[:k]
+    if residual is None:
+        return shard[:k]
+    new_res = rows_f - dequantize_blocks(q, s, block).reshape(n, k2)
+    return shard[:k], new_res
 
 
 class Compression:

@@ -98,19 +98,34 @@ def _pad_rows(b, n):
     return out.reshape(n, k)
 
 
-def _scatter_bucket(rows, ax, n, wire):
+def _scatter_bucket(rows, ax, n, wire, residual=None):
     """Reduce-scatter one padded (n, k) gradient bucket to this rank's
     AVERAGED (k,) shard on the configured wire — the shared per-bucket
-    data plane of the monolithic chain (update_fn) and the
-    backward-interleaved scheduler (ops/overlap.py), extracted verbatim
-    so both trace identical collectives."""
+    data plane of the monolithic chain (update_fn), the
+    backward-interleaved scheduler (ops/overlap.py), and the FSDP
+    backward (optim/fsdp.py), extracted verbatim so all three trace
+    identical collectives.
+
+    ``residual`` (int8 wire only) is this rank's error-feedback shard
+    over the padded row stack; when given, the return is
+    ``(shard, new_residual)`` — the FSDP path carries it
+    (docs/fsdp.md), the ZeRO-1 path never passes it (the residual
+    would change its state layout, docs/zero.md)."""
     from .compression import quantized_reduce_scatter_rows, wire_applies
 
     if wire_applies(wire, rows.dtype) and wire.kind == "int8":
         # block-quantized exchange; the shard SUM comes back in
         # f32 and averages exactly like the uncompressed path
+        if residual is not None:
+            shard, new_res = quantized_reduce_scatter_rows(
+                rows, ax, wire.block, residual=residual)
+            return (shard / n).astype(rows.dtype), new_res
         return (quantized_reduce_scatter_rows(
             rows, ax, wire.block) / n).astype(rows.dtype)
+    if residual is not None:
+        raise ValueError(
+            "error-feedback residual passed for a non-int8 wire — only "
+            "the quantized exchange produces an error to feed back")
     if wire_applies(wire, rows.dtype):
         return (jax.lax.psum_scatter(
             rows.astype(wire.wire_dtype).reshape(-1), ax,
@@ -129,7 +144,8 @@ def _as_staged_shards(grads):
 def ShardedOptimizer(optimizer, axis_name=None,
                      fusion_threshold_bytes=None,
                      bucket_backward_order=None,
-                     compression=None):
+                     compression=None,
+                     params_sharded=False):
     """Wrap an elementwise optax optimizer so its state is sharded 1/N
     per rank (ZeRO stage 1). Returns an optax GradientTransformation
     whose `update()` reduce-scatters gradient buckets (backward-ordered,
@@ -149,8 +165,24 @@ def ShardedOptimizer(optimizer, axis_name=None,
     precision (it carries the applied update, not a SUM), and the int8
     reduce-scatter runs without error feedback — the residual would
     need a state-layout change; use DistributedOptimizer for int8+EF.
-    ``none`` is bitwise-identical to the pre-compression behavior."""
+    ``none`` is bitwise-identical to the pre-compression behavior.
+
+    ``params_sharded=True`` escalates from ZeRO-1 to ZeRO-3: it returns
+    :func:`horovod_tpu.optim.fsdp.FullyShardedOptimizer` over the same
+    arguments — parameters themselves live sharded as per-bucket rows
+    and the train step gathers them bucket-by-bucket in the forward
+    (docs/fsdp.md). The two spellings are interchangeable entry points
+    to the same optimizer."""
     import optax
+
+    if params_sharded:
+        from .fsdp import FullyShardedOptimizer
+
+        return FullyShardedOptimizer(
+            optimizer, axis_name=axis_name,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            bucket_backward_order=bucket_backward_order,
+            compression=compression)
 
     def init_fn(params):
         n = _world(axis_name)

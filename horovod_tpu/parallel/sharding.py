@@ -119,6 +119,19 @@ def batch_sharding(mesh: Mesh, *, seq_axis: Optional[int] = None):
     return NamedSharding(mesh, P(*entries))
 
 
+def fsdp_row_shardings(layout, mesh: Mesh, axis_name=None):
+    """NamedShardings for a fully-sharded parameter row dict
+    (optim/fsdp.py, docs/fsdp.md): each `(world, k)` bucket row stack
+    sharded one row per device over the data axis — the manual-layout
+    counterpart of TRANSFORMER_RULES' per-tensor `fsdp` annotations
+    (there XLA SPMD shards named tensor dims; here the FSDP step owns
+    the layout and gathers bucket-wise). Thin delegate so sharding
+    policy stays discoverable in one module."""
+    from ..optim.fsdp import param_row_shardings
+
+    return param_row_shardings(layout, mesh, axis_name)
+
+
 def logical_rules_to_shardings(*args, **kw):  # pragma: no cover
     raise NotImplementedError(
         "flax logical-axis metadata is intentionally unused; see "

@@ -116,12 +116,162 @@ def make_lm_train_step(
         return params, opt_state, loss
 
     donate_argnums = (0, 1) if donate else ()
+    fsdp_fns = _maybe_fsdp_step_fn(
+        cfg, model, optimizer, mesh, batch_spec, sequence_parallel,
+        donate)
+    if fsdp_fns is not None:
+        fsdp_init_fn, fsdp_step_fn = fsdp_fns
+        return fsdp_init_fn, fsdp_step_fn, batch_sharding
     staged_fn = _maybe_staged_step_fn(
         model, optimizer, mesh, batch_spec, sequence_parallel, donate)
     if staged_fn is not None:
         return init_fn, staged_fn, batch_sharding
     step_fn = jax.jit(step, donate_argnums=donate_argnums)
     return init_fn, step_fn, batch_sharding
+
+
+def _count_weighted_stages(model, want, n_world):
+    """Stage builder closing over a token batch: each shard's mean loss
+    weighted by its share of the global valid-token count, so AVERAGE-
+    reduced gradients and the psum/n_world loss reproduce the
+    monolithic step's single global mean even when ignore_index padding
+    is uneven across shards (shared by the staged and FSDP step
+    builders — with equal per-shard counts w == 1.0 exactly)."""
+    from ..models.transformer import causal_lm_loss
+    from ..ops import overlap as overlap_mod
+
+    def stages_for(tokens):
+        # clamp only the global denominator: a zero-valid shard must
+        # contribute weight 0, not inflate the world count by 1
+        c = jnp.sum(tokens[:, 1:] != -1).astype(jnp.float32)
+        w = c * n_world / jnp.maximum(jax.lax.psum(c, want), 1.0)
+
+        def head_loss(logits, _tk=tokens, _w=w):
+            loss, _ = causal_lm_loss(logits, _tk)
+            return loss * _w
+
+        return overlap_mod.transformer_lm_stages(model, tokens,
+                                                 head_loss)
+
+    return stages_for
+
+
+def _maybe_fsdp_step_fn(cfg, model, optimizer, mesh, batch_spec,
+                        sequence_parallel, donate):
+    """When the optimizer is a FullyShardedOptimizer
+    (`ShardedOptimizer(params_sharded=True)`), build the
+    fully-sharded-parameter train step (optim/fsdp.py, docs/fsdp.md):
+    parameters live as per-bucket row shards over the data/fsdp mesh
+    axis, the forward prefetch-gathers them bucket-by-bucket
+    interleaved with compute, the backward reduce-scatters ride the
+    staged path, and the update applies to the local shard. Returns
+    ``(init_fn, step_fn)`` — init_fn yields the SHARDED row dict, not
+    a replicated params pytree, so the whole train state is ~1/world
+    per device. Anything this step cannot drive raises loudly (an
+    fsdp-kind optimizer has no monolithic fallback: its update consumes
+    staged shards only); non-FSDP optimizers return None and take
+    today's paths bit-for-bit regardless of the HOROVOD_FSDP knob."""
+    import functools
+
+    from ..core.state import global_state
+    from ..compat import shard_map as _shard_map
+    from ..ops import collectives as _coll
+    from ..ops import overlap as overlap_mod
+    from ..optim import fsdp as fsdp_mod
+    from ..optim.zero import sharded_state_specs
+
+    info = getattr(getattr(optimizer, "update", None),
+                   "_hvd_overlap_info", None)
+    if info is None or info.get("kind") != "fsdp":
+        return None
+    knobs = global_state().knobs
+    if not knobs.fsdp:
+        raise ValueError(
+            "HOROVOD_FSDP=0 but the optimizer is a "
+            "FullyShardedOptimizer — its update consumes staged shards "
+            "and cannot ride the monolithic paths; turn the knob on or "
+            "use ShardedOptimizer/DistributedOptimizer (docs/fsdp.md)")
+    if sequence_parallel is not None:
+        raise ValueError(
+            "the FSDP step does not compose with manual sequence "
+            "parallelism yet — use ShardedOptimizer or the auto-pjit "
+            "path for sp meshes (docs/fsdp.md)")
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axes = data_axes(mesh)
+    extra = [a for a, s in sizes.items()
+             if s > 1 and a not in ("dp", "fsdp")]
+    if extra or len(axes) != 1:
+        raise ValueError(
+            f"the FSDP step shards parameters over exactly one live "
+            f"data axis; mesh has data axes {axes} and extra live axes "
+            f"{extra} (docs/fsdp.md)")
+    want = _coll._resolve_axis(info.get("axis_name"))
+    if set(want) != set(axes):
+        raise ValueError(
+            f"FullyShardedOptimizer reduces over axes {want} but the "
+            f"batch is sharded over {axes} — construct it with "
+            f"axis_name={axes[0]!r}")
+    ax = axes[0]
+    n_world = sizes[ax]  # > 1: data_axes only returns live axes
+
+    abs_params = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.ones((1, cfg.max_seq_len), jnp.int32))["params"])
+    layout = fsdp_mod.fsdp_layout(
+        abs_params, world=n_world,
+        fusion_threshold_bytes=info.get("fusion_threshold_bytes"),
+        bucket_backward_order=info.get("bucket_backward_order"))
+    row_specs = fsdp_mod.param_row_specs(layout, info.get("axis_name"))
+    row_shardings = {k: NamedSharding(mesh, s)
+                     for k, s in row_specs.items()}
+
+    def fsdp_init_fn(rng, sample_tokens):
+        abs_opt = jax.eval_shape(optimizer.init, abs_params)
+        state_specs = sharded_state_specs(abs_opt,
+                                          info.get("axis_name"))
+        state_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), state_specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+        @functools.partial(
+            jax.jit, out_shardings=(row_shardings, state_shardings))
+        def _init(r, s):
+            params = model.init(r, s)["params"]
+            return (fsdp_mod.shard_params(params, layout),
+                    optimizer.init(params))
+
+        return _init(rng, sample_tokens)
+
+    svag = overlap_mod.fsdp_staged_value_and_grad(
+        _count_weighted_stages(model, want, n_world), optimizer,
+        layout, prefetch=knobs.fsdp_prefetch)
+
+    def fsdp_step(rows, opt_state, tokens):
+        loss, g = svag(rows, tokens, opt_state=opt_state)
+        upd, opt_state = optimizer.update(
+            g, opt_state, fsdp_mod.local_shards(rows, layout))
+        rows = fsdp_mod.apply_shard_updates(rows, upd, layout)
+        loss = jax.lax.psum(loss, want) / n_world
+        return rows, opt_state, loss.reshape(())
+
+    cache = {}
+
+    def step_fn(rows, opt_state, tokens):
+        key = jax.tree_util.tree_structure(opt_state)
+        if key not in cache:
+            state_specs = sharded_state_specs(opt_state,
+                                              info.get("axis_name"))
+            fn = _shard_map(
+                fsdp_step, mesh=mesh,
+                in_specs=(row_specs, state_specs, batch_spec),
+                out_specs=(row_specs, state_specs, P()),
+                check_vma=False)
+            cache[key] = jax.jit(
+                fn, donate_argnums=(0, 1) if donate else ())
+        return cache[key](rows, opt_state, tokens)
+
+    return fsdp_init_fn, step_fn
 
 
 def _maybe_staged_step_fn(model, optimizer, mesh, batch_spec,
@@ -135,7 +285,6 @@ def _maybe_staged_step_fn(model, optimizer, mesh, batch_spec,
     unchanged (bit-for-bit today's trace), so flipping the knob is
     always safe."""
     from ..compat import shard_map as _shard_map
-    from ..models.transformer import causal_lm_loss
     from ..ops import collectives as _coll
     from ..ops import overlap as overlap_mod
 
@@ -144,6 +293,11 @@ def _maybe_staged_step_fn(model, optimizer, mesh, batch_spec,
     info = getattr(getattr(optimizer, "update", None),
                    "_hvd_overlap_info", None)
     if info is None or overlap_mod.check_supported(info) is not None:
+        return None
+    if info.get("kind") == "fsdp":
+        # fully-sharded optimizers are routed by _maybe_fsdp_step_fn
+        # (which raises rather than falling back when it can't drive
+        # them); never hand one to the replicated staged step
         return None
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     if any(s > 1 for a, s in sizes.items() if a != "dp"):
@@ -166,26 +320,8 @@ def _maybe_staged_step_fn(model, optimizer, mesh, batch_spec,
     if n_world <= 1:
         return None
 
-    def stages_for(tokens):
-        # weight each shard's mean loss by its share of the global
-        # valid-token count so the AVERAGE-reduced gradients and the
-        # psum/n_world loss below reproduce the monolithic step's
-        # single global mean even when ignore_index padding is uneven
-        # across shards; with equal per-shard counts w == 1.0 exactly
-        # (power-of-two worlds) and the staged values are unchanged
-        # clamp only the global denominator: a zero-valid shard must
-        # contribute weight 0, not inflate the world count by 1
-        c = jnp.sum(tokens[:, 1:] != -1).astype(jnp.float32)
-        w = c * n_world / jnp.maximum(jax.lax.psum(c, want), 1.0)
-
-        def head_loss(logits, _tk=tokens, _w=w):
-            loss, _ = causal_lm_loss(logits, _tk)
-            return loss * _w
-
-        return overlap_mod.transformer_lm_stages(model, tokens,
-                                                 head_loss)
-
-    svag = overlap_mod.staged_value_and_grad(stages_for, opt=optimizer)
+    svag = overlap_mod.staged_value_and_grad(
+        _count_weighted_stages(model, want, n_world), opt=optimizer)
 
     def staged_step(params, opt_state, tokens):
         import optax

@@ -98,6 +98,20 @@ def parse_args(argv: Optional[List[str]] = None):
                         "the next segment's compute; 'double' also "
                         "defers optimizer consumption until the last "
                         "segment retires; default off")
+    p.add_argument("--fsdp", dest="fsdp", choices=["0", "1"],
+                   help="fully-sharded parameters / ZeRO-3 routing "
+                        "(HOROVOD_FSDP, docs/fsdp.md): 1 (default) "
+                        "routes FullyShardedOptimizer train steps "
+                        "through the prefetch-interleaved FSDP path — "
+                        "params + optimizer state ~1/world per chip; "
+                        "0 disables routing (such a step then raises; "
+                        "non-FSDP configs are untouched either way)")
+    p.add_argument("--fsdp-prefetch", dest="fsdp_prefetch", type=int,
+                   help="FSDP forward all-gather look-ahead in stages "
+                        "(HOROVOD_FSDP_PREFETCH, default 1): bucket "
+                        "k+1's parameter gather issues at segment k's "
+                        "boundary and overlaps its compute; 0 "
+                        "serializes gathers at their need boundaries")
     p.add_argument("--compression-wire-dtype",
                    dest="compression_wire_dtype",
                    choices=["bfloat16", "float16"])

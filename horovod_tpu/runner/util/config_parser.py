@@ -24,6 +24,10 @@ ARG_TO_ENV = {
     "compression": "HOROVOD_COMPRESSION",
     "compression_block": "HOROVOD_COMPRESSION_BLOCK",
     "overlap_schedule": "HOROVOD_OVERLAP_SCHEDULE",
+    # --fsdp stores the literal "0"/"1" (env_from_args skips boolean
+    # False, so a store_false flag could never reach the env)
+    "fsdp": "HOROVOD_FSDP",
+    "fsdp_prefetch": "HOROVOD_FSDP_PREFETCH",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
     "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
     "hierarchical_local_size": "HOROVOD_HIERARCHICAL_LOCAL_SIZE",

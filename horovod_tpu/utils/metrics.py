@@ -565,6 +565,8 @@ class StepStats:
         self.wire_logical = 0
         self.wire_sent = 0
         self.overlap_window = None  # staged-scheduler pin (0..1)
+        self.fsdp_param_bytes = None  # per-device resident param bytes
+        self.fsdp_gather_bytes = 0    # forward all-gather bytes
         self.mfu = None             # model-FLOPs utilization (0..1)
         self.attribution = None     # sampled device attribution dict
         self.queue_depth = 0
@@ -603,6 +605,11 @@ class StepStats:
     def set_overlap_window(self, frac: float) -> None:
         with self._lock:
             self.overlap_window = float(frac)
+
+    def add_fsdp(self, param_bytes: int, gather_bytes: int) -> None:
+        with self._lock:
+            self.fsdp_param_bytes = int(param_bytes)
+            self.fsdp_gather_bytes += int(gather_bytes)
 
     def set_mfu(self, mfu: float) -> None:
         with self._lock:
@@ -694,6 +701,11 @@ class StepStats:
                 }
             if self.overlap_window is not None:
                 record["overlap_window_frac"] = self.overlap_window
+            if self.fsdp_param_bytes is not None:
+                record["fsdp"] = {
+                    "hbm_param_bytes": self.fsdp_param_bytes,
+                    "gather_bytes": self.fsdp_gather_bytes,
+                }
             if self.mfu is not None:
                 record["mfu"] = self.mfu
             if self.attribution is not None:
@@ -909,6 +921,29 @@ def record_overlap_window(frac: float) -> None:
         "Backward fraction pinned after the first gradient collective "
         "by the overlap schedule").set(float(frac))
     step_stats.set_overlap_window(frac)
+
+
+def record_fsdp_step(param_bytes: int, gather_bytes: int) -> None:
+    """One executed fully-sharded-parameter step (optim/fsdp.py,
+    io_callback from the compiled step): the per-device parameter bytes
+    RESIDENT in HBM (the sharded footprint — under FSDP ~1/world of
+    the replicated size; the durable memory win) and the full-precision
+    parameter bytes the forward all-gathers re-materialized this step
+    (the recurring wire rent paid for it). Their ratio per step is
+    ~world: FSDP trades gather bandwidth for resident HBM
+    (docs/fsdp.md)."""
+    if not _enabled:
+        return
+    registry.gauge(
+        "hvd_hbm_param_bytes",
+        "Per-device parameter bytes resident in HBM (sharded "
+        "footprint under FSDP; replicated size otherwise)").set(
+            float(param_bytes))
+    registry.counter(
+        "hvd_fsdp_gather_bytes_total",
+        "Full-precision parameter bytes materialized by FSDP forward "
+        "all-gathers").inc(float(gather_bytes))
+    step_stats.add_fsdp(param_bytes, gather_bytes)
 
 
 def record_mfu(mfu: float) -> None:

@@ -133,6 +133,16 @@ def summarize(records):
         print("overlap: unscheduled (HOROVOD_OVERLAP_SCHEDULE off — "
               "collectives placed at the compiler's discretion)")
 
+    # fully-sharded parameters (optim/fsdp.py, docs/fsdp.md): steps
+    # carrying the fsdp object ran the prefetch-interleaved FSDP step
+    fsdp = [r["fsdp"] for r in records if "fsdp" in r]
+    if fsdp:
+        last = fsdp[-1]
+        gathered = sum(f.get("gather_bytes", 0) for f in fsdp)
+        print(f"fsdp: param shard {_human_bytes(last['hbm_param_bytes'])}"
+              f" resident/device, {_human_bytes(gathered)} gathered "
+              f"over {len(fsdp)}/{len(records)} sharded steps")
+
     # continuous profiler (utils/prof.py, docs/timeline.md): hvd_mfu is
     # per-step once set_step_flops declared the model cost; attribution
     # rides the steps whose sampled capture finished parsing

@@ -422,6 +422,371 @@ def compile_and_analyze(model, mesh, nchips, fusion_mb, batch_per_chip,
     return r
 
 
+def analyze_gather(txt, min_elems: int = 256):
+    """Scheduled-module analysis of the FSDP forward (docs/fsdp.md):
+    how much forward compute does the optimized schedule place BEFORE
+    the LAST parameter all-gather issues — i.e. compute available to
+    hide the gathers behind. The naive gather-everything-up-front
+    lowering scores ~0 (every gather precedes all compute, and a full
+    replicated copy of the model is live from t=0); the
+    prefetch-interleaved schedule spreads the gathers through the
+    forward and scores high. Plain-wire steps only: the int8 backward
+    wire emits its own all-gathers and would pollute the count."""
+    all_lines = txt.splitlines()
+    start = next(i for i, l in enumerate(all_lines)
+                 if l.startswith("ENTRY"))
+    lines = all_lines[start:]
+    ags = [i for i, l in enumerate(lines)
+           if re.search(r' all-gather(-start)?\(', l)
+           and _ar_elems(l) >= min_elems]
+    fwd = [i for i, l in enumerate(lines)
+           if "op_name=" in l and "transpose" not in l
+           and re.search(r' (dot|fusion|convolution|custom-call)\(', l)]
+    before = sum(1 for f in fwd if ags and f < ags[-1])
+    return {
+        "scheduled": "is_scheduled=true" in txt,
+        "param_all_gathers_in_optimized_hlo": len(ags),
+        "forward_compute_ops": len(fwd),
+        "forward_ops_scheduled_before_last_all_gather": before,
+        "gather_window_frac": round(before / len(fwd), 4) if fwd
+        else 0.0,
+    }
+
+
+def analyze_gather_preopt(txt, min_elems: int = 256):
+    """Structural analysis of the PRE-optimization HLO for the FSDP
+    forward: how many forward dots sit in each parameter all-gather's
+    transitive PRODUCER closure. A gather whose producers include
+    compute cannot be hoisted to t=0 by ANY correct scheduler — the
+    anti-hoist mirror of analyze_preopt's consumer-closure proof, and
+    the evidence that survives pipelines whose barrier expander erases
+    optimization_barrier post-opt (XLA CPU). With prefetch the LAST
+    bucket's gather depends on nearly the whole forward
+    (pinned_fwd_dot_frac ≫ 0); the up-front lowering's gathers depend
+    on nothing (0 pinned)."""
+    comps = _split_computations(txt)
+
+    def _gathers(body):
+        return [i for i, l in enumerate(body)
+                if re.search(r' all-gather\(', l)
+                and _ar_elems(l) >= min_elems]
+
+    best, ags = None, []
+    for name, body in comps.items():
+        a = _gathers(body)
+        if len(a) > len(ags):
+            best, ags = name, a
+    out = {
+        "param_all_gathers": len(ags),
+        "gathers_pinned_behind_compute": 0,
+        "fwd_dots_total": 0,
+        "fwd_dots_pinned_before_last_gather": 0,
+        "pinned_fwd_dot_frac": 0.0,
+    }
+    if best is None:
+        return out
+    body = comps[best]
+    fwd_dots = [i for i, l in enumerate(body)
+                if re.search(r' (dot|convolution)\(', l)
+                and "transpose" not in l]
+    out["fwd_dots_total"] = len(fwd_dots)
+    # def/operand maps (pre-opt names are word.number tokens)
+    defs = {}
+    refs_of = {}
+    for i, l in enumerate(body):
+        m = _PAT_LHS.match(l)
+        if not m:
+            continue
+        defs[m.group(1)] = i
+        refs_of[i] = re.findall(r'([A-Za-z_][\w-]*\.\d+)',
+                                l.split(" = ", 1)[1])
+
+    def producer_closure(start_i):
+        seen, stack = set(), [start_i]
+        while stack:
+            i = stack.pop()
+            if i in seen:
+                continue
+            seen.add(i)
+            for ref in refs_of.get(i, ()):
+                j = defs.get(ref)
+                if j is not None and j not in seen:
+                    stack.append(j)
+        return seen
+
+    fwd_set = set(fwd_dots)
+    pinned_gathers = 0
+    for g in ags:
+        if producer_closure(g) & fwd_set:
+            pinned_gathers += 1
+    out["gathers_pinned_behind_compute"] = pinned_gathers
+    if ags:
+        last = producer_closure(ags[-1]) & fwd_set
+        out["fwd_dots_pinned_before_last_gather"] = len(last)
+        if fwd_dots:
+            out["pinned_fwd_dot_frac"] = round(
+                len(last) / len(fwd_dots), 4)
+    return out
+
+
+def build_fsdp_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
+                    mode="prefetch", compression=None, prefetch=None):
+    """The FSDP train step over sharded parameter rows: same model
+    config/loss/optimizer as build_step, parameters living as
+    per-bucket row shards (optim/fsdp.py). ``mode="upfront"`` is the
+    naive gather-everything-at-t0 reference; ``"prefetch"`` the
+    interleaved schedule. Returns (jitted step, rows, state, token
+    shape, layout)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.optim import fsdp as fsdp_mod
+
+    cfg, model, loss_of_logits, bpc = _model_pieces(
+        model_name, batch_per_chip)
+    T = cfg.max_seq_len
+    comp = hvd.Compression.lookup(compression) if compression else None
+    toks_s = jax.ShapeDtypeStruct((bpc * nchips, T), jnp.int32)
+    params = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.ones((1, T), jnp.int32)))["params"]
+    opt = hvd.FullyShardedOptimizer(
+        optax.adamw(1e-4),
+        fusion_threshold_bytes=int(fusion_mb * (1 << 20)),
+        compression=comp)
+    layout = fsdp_mod.fsdp_layout(
+        params, world=nchips,
+        fusion_threshold_bytes=int(fusion_mb * (1 << 20)))
+    rows_s = {
+        k: jax.ShapeDtypeStruct((nchips, layout.ks[i]),
+                                layout.dtypes[i])
+        for i, k in enumerate(
+            fsdp_mod.bucket_name(j) for j in range(len(layout.plans)))
+    }
+    state = jax.eval_shape(lambda: opt.init(jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype), params)))
+    state_specs = hvd.sharded_state_specs(state)
+    row_specs = fsdp_mod.param_row_specs(layout)
+
+    def stages_for(b):
+        return hvd.overlap.transformer_lm_stages(
+            model, b, lambda lg, _b=b: loss_of_logits(lg, _b))
+
+    vag = fsdp_mod.fsdp_value_and_grad(stages_for, opt, layout,
+                                       mode=mode, prefetch=prefetch)
+
+    def step(r, s, b):
+        l, g = vag(r, b, opt_state=s)
+        upd, s = opt.update(g, s, fsdp_mod.local_shards(r, layout))
+        r = fsdp_mod.apply_shard_updates(r, upd, layout)
+        return r, s, jax.lax.psum(l, "hvd").reshape(1)
+
+    js = jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(row_specs, state_specs, P("hvd")),
+        out_specs=(row_specs, state_specs, P()), check_vma=False))
+    return js, rows_s, state, toks_s, layout
+
+
+def _fsdp_compile_and_analyze(model, mesh, nchips, fusion_mb,
+                              batch_per_chip, mode, compression=None,
+                              min_elems=256):
+    js, rows_s, state, toks_s, _ = build_fsdp_step(
+        model, mesh, nchips, fusion_mb, batch_per_chip, mode=mode,
+        compression=compression)
+    low = js.lower(rows_s, state, toks_s)
+    # serialize the pre-opt module ONCE (tens of MB on the real
+    # vehicles) and feed both analyzers
+    preopt_txt = low.compiler_ir(dialect="hlo").as_hlo_text()
+    r = analyze_gather(low.compile().as_text(), min_elems=min_elems)
+    r["preopt"] = analyze_gather_preopt(preopt_txt,
+                                        min_elems=min_elems)
+    # the backward half still rides the staged reduce-scatter path —
+    # reuse the consumer-closure proof so one artifact shows both
+    # directions pinned
+    r["preopt_backward"] = analyze_preopt(preopt_txt,
+                                          min_elems=min_elems)
+    return r
+
+
+def trees_bitwise_equal(a, b):
+    """Leaf-wise np.array_equal over two pytrees — the shared parity
+    predicate of the fsdp/overlap gates (scripts/fsdp_check.py imports
+    it so the two gates can never drift in strictness)."""
+    import numpy as np
+
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)))
+
+
+def _fsdp_cpu_exec_ab(model, mesh, nchips, fusion_mb, batch_per_chip,
+                      compression, steps=4):
+    """Execute upfront/prefetch steps on the CPU host mesh: bitwise
+    parity of one step (params rows, optimizer state, loss) + median
+    wall step time for each mode."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.optim import fsdp as fsdp_mod
+
+    cfg, model_obj, _, bpc = _model_pieces(model, batch_per_chip)
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (bpc * nchips, cfg.max_seq_len)),
+        jnp.int32)
+    params = model_obj.init(jax.random.PRNGKey(0), toks[:1])["params"]
+    comp = hvd.Compression.lookup(compression) if compression else None
+    out, results = {}, {}
+    for mode in ("upfront", "prefetch"):
+        js, _, _, _, layout = build_fsdp_step(
+            model, mesh, nchips, fusion_mb, batch_per_chip, mode=mode,
+            compression=compression)
+        opt = hvd.FullyShardedOptimizer(
+            optax.adamw(1e-4),
+            fusion_threshold_bytes=int(fusion_mb * (1 << 20)),
+            compression=comp)
+        rows = fsdp_mod.shard_params(params, layout)
+        state = opt.init(params)
+        r = js(rows, state, toks)
+        jax.block_until_ready(r)
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            r2 = js(rows, state, toks)
+            jax.block_until_ready(r2)
+            times.append(time.perf_counter() - t0)
+        results[mode] = r
+        out[f"step_time_ms_{mode}"] = round(_median(times) * 1e3, 2)
+    out["params_bitwise_equal"] = trees_bitwise_equal(
+        results["upfront"][0], results["prefetch"][0])
+    out["state_bitwise_equal"] = trees_bitwise_equal(
+        results["upfront"][1], results["prefetch"][1])
+    out["loss_bitwise_equal"] = trees_bitwise_equal(
+        results["upfront"][2], results["prefetch"][2])
+    return out
+
+
+_FSDP_AB_NOTE = (
+    "FSDP A/B: off = naive gather-everything-up-front lowering (every "
+    "parameter all-gather unpinned at t=0 — a full replicated copy of "
+    "the model is live for the whole step); on = prefetch-interleaved "
+    "forward (hvd.fsdp, docs/fsdp.md) — bucket k+1's all-gather is "
+    "pinned BEHIND the activation entering segment k via "
+    "optimization_barrier, so it cannot hoist to t=0 yet overlaps "
+    "segment k's compute, and the gathered buffer drops after last "
+    "use. gather_window_frac = forward compute the optimized schedule "
+    "places before the last parameter all-gather (compute available "
+    "to hide gathers); preopt.pinned_fwd_dot_frac = forward dots in "
+    "the last gather's transitive PRODUCER closure — a dependency any "
+    "correct scheduler must respect, the anti-hoist lower bound that "
+    "survives barrier-expanding backends. preopt_backward shows the "
+    "reduce-scatters still pin backward compute (the PR 9 property, "
+    "now on the FSDP path). step_time_ms rows appear only in --cpu "
+    "mode."
+)
+
+
+def fsdp_ab(args):
+    """--fsdp-ab: prefetch-vs-upfront A/B of the fully-sharded
+    parameter step into one JSON artifact (the `fsdp` run_all_checks
+    gate drives the --cpu --check form via scripts/fsdp_check.py)."""
+    import horovod_tpu as hvd
+
+    if args.cpu:
+        hvd.shutdown()
+        hvd.init()
+        mesh = hvd.mesh()
+        nchips = len(jax.devices())
+        topo_name = f"cpu host mesh ({nchips} devices)"
+    else:
+        from jax.experimental import topologies
+
+        topology = args.topology.split(",")[0]
+        topo = topologies.get_topology_desc(
+            topology_name=topology, platform="tpu")
+        nchips = len(topo.devices)
+        mesh = topologies.make_mesh(topo, (nchips,), ("hvd",))
+        hvd.shutdown()
+        hvd.init(mesh=mesh)
+        topo_name = f"{topology} ({nchips} chips, AOT)"
+
+    rows, failures = [], []
+    for model in args.model.split(","):
+        min_elems = 256 if model in ("tiny", "toy") else 10_000
+        row = {
+            "model": model, "topology": topo_name,
+            "fusion_mb": args.fusion_mb, "wire": "none",
+        }
+        t0 = time.perf_counter()
+        off = _fsdp_compile_and_analyze(
+            model, mesh, nchips, args.fusion_mb, args.batch_per_chip,
+            "upfront", min_elems=min_elems)
+        on = _fsdp_compile_and_analyze(
+            model, mesh, nchips, args.fusion_mb, args.batch_per_chip,
+            "prefetch", min_elems=min_elems)
+        row["off"] = off
+        row["on"] = on
+        row["window_delta"] = round(
+            on["gather_window_frac"] - off["gather_window_frac"], 4)
+        row["compile_wall_s"] = round(time.perf_counter() - t0, 1)
+        if args.cpu:
+            row["exec"] = _fsdp_cpu_exec_ab(
+                model, mesh, nchips, args.fusion_mb,
+                args.batch_per_chip, None)
+            row["exec_int8"] = _fsdp_cpu_exec_ab(
+                model, mesh, nchips, args.fusion_mb,
+                args.batch_per_chip, "int8")
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+        if args.check:
+            # the pinned fraction scales with depth: the last-needed
+            # bucket's gather pins everything before its prefetch
+            # boundary, ~ (S-3)/S of forward for S stages — ≥ 0.5 on
+            # the 26-stage BERT-L vehicle, structurally ~0.25 on the
+            # 6-stage tiny gate vehicle
+            floor = 0.2 if model in ("tiny", "toy") else 0.5
+            pin_on = on["preopt"]["pinned_fwd_dot_frac"]
+            pin_off = off["preopt"]["gathers_pinned_behind_compute"]
+            if pin_on < floor:
+                failures.append(
+                    f"{model}: prefetch pins only {pin_on} of forward "
+                    f"compute before the last gather (floor {floor})")
+            if pin_off != 0:
+                failures.append(
+                    f"{model}: upfront lowering unexpectedly pins "
+                    f"{pin_off} gathers — off is no longer the naive "
+                    f"reference")
+            if on["preopt_backward"][
+                    "dots_pinned_after_first_all_reduce"] <= 0:
+                failures.append(
+                    f"{model}: FSDP backward pins no compute behind "
+                    f"the first reduce-scatter")
+            if args.cpu:
+                for key in ("exec", "exec_int8"):
+                    e = row[key]
+                    if not (e["params_bitwise_equal"]
+                            and e["state_bitwise_equal"]
+                            and e["loss_bitwise_equal"]):
+                        failures.append(
+                            f"{model}/{key}: prefetch vs upfront NOT "
+                            f"bitwise equal")
+
+    doc = {"note": _FSDP_AB_NOTE, "runs": rows}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    if args.check:
+        if failures:
+            for fmsg in failures:
+                print("fsdp-ab check FAILED:", fmsg)
+            return 1
+        print(f"fsdp-ab check OK: {len(rows)} A/B rows, bitwise "
+              f"parity + gather pin structure hold"
+              + (f", artifact {args.out}" if args.out else ""))
+    return 0
+
+
 _NOTE = (
     "overlap_window_frac = fraction of backward compute ops the "
     "optimized schedule places after the first gradient all-reduce "
@@ -656,6 +1021,10 @@ def main(argv=None):
     ap.add_argument("--schedule-ab", action="store_true",
                     help="scheduled-vs-unscheduled A/B over --model x "
                          "--paths into one artifact (--out)")
+    ap.add_argument("--fsdp-ab", action="store_true",
+                    help="prefetch-vs-upfront A/B of the fully-sharded "
+                         "parameter step (hvd.fsdp, docs/fsdp.md) into "
+                         "one artifact (--out)")
     ap.add_argument("--paths", default="plain,zero,int8",
                     help="--schedule-ab optimizer paths: plain, zero, "
                          "int8, bf16, zero-int8")
@@ -676,6 +1045,8 @@ def main(argv=None):
 
     if args.schedule_ab:
         return schedule_ab(args)
+    if args.fsdp_ab:
+        return fsdp_ab(args)
 
     from jax.experimental import topologies
 

@@ -28,6 +28,11 @@ locally before the full pytest tier:
   on/off bitwise parity over plain + ZeRO + int8, and the staged mode
   provably pins backward compute behind the first gradient
   collective);
+* ``fsdp`` — ``scripts/fsdp_check.py --check`` (fully-sharded
+  parameters: prefetch-vs-upfront bitwise parity on plain + int8
+  wires, forward gather + backward reduce-scatter pin structure,
+  measured per-device param bytes ≤ replicated/world + one bucket,
+  and the HOROVOD_FSDP knob inert on non-FSDP lowerings);
 * ``perf`` — ``scripts/perf_baseline.py --check`` (the perf-regression
   gate: structural invariants — fast-path engaged, zero steady
   negotiated bytes, profiler sampled + attributed inside its duty
@@ -190,6 +195,23 @@ def check_overlap():
         ], env=env)
 
 
+def check_fsdp():
+    """The fully-sharded-parameter gate (10th): parity vs the gathered
+    reference, pin structure both directions, memory bound, knob
+    hash."""
+    env = _env()
+    if "xla_force_host_platform_device_count" not in env.get(
+            "XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "fsdp_check.py"),
+        "--check",
+    ], env=env)
+
+
 def check_perf():
     """The perf-regression gate + the merged-trace smoke (one gate:
     both run the unified-observability stack end-to-end)."""
@@ -215,6 +237,7 @@ GATES = [
     ("recovery", check_recovery),
     ("compression", check_compression),
     ("overlap", check_overlap),
+    ("fsdp", check_fsdp),
     ("perf", check_perf),
 ]
 

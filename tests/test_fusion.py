@@ -122,3 +122,43 @@ def test_backward_order_changes_first_bucket():
         tree, threshold_bytes=32, backward_order=True)
     assert float(np.asarray(fwd[0])[0]) == 1.0   # block_0 first
     assert float(np.asarray(bwd[0])[0]) == 3.0   # ln_f first
+
+
+def test_bucket_prefetch_schedule_forward_direction():
+    """bucket_issue_schedule driven in the forward (prefetch)
+    direction (docs/fsdp.md): a bucket is NEEDED at the first forward
+    stage touching any of its leaves — the mirror of the backward's
+    complete-at-last-contribution. The tied-embedding bucket is the
+    canonical asymmetry: it completes LAST on backward (the input
+    lookup's gradient closes at the final segment) but is needed FIRST
+    on forward (the embedding stage reads it at step 0)."""
+    from horovod_tpu.ops.fusion import (bucket_issue_schedule,
+                                        bucket_prefetch_schedule)
+
+    # stages: 0=embed, 1=block, 2=head(tied). leaves: 0=tok_emb (tied,
+    # stages 0 and 2), 1=block w (stage 1), 2=ln_final (stage 2)
+    plans = [[(0, 0, 4, (4,))], [(1, 0, 4, (4,))], [(2, 0, 4, (4,))]]
+    leaf_stages = [[0, 2], [1], [2]]
+
+    # backward: tied bucket 0 completes at the LAST backward step
+    bwd = bucket_issue_schedule(plans, leaf_stages, [2, 1, 0])
+    assert bwd == [[2], [1], [0]]
+
+    # forward: tied bucket 0 is needed at the FIRST stage
+    need = bucket_prefetch_schedule(
+        plans, [min(s) for s in leaf_stages], 3)
+    assert need == [[0], [1], [2]]
+
+
+def test_bucket_prefetch_schedule_multi_leaf_buckets():
+    """A bucket mixing leaves of several stages is needed at the
+    EARLIEST of them (gathering at the latest would starve the earlier
+    stage), and every bucket appears exactly once."""
+    from horovod_tpu.ops.fusion import bucket_prefetch_schedule
+
+    # bucket 0 spans leaves first used at stages 2 and 0 -> needed at 0
+    plans = [[(0, 0, 4, (4,)), (1, 4, 4, (4,))], [(2, 0, 4, (4,))]]
+    need = bucket_prefetch_schedule(plans, [2, 0, 1], 3)
+    assert need == [[0], [1], []]
+    flat = [b for step in need for b in step]
+    assert sorted(flat) == [0, 1]
