@@ -70,6 +70,7 @@ from .ops import (  # noqa: F401
     IndexedSlices,
     Max,
     Min,
+    OnlineTuner,
     Product,
     ReduceOp,
     SPMDStepTuner,
@@ -92,6 +93,7 @@ from .ops import (  # noqa: F401
     grouped_reducescatter_async,
     join,
     masked_allreduce,
+    model_fingerprint,
     poll,
     reducescatter,
     reducescatter_async,
@@ -120,6 +122,7 @@ from .optim import (  # noqa: F401
 # hvd.elastic.* and hvd.start_timeline in the reference. Metrics is the
 # live-telemetry namespace (hvd.metrics.step(), hvd.metrics.scrape()).
 from . import callbacks  # noqa: F401
+from .ops import autotune  # noqa: F401  (hvd.autotune.OnlineTuner)
 from .ops import overlap  # noqa: F401  (hvd.overlap.staged_value_and_grad)
 from .optim import fsdp  # noqa: F401  (hvd.fsdp.shard_params / layout)
 from .utils import faults  # noqa: F401

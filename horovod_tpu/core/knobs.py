@@ -105,12 +105,25 @@ class Knobs:
     timeline_filename: str = ""
     timeline_mark_cycles: bool = False
 
-    # --- autotune (parameter_manager.h:42) ---
+    # --- autotune (parameter_manager.h:42; ops/autotune.py) ---
     autotune: bool = False
     autotune_bayes: bool = False  # GP+EI search (optim/bayesian_optimization.cc)
     autotune_log: str = ""
     autotune_warmup_samples: int = 3
     autotune_steps_per_sample: int = 10
+    # persistent warm-start cache for the closed-loop OnlineTuner
+    # (docs/autotune.md): winners persist per (model fingerprint,
+    # topology) at this path; later runs and serving replicas pin the
+    # cached configuration with zero tuning compiles. "" = no cache.
+    autotune_cache: str = ""
+    # score trials by measured hvd_mfu when the continuous profiler is
+    # live (utils/prof.py set_step_flops); the step-time p50 via
+    # metrics.StepStats is always recorded and is the fallback score
+    autotune_mfu: bool = True
+    # opt IN to the numerics-changing dimensions (wire dtype/block,
+    # eager fast-path warmup K): int8 on the wire is lossy, so the
+    # tuner never sweeps or warm-starts these without explicit consent
+    autotune_wire: bool = False
 
     # --- numerics / wire format ---
     # fp16 ("compression") on the wire: reference torch/compression.py:20.
@@ -317,6 +330,9 @@ class Knobs:
             autotune_steps_per_sample=_env_int(
                 "AUTOTUNE_STEPS_PER_SAMPLE", 10
             ),
+            autotune_cache=_env("AUTOTUNE_CACHE", "") or "",
+            autotune_mfu=_env_bool("AUTOTUNE_MFU", True),
+            autotune_wire=_env_bool("AUTOTUNE_WIRE", False),
             compression_wire_dtype=_env("COMPRESSION_WIRE_DTYPE", "") or "",
             compression=_env("COMPRESSION", "") or "none",
             compression_block=_env_int("COMPRESSION_BLOCK", 256),

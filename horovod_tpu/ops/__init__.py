@@ -29,8 +29,16 @@ from .collectives import (  # noqa: F401
     synchronize,
 )
 from .adasum import adasum_allreduce, hierarchical_adasum  # noqa: F401
-from .autotune import ParameterManager, SPMDStepTuner  # noqa: F401
-from .fusion import flatten_pytree_buckets, fuse_apply  # noqa: F401
+from .autotune import (  # noqa: F401
+    OnlineTuner,
+    ParameterManager,
+    SPMDStepTuner,
+)
+from .fusion import (  # noqa: F401
+    flatten_pytree_buckets,
+    fuse_apply,
+    model_fingerprint,
+)
 from . import overlap  # noqa: F401  (backward-interleaved scheduler)
 # pallas kernel family (TPU-first hot ops; interpret-mode off-TPU)
 from .pallas_attention import (  # noqa: F401

@@ -19,11 +19,31 @@ in the reference.
 
 from __future__ import annotations
 
+import hashlib
 from typing import Callable, List, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def model_fingerprint(tree) -> str:
+    """Stable identity of a model's bucketable structure: sha256 over
+    the pytree treedef plus every leaf's (path, shape, dtype) — exactly
+    the inputs :func:`pytree_bucket_plan` derives a bucket plan from,
+    so two models share a fingerprint iff they produce identical plans
+    at every threshold. Value-free and process-stable: the autotuner's
+    warm-start cache keys on it (ops/autotune.py, docs/autotune.md).
+    Works on concrete arrays and ShapeDtypeStructs alike (serving
+    replicas fingerprint restored params; trainers can fingerprint
+    ``jax.eval_shape`` output before any init)."""
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    h = hashlib.sha256(str(treedef).encode())
+    for path, leaf in paths_leaves:
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(repr((tuple(jnp.shape(leaf)),
+                       str(jnp.result_type(leaf)))).encode())
+    return h.hexdigest()[:16]
 
 
 def _threshold_bytes() -> int:

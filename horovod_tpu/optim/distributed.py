@@ -138,6 +138,32 @@ def _warn_wire_mismatch_once(requested: str, executor: str) -> None:
         "docs/compression.md.", requested, executor, requested)
 
 
+_TUNED_MASK_WARNED = [False]
+
+
+def _warn_tuned_threshold_masked_once(explicit: int) -> None:
+    """An explicit per-optimizer ``fusion_threshold_bytes`` outranks the
+    global knob — which is exactly where the closed-loop autotuner
+    (ops/autotune.py) pins its winners. When tuning (or a warm-start
+    cache) is active, the pinned bucket size would be silently masked
+    by the constructor argument: say so once (docs/autotune.md)."""
+    if _TUNED_MASK_WARNED[0]:
+        return
+    knobs = global_state().knobs
+    if not (knobs.autotune or getattr(knobs, "autotune_cache", "")):
+        return
+    _TUNED_MASK_WARNED[0] = True
+    from ..utils.logging import get_logger
+
+    get_logger().warning(
+        "DistributedOptimizer was built with an explicit "
+        "fusion_threshold_bytes=%d while autotuning is active "
+        "(HOROVOD_AUTOTUNE / HOROVOD_AUTOTUNE_CACHE): the explicit "
+        "value masks the tuner's pinned bucket size for this "
+        "optimizer. Drop the argument to let the tuned knob apply — "
+        "docs/autotune.md.", explicit)
+
+
 _STATELESS_EF_WARNED = [False]
 
 
@@ -489,6 +515,8 @@ def DistributedOptimizer(
 
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    if fusion_threshold_bytes is not None:
+        _warn_tuned_threshold_masked_once(fusion_threshold_bytes)
     if compression is None:
         compression = Compression.from_knobs()
     # error feedback exists to de-bias the quantized SUM; ops the int8

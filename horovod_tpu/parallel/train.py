@@ -130,6 +130,72 @@ def make_lm_train_step(
     return init_fn, step_fn, batch_sharding
 
 
+def tune_lm_train_step(
+    cfg: TransformerConfig,
+    optimizer_factory: Callable[[], Any],
+    mesh: Mesh,
+    rng,
+    sample_tokens,
+    tuner=None,
+    rules: Optional[Sequence] = None,
+    sequence_parallel: Optional[str] = None,
+    donate: bool = True,
+    **tuner_kwargs,
+):
+    """Closed-loop autotune of the causal-LM train step
+    (ops/autotune.OnlineTuner, docs/autotune.md): coordinate-descend the
+    data-plane knobs by rebuilding the REAL step through
+    :func:`make_lm_train_step` per candidate — the factory route is what
+    lets compile-time knobs (overlap schedule, FSDP prefetch depth, wire
+    dtype) actually take effect, since a traced step bakes its
+    collective structure in. Returns ``(init_fn, step_fn,
+    batch_sharding, config)`` where the first three are a fresh
+    :func:`make_lm_train_step` build under the pinned winners and
+    ``config`` is the pinned configuration.
+
+    ``optimizer_factory`` is called once per candidate (and once for the
+    final build): an optimizer's state tree can depend on the knobs
+    being tuned (an error-feedback wire adds residual state), so the
+    optimizer must be REBUILT, not reused, per candidate.
+
+    The model fingerprint for the warm-start cache comes from the
+    shape-inferred parameter pytree, so a run against a cached
+    (model, topology) key pins the stored winners and performs zero
+    tuning compiles."""
+    from ..ops import autotune as autotune_mod
+    from ..ops.fusion import model_fingerprint
+
+    model = Transformer(cfg)
+    abs_params = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0),
+            jnp.ones((1, cfg.max_seq_len), jnp.int32))["params"])
+    fingerprint = model_fingerprint(abs_params)
+    if tuner is None:
+        tuner = autotune_mod.OnlineTuner(**tuner_kwargs)
+
+    def build_step(overrides):
+        # knobs already hold `overrides`; donate=False so the candidate
+        # step can run warmup+measure iterations on the same arrays
+        opt = optimizer_factory()
+        init_fn, step_fn, _ = make_lm_train_step(
+            cfg, opt, mesh, rules=rules,
+            sequence_parallel=sequence_parallel, donate=False)
+        params, opt_state = init_fn(rng, sample_tokens)
+
+        def step(tokens):
+            return step_fn(params, opt_state, tokens)
+
+        return step
+
+    config = tuner.tune(build_step, sample_tokens,
+                        fingerprint=fingerprint)
+    init_fn, step_fn, batch_sharding = make_lm_train_step(
+        cfg, optimizer_factory(), mesh, rules=rules,
+        sequence_parallel=sequence_parallel, donate=donate)
+    return init_fn, step_fn, batch_sharding, config
+
+
 def _count_weighted_stages(model, want, n_world):
     """Stage builder closing over a token batch: each shard's mean loss
     weighted by its share of the global valid-token count, so AVERAGE-

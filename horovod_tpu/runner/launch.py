@@ -79,6 +79,29 @@ def parse_args(argv: Optional[List[str]] = None):
                    help="Bayesian (GP + expected-improvement) autotune "
                         "search instead of coordinate descent")
     p.add_argument("--autotune-log", dest="autotune_log")
+    p.add_argument("--autotune-cache", dest="autotune_cache",
+                   help="persistent warm-start cache for the "
+                        "closed-loop OnlineTuner "
+                        "(HOROVOD_AUTOTUNE_CACHE, docs/autotune.md): "
+                        "winners persist per (model fingerprint, "
+                        "topology); later runs and serving replicas "
+                        "pin the cached configuration with zero "
+                        "tuning compiles")
+    p.add_argument("--autotune-mfu", dest="autotune_mfu",
+                   choices=["0", "1"],
+                   help="score autotune trials by measured hvd_mfu "
+                        "when the continuous profiler is live "
+                        "(HOROVOD_AUTOTUNE_MFU, default 1; the "
+                        "step-time p50 via StepStats is always "
+                        "recorded and is the fallback score)")
+    p.add_argument("--autotune-wire", dest="autotune_wire",
+                   choices=["0", "1"],
+                   help="opt IN to the NUMERICS-CHANGING autotune "
+                        "dimensions — wire dtype/block and eager "
+                        "fast-path warmup K (HOROVOD_AUTOTUNE_WIRE, "
+                        "default 0; int8 on the wire is lossy, so "
+                        "the tuner never sweeps or warm-starts these "
+                        "without explicit consent)")
     p.add_argument("--compression", dest="compression",
                    choices=["none", "fp16", "bf16", "int8", "int8-raw"],
                    help="compressed collective data plane "

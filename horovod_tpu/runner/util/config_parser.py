@@ -20,6 +20,13 @@ ARG_TO_ENV = {
     "autotune": "HOROVOD_AUTOTUNE",
     "autotune_bayes": "HOROVOD_AUTOTUNE_BAYES",
     "autotune_log": "HOROVOD_AUTOTUNE_LOG",
+    # closed-loop OnlineTuner warm start + scoring (docs/autotune.md).
+    # --autotune-mfu / --autotune-wire store literal "0"/"1"
+    # (env_from_args skips boolean False, so a store_false flag could
+    # never reach the env — the --fsdp precedent)
+    "autotune_cache": "HOROVOD_AUTOTUNE_CACHE",
+    "autotune_mfu": "HOROVOD_AUTOTUNE_MFU",
+    "autotune_wire": "HOROVOD_AUTOTUNE_WIRE",
     "compression_wire_dtype": "HOROVOD_COMPRESSION_WIRE_DTYPE",
     "compression": "HOROVOD_COMPRESSION",
     "compression_block": "HOROVOD_COMPRESSION_BLOCK",

@@ -24,7 +24,7 @@ import importlib
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Callable, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -158,6 +158,27 @@ class InferenceEngine:
         else:
             params = jax.device_put(params)
         self._params = params
+        # autotune warm start (ops/autotune.py, docs/autotune.md): a
+        # replica pointed at the training run's HOROVOD_AUTOTUNE_CACHE
+        # pins the model's tuned configuration at init — fingerprint
+        # matched on the restored params, topology-relaxed (an
+        # inference tier rarely shares the training world's shape;
+        # numerics-changing winners transfer only under the
+        # HOROVOD_AUTOTUNE_WIRE opt-in)
+        self.autotune_config: Optional[Dict[str, Any]] = None
+        sk = serving_knobs()
+        if getattr(sk, "autotune_cache", ""):
+            from ..ops import autotune as autotune_mod
+
+            # opt-in resolved from the env-parsed serving knobs: an
+            # uninitialized replica's global Knobs never saw the env,
+            # so reading HOROVOD_AUTOTUNE_WIRE off it would silently
+            # ignore the operator's consent
+            self.autotune_config = autotune_mod.warm_start(
+                params, cache_path=sk.autotune_cache,
+                allow_numerics=bool(getattr(sk, "autotune_wire",
+                                            False)),
+                context="serving")
 
     @classmethod
     def from_checkpoint(

@@ -73,6 +73,11 @@ _dump_count = 0
 _handlers_installed = False
 _prev_excepthook = None
 _prev_sigusr2 = None
+# the autotuner's most recent agreed pin (ops/autotune.py): kept out of
+# the ring so it survives ring wraparound — an autopsy must show the
+# tuned configuration the crashed step was compiled under even when
+# thousands of later events displaced the pin event itself
+_last_autotune: Optional[dict] = None
 
 FLIGHT_SCOPE = "flight"  # rendezvous KV scope dumps land in
 
@@ -134,10 +139,18 @@ def record(kind: str, name: str = "", **detail) -> None:
     Safe from any thread and from signal handlers."""
     if not _enabled:
         return
+    if kind == "autotune" and name in ("pin", "final", "warm_start"):
+        global _last_autotune
+        _last_autotune = {"name": name, **detail}
     _events.append((
         next(_seq), time.monotonic(), time.time(), kind, name,
         detail or None,
     ))
+
+
+def last_autotune() -> Optional[dict]:
+    """The most recent autotune pin recorded (None before any)."""
+    return _last_autotune
 
 
 def snapshot() -> List[tuple]:
@@ -273,6 +286,8 @@ def dump(reason: str = "manual") -> Optional[str]:
             "monotonic": time.monotonic(),
             "events": len(events),
         }
+        if _last_autotune is not None:
+            header["autotune"] = _last_autotune
         header.update(_clock_probe())
         lines = [json.dumps(header)]
         for seq, t_mono, t_wall, kind, name, detail in events:
@@ -573,7 +588,7 @@ def reset() -> None:
     """Test hook: clear events/counters and return to the disabled,
     unconfigured state."""
     global _configured, _dump_count, _rank, _sink, _dir, _seq
-    global _push_policy, _push_outage
+    global _push_policy, _push_outage, _last_autotune
     _push_policy = _push_outage = None
     disable()
     _configured = False
@@ -583,3 +598,4 @@ def reset() -> None:
     _rank = -1
     _sink = None
     _dir = ""
+    _last_autotune = None

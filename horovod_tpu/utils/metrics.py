@@ -84,6 +84,10 @@ def _escape_label(v: str) -> str:
 
 
 def _fmt(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v in (float("inf"), float("-inf")):
+        return "+Inf" if v > 0 else "-Inf"
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return repr(float(v))
@@ -643,6 +647,20 @@ class StepStats:
 
     # -- step boundary ------------------------------------------------------
 
+    def emit_event(self, kind: str, payload: dict) -> None:
+        """Write one out-of-band event line to the JSONL: decision-trail
+        records (the autotuner's trial/pin/reject blocks) that must not
+        wait for a training-step boundary to flush. Event lines carry
+        ``{"event": kind, kind: payload}`` instead of the step fields;
+        scripts/metrics_summary.py separates them from step records."""
+        with self._lock:
+            if self._log_fh is None:
+                return
+            rec = {"event": kind, "time_unix": time.time(),
+                   kind: dict(payload)}
+            self._log_fh.write(json.dumps(rec) + "\n")
+            self._log_fh.flush()
+
     def open_log(self, path: str) -> None:
         with self._lock:
             if self._log_fh is not None:
@@ -998,6 +1016,83 @@ def record_step_attribution(attribution: dict) -> None:
         "hvd_overlap_window_frac)",
     ).set(-1.0 if overlap is None else float(overlap))
     step_stats.set_attribution(attribution)
+
+
+def record_autotune_trial(dimension: str, step_s: Optional[float],
+                          mfu: Optional[float] = None,
+                          error: Optional[str] = None,
+                          overrides: Optional[dict] = None) -> None:
+    """One autotuner candidate measured (or failed) by the closed-loop
+    tuner (ops/autotune.py): counts into
+    ``hvd_autotune_trials_total{dimension}`` (errors additionally into
+    ``hvd_autotune_trial_errors_total``) and lands as an ``autotune``
+    event line in the StepStats JSONL — the decision trail
+    scripts/metrics_summary.py renders as the sweep table."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_autotune_trials_total",
+        "Autotune candidates measured, by sweep dimension",
+        ("dimension",),
+    ).labels(dimension).inc()
+    if error is not None:
+        registry.counter(
+            "hvd_autotune_trial_errors_total",
+            "Autotune candidates that failed to compile/run, by "
+            "dimension", ("dimension",),
+        ).labels(dimension).inc()
+    payload = {"kind": "trial", "dimension": dimension}
+    if overrides:
+        payload["overrides"] = {k: v for k, v in overrides.items()}
+    if step_s is not None:
+        payload["step_s"] = float(step_s)
+    if mfu is not None:
+        payload["mfu"] = float(mfu)
+    if error is not None:
+        payload["error"] = error
+    step_stats.emit_event("autotune", payload)
+
+
+def record_autotune_pin(dimension: str, config: dict,
+                        step_s: Optional[float],
+                        accepted: bool = True,
+                        source: str = "sweep") -> None:
+    """One per-dimension agreement outcome (pin when the dimension
+    improved on the incumbent, reject when it kept it) or a
+    warm-start/final pin: ``hvd_autotune_best_step_s`` tracks the
+    agreed best step time and ``hvd_autotune_dimension{dimension=<knob>}``
+    carries every pinned knob's numeric value (strings enumerate per
+    ops/autotune._ENUM_VALUES). ``step_s`` None = no candidate of the
+    dimension measured successfully (all failed): the gauge keeps its
+    last value and the JSONL event carries null — a bare ``Infinity``
+    token would make the line unparseable to RFC-8259 readers."""
+    if not _enabled:
+        return
+    from ..ops.autotune import _numeric
+
+    if step_s is not None and step_s == step_s and step_s not in (
+            float("inf"), float("-inf")):
+        registry.gauge(
+            "hvd_autotune_best_step_s",
+            "Agreed best measured step seconds of the autotune sweep "
+            "(the warm-start entry's recorded time on cache pins)",
+        ).set(float(step_s))
+    else:
+        step_s = None
+    gauge = registry.gauge(
+        "hvd_autotune_dimension",
+        "Pinned autotune knob values, by knob (strings enumerate: "
+        "overlap off/stage/double=0/1/2, compression "
+        "none/fp16/bf16/int8/int8-raw=0..4)", ("dimension",))
+    for k, v in config.items():
+        gauge.labels(k).set(_numeric(k, v))
+    step_stats.emit_event("autotune", {
+        "kind": "pin" if accepted else "reject",
+        "dimension": dimension,
+        "config": {k: v for k, v in config.items()},
+        "step_s": step_s,
+        "source": source,
+    })
 
 
 def record_timeline_activity(activity: str, seconds: float) -> None:

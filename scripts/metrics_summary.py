@@ -47,6 +47,12 @@ def load_records(path):
             if not isinstance(rec, dict):
                 errors.append(f"line {lineno}: not an object")
                 continue
+            if "event" in rec:
+                # out-of-band event record (StepStats.emit_event):
+                # e.g. the autotuner's decision trail — carries
+                # {"event": kind, kind: payload} instead of step fields
+                records.append(rec)
+                continue
             missing = [f for f in REQUIRED_FIELDS if f not in rec]
             if missing:
                 errors.append(
@@ -70,7 +76,56 @@ def _human_bytes(n):
         n /= 1024
 
 
+def _fmt_overrides(d):
+    return " ".join(f"{k}={v}" for k, v in sorted(d.items()))
+
+
+def summarize_autotune(events):
+    """Render the autotuner's decision trail (ops/autotune.py event
+    records) as a sweep table: every measured candidate with its
+    step-time/MFU score, failures, and the per-dimension pin/reject
+    outcomes ending in the pinned configuration."""
+    if not events:
+        return
+    print("\nautotune sweep (decision trail):")
+    width = max((len(e.get("dimension", "")) for e in events), default=9)
+    width = max(width, len("dimension"))
+    print(f"  {'dimension':<{width}}  {'outcome':<8}  detail")
+    final = None
+    for e in events:
+        dim = e.get("dimension", "?")
+        kind = e.get("kind", "?")
+        if kind == "trial":
+            if "error" in e:
+                detail = (f"FAILED {_fmt_overrides(e.get('overrides', {}))}"
+                          f" ({e['error']})")
+            else:
+                detail = f"{e.get('step_s', 0) * 1e3:.2f} ms"
+                if "mfu" in e:
+                    detail += f"  mfu {e['mfu']:.4f}"
+                detail += f"  {_fmt_overrides(e.get('overrides', {}))}"
+            print(f"  {dim:<{width}}  {'trial':<8}  {detail}")
+        elif kind in ("pin", "reject"):
+            detail = f"best {e.get('step_s', 0) * 1e3:.2f} ms"
+            src = e.get("source", "sweep")
+            if src != "sweep":
+                detail += f"  [{src}]"
+            print(f"  {dim:<{width}}  {kind:<8}  {detail}")
+            if dim in ("final", "warm_start"):
+                final = e
+    if final is not None:
+        src = final.get("source", "sweep")
+        print(f"  pinned configuration ({src}): "
+              f"{_fmt_overrides(final.get('config', {}))}")
+
+
 def summarize(records):
+    autotune_events = [r["autotune"] for r in records
+                       if r.get("event") == "autotune" and "autotune" in r]
+    records = [r for r in records if "event" not in r]
+    if not records:
+        summarize_autotune(autotune_events)
+        return
     times = sorted(r["step_time_s"] for r in records)
     print(f"steps: {len(records)}  "
           f"(#{records[0]['step']} .. #{records[-1]['step']})")
@@ -193,6 +248,8 @@ def summarize(records):
             print("retry GIVE-UPS: " + ", ".join(
                 f"{p}={int(n)}" for p, n in sorted(giveups.items())))
 
+    summarize_autotune(autotune_events)
+
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
@@ -208,16 +265,19 @@ def main(argv=None):
 
     records, errors = load_records(args.jsonl)
 
+    steps = [r for r in records if "event" not in r]
     if args.check:
         if errors:
             print(f"metrics check FAILED: {errors[0]}"
                   + (f" (+{len(errors) - 1} more)" if len(errors) > 1
                      else ""))
             return 1
-        if not records:
+        if not steps:
             print(f"metrics check FAILED: no step records in {args.jsonl}")
             return 1
-        print(f"metrics check OK: {len(records)} step records")
+        print(f"metrics check OK: {len(steps)} step records"
+              + (f" (+{len(records) - len(steps)} event records)"
+                 if len(records) > len(steps) else ""))
         return 0
 
     for e in errors:
@@ -226,7 +286,8 @@ def main(argv=None):
         print(f"no step records in {args.jsonl}", file=sys.stderr)
         return 1
     if args.last:
-        records = records[-args.last:]
+        steps = steps[-args.last:]
+        records = [r for r in records if "event" in r] + steps
     summarize(records)
     return 0
 

@@ -607,11 +607,17 @@ def _fsdp_compile_and_analyze(model, mesh, nchips, fusion_mb,
 
 
 def trees_bitwise_equal(a, b):
-    """Leaf-wise np.array_equal over two pytrees — the shared parity
-    predicate of the fsdp/overlap gates (scripts/fsdp_check.py imports
-    it so the two gates can never drift in strictness)."""
+    """Structure + leaf-wise np.array_equal over two pytrees — the
+    shared parity predicate of the fsdp/overlap/autotune gates
+    (scripts/fsdp_check.py and scripts/autotune_check.py import it so
+    the gates can never drift in strictness). Structures are compared
+    first: a bare leaf-zip would truncate at the shorter list and call
+    structurally different outputs "bitwise"."""
     import numpy as np
 
+    if (jax.tree_util.tree_structure(a)
+            != jax.tree_util.tree_structure(b)):
+        return False
     return all(
         np.array_equal(np.asarray(x), np.asarray(y))
         for x, y in zip(jax.tree_util.tree_leaves(a),

@@ -33,6 +33,12 @@ locally before the full pytest tier:
   wires, forward gather + backward reduce-scatter pin structure,
   measured per-device param bytes ≤ replicated/world + one bucket,
   and the HOROVOD_FSDP knob inert on non-FSDP lowerings);
+* ``autotune`` — ``scripts/autotune_check.py --check`` (closed-loop
+  autotuner: world-2 loopback sweep with skewed per-rank timings pins
+  identical winners on both ranks, the pinned config is never worse
+  than the incumbent default, a cache-hit rerun performs 0 tuning
+  compiles, pin-then-rebuild is bitwise, and the decision trail is
+  visible in /metrics + the StepStats JSONL + metrics_summary);
 * ``perf`` — ``scripts/perf_baseline.py --check`` (the perf-regression
   gate: structural invariants — fast-path engaged, zero steady
   negotiated bytes, profiler sampled + attributed inside its duty
@@ -212,6 +218,22 @@ def check_fsdp():
     ], env=env)
 
 
+def check_autotune():
+    """The closed-loop autotuner gate (11th): agreement, never-worse,
+    warm start, pin-then-rebuild determinism, decision trail."""
+    env = _env()
+    if "xla_force_host_platform_device_count" not in env.get(
+            "XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=2"
+                            ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "autotune_check.py"),
+        "--check",
+    ], env=env)
+
+
 def check_perf():
     """The perf-regression gate + the merged-trace smoke (one gate:
     both run the unified-observability stack end-to-end)."""
@@ -238,6 +260,7 @@ GATES = [
     ("compression", check_compression),
     ("overlap", check_overlap),
     ("fsdp", check_fsdp),
+    ("autotune", check_autotune),
     ("perf", check_perf),
 ]
 

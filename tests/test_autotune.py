@@ -249,3 +249,438 @@ def test_parameter_manager_drops_first_post_switch_window(tmp_path):
     assert len(pm._log_rows) == 2
     assert pm._log_rows[1][0] == switched
     assert pm._best[1] in (first, switched)
+
+
+# ---------------------------------------------------------------------------
+# Closed-loop OnlineTuner (ops/autotune.py, docs/autotune.md)
+# ---------------------------------------------------------------------------
+
+import json
+import queue
+import threading
+import time
+
+from horovod_tpu.ops.autotune import (KNOB_SCHEMA_VERSION, OnlineTuner,
+                                      TuneCache, cache_key, warm_start)
+from horovod_tpu.ops.fusion import model_fingerprint
+from horovod_tpu.utils import metrics as metrics_mod
+
+
+def test_spmd_tuner_survives_failing_candidate():
+    """A candidate that fails to compile (OOM / compile error on an
+    aggressive threshold) must be recorded as an error trial, restore
+    the saved knobs, and let the dimension continue — not abort the
+    sweep mid-dimension (which would desync the agreement protocol:
+    other ranks keep walking toward the broadcast)."""
+    knobs = Knobs()
+    agreements = []
+
+    def agree(best, best_t):
+        agreements.append(dict(best))
+        return best, best_t
+
+    def factory(overrides):
+        if overrides["fusion_threshold_bytes"] == 2 << 20:
+            raise MemoryError("candidate OOM")
+        return lambda: jnp.zeros(())
+
+    tuner = SPMDStepTuner(
+        knobs=knobs,
+        thresholds=[knobs.fusion_threshold_bytes, 2 << 20, 1 << 20],
+        warmup=0, measure=1, tune_ordered=True, agree_fn=agree)
+    best = tuner.tune(factory)
+
+    # the failing candidate was logged, not raised
+    errs = [r for r in tuner.trials if "error" in r]
+    assert len(errs) == 1
+    assert errs[0]["fusion_threshold_bytes"] == 2 << 20
+    assert "MemoryError" in errs[0]["error"]
+    # the sweep continued: the candidate after the failure was timed
+    assert any(r.get("fusion_threshold_bytes") == 1 << 20
+               and "step_s" in r for r in tuner.trials)
+    # the failed candidate can never win, and knobs hold the winner
+    assert best["fusion_threshold_bytes"] != 2 << 20
+    assert knobs.fusion_threshold_bytes == best["fusion_threshold_bytes"]
+    # every dimension still reached its agreement point
+    assert len(agreements) == 2  # thresholds + ordered flip
+
+
+def test_spmd_tuner_all_failing_dimension_pins_incumbent():
+    knobs = Knobs()
+    incumbent = knobs.fusion_threshold_bytes
+
+    def factory(overrides):
+        raise RuntimeError("nothing compiles today")
+
+    tuner = SPMDStepTuner(knobs=knobs,
+                          thresholds=[incumbent, 1 << 20],
+                          warmup=0, measure=1, tune_ordered=False)
+    best = tuner.tune(factory)
+    assert best["fusion_threshold_bytes"] == incumbent
+    assert knobs.fusion_threshold_bytes == incumbent
+
+
+# per-candidate sleeps, INVERTED between ranks: local argmins disagree,
+# so only the rank-0-wins agreement can make the pins identical
+_SKEW = {
+    0: {128 << 20: 0.004, 1 << 20: 0.0005},
+    1: {128 << 20: 0.0005, 1 << 20: 0.004},
+}
+
+
+def _skewed_rank(rank, q01, results, cache_path):
+    knobs = Knobs()
+    compile_log = []
+
+    def agree(best, best_t):
+        if rank == 0:
+            q01.put((best, best_t))
+            return best, best_t
+        return q01.get(timeout=30)
+
+    def factory(overrides):
+        compile_log.append(dict(overrides))
+        delay = _SKEW[rank][knobs.fusion_threshold_bytes]
+
+        def step():
+            time.sleep(delay)
+            return jnp.zeros(())
+
+        return step
+
+    tuner = OnlineTuner(
+        knobs, thresholds=[knobs.fusion_threshold_bytes, 1 << 20],
+        warmup=0, measure=2, tune_overlap=False,
+        cache_path=cache_path, fingerprint="w2test", agree_fn=agree)
+    config = tuner.tune(factory)
+    local = {r["fusion_threshold_bytes"]: r["step_s"]
+             for r in tuner.trials
+             if r.get("dimension") == "fusion_threshold_bytes"}
+    results[rank] = {
+        "config": config,
+        "compiles": compile_log,
+        "local_argmin": min(local, key=local.get),
+        "knob": knobs.fusion_threshold_bytes,
+    }
+
+
+def test_world2_agreement_pins_identical_winners(tmp_path):
+    """World-2 loopback with deliberately skewed per-rank candidate
+    timings: both ranks must pin IDENTICAL winners (rank 0's), and the
+    compile-override sequences must match exactly after every
+    agreement point — the invariant that no rank ever compiles a
+    rank-mismatched collective structure."""
+    q01, results = queue.Queue(), {}
+    threads = [
+        threading.Thread(target=_skewed_rank,
+                         args=(r, q01, results,
+                               str(tmp_path / f"cache{r}.json")))
+        for r in (0, 1)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert set(results) == {0, 1}
+    r0, r1 = results[0], results[1]
+    # the skew bit: each rank's own clock preferred a different winner
+    assert r0["local_argmin"] == 1 << 20
+    assert r1["local_argmin"] == 128 << 20
+    # ... yet both pinned rank 0's (the coordinator's) pick
+    assert r0["config"] == r1["config"]
+    assert r0["config"]["fusion_threshold_bytes"] == 1 << 20
+    assert r0["knob"] == r1["knob"] == 1 << 20
+    # identical candidate sequences => identical compiled structures
+    assert r0["compiles"] == r1["compiles"]
+
+
+def test_online_tuner_cache_warm_start_zero_compiles(tmp_path):
+    cache = str(tmp_path / "cache.json")
+    knobs = Knobs()
+
+    def factory(overrides):
+        return lambda: jnp.zeros(())
+
+    t1 = OnlineTuner(knobs, thresholds=[knobs.fusion_threshold_bytes,
+                                        1 << 20],
+                     warmup=0, measure=1, cache_path=cache,
+                     fingerprint="fp-a")
+    cfg = t1.tune(factory)
+    assert t1.pin_source == "sweep" and t1.compiles > 0
+
+    knobs2 = Knobs()
+
+    def must_not_build(overrides):
+        raise AssertionError("warm start must not compile")
+
+    t2 = OnlineTuner(knobs2, thresholds=[knobs2.fusion_threshold_bytes,
+                                         1 << 20],
+                     warmup=0, measure=1, cache_path=cache,
+                     fingerprint="fp-a")
+    cfg2 = t2.tune(must_not_build)
+    assert t2.compiles == 0 and t2.pin_source == "cache"
+    assert cfg2 == cfg
+    assert knobs2.fusion_threshold_bytes == cfg["fusion_threshold_bytes"]
+
+    # fingerprint mismatch = different model => full re-tune
+    knobs3 = Knobs()
+    calls = []
+
+    def factory3(overrides):
+        calls.append(dict(overrides))
+        return lambda: jnp.zeros(())
+
+    t3 = OnlineTuner(knobs3, thresholds=[knobs3.fusion_threshold_bytes,
+                                         1 << 20],
+                     warmup=0, measure=1, cache_path=cache,
+                     fingerprint="fp-OTHER")
+    t3.tune(factory3)
+    assert t3.pin_source == "sweep" and calls
+
+
+def test_online_tuner_stale_schema_retunes_loudly(tmp_path):
+    """A cache entry from another knob-schema generation must re-tune
+    (never silently reuse) and say so."""
+    cache = str(tmp_path / "cache.json")
+    knobs = Knobs()
+    key = cache_key("fp-a")
+    TuneCache(cache).store(key, {
+        "config": {"fusion_threshold_bytes": 1 << 20},
+        "schema": KNOB_SCHEMA_VERSION + 1, "time_unix": 1.0})
+    calls = []
+
+    def factory(overrides):
+        calls.append(dict(overrides))
+        return lambda: jnp.zeros(())
+
+    t = OnlineTuner(knobs, thresholds=[knobs.fusion_threshold_bytes],
+                    warmup=0, measure=1, tune_ordered=False,
+                    tune_overlap=False, cache_path=cache,
+                    fingerprint="fp-a")
+    t.tune(factory)
+    assert t.pin_source == "sweep" and calls  # re-tuned
+    # ... and the rewritten entry is consumable again
+    entry = TuneCache(cache).lookup(key)
+    assert entry is not None
+    assert entry["schema"] == KNOB_SCHEMA_VERSION
+
+
+def test_online_tuner_optin_dimensions_walk():
+    """fsdp prefetch / wire dtype / block / fast-path warmup candidates
+    only enter the sweep when their dimension is enabled — and the
+    quantization-block dimension only when the wire pinned a
+    block-quantized compressor (a dead knob must not burn compiles or
+    let noise pin an arbitrary block)."""
+    knobs = Knobs()
+    calls = []
+
+    def int8_wins(overrides):
+        calls.append(dict(overrides))
+        slow = 0.002 if knobs.compression != "int8" else 0.0
+
+        def step():
+            time.sleep(slow)
+            return jnp.zeros(())
+
+        return step
+
+    t = OnlineTuner(
+        knobs, thresholds=[knobs.fusion_threshold_bytes],
+        warmup=0, measure=1, tune_ordered=False, tune_overlap=False,
+        tune_fsdp_prefetch=True, prefetch_depths=[0, 1, 2],
+        tune_wire=True, wire_candidates=["none", "int8"],
+        block_candidates=[128, 256], warmup_k_candidates=[3, 8])
+    cfg = t.tune(int8_wins)
+    dims = {r.get("dimension") for r in t.trials}
+    assert "fsdp_prefetch" in dims
+    assert "compression" in dims
+    assert cfg["compression"] == "int8"
+    assert "compression_block" in dims  # live knob under int8
+    assert "eager_fast_path_warmup" in dims
+    # incumbents excluded from their own dimension's candidate list
+    assert sum(1 for r in t.trials
+               if r.get("dimension") == "fsdp_prefetch") == 2
+    for k in ("fsdp_prefetch", "compression", "compression_block",
+              "eager_fast_path_warmup"):
+        assert k in cfg
+        assert getattr(knobs, k) == cfg[k]
+
+    # wire pinned "none" => the block dimension is skipped entirely
+    knobs2 = Knobs()
+
+    def none_wins(overrides):
+        slow = 0.002 if knobs2.compression == "int8" else 0.0
+
+        def step():
+            time.sleep(slow)
+            return jnp.zeros(())
+
+        return step
+
+    t2 = OnlineTuner(
+        knobs2, thresholds=[knobs2.fusion_threshold_bytes],
+        warmup=0, measure=1, tune_ordered=False, tune_overlap=False,
+        tune_wire=True, wire_candidates=["none", "int8"],
+        block_candidates=[128, 256], warmup_k_candidates=[3, 8])
+    cfg2 = t2.tune(none_wins)
+    assert cfg2["compression"] == "none"
+    dims2 = {r.get("dimension") for r in t2.trials}
+    assert "compression_block" not in dims2
+    assert knobs2.compression_block == Knobs().compression_block
+
+
+def test_online_tuner_decision_trail(tmp_path):
+    """Every trial and pin lands in the registry and as autotune event
+    lines in the StepStats JSONL."""
+    jsonl = tmp_path / "steps.jsonl"
+    metrics_mod.reset()
+    metrics_mod.enable()
+    metrics_mod.step_stats.open_log(str(jsonl))
+    try:
+        knobs = Knobs()
+
+        def factory(overrides):
+            return lambda: jnp.zeros(())
+
+        t = OnlineTuner(knobs,
+                        thresholds=[knobs.fusion_threshold_bytes,
+                                    1 << 20],
+                        warmup=0, measure=2)
+        t.tune(factory)
+        snap = metrics_mod.registry.snapshot()
+        trials = snap.get("hvd_autotune_trials_total", {})
+        assert sum(trials.values()) == len(t.trials)
+        assert "hvd_autotune_best_step_s" in snap
+        dim = snap.get("hvd_autotune_dimension", {})
+        assert dim.get("fusion_threshold_bytes") == float(
+            knobs.fusion_threshold_bytes)
+        scrape = metrics_mod.scrape()
+        assert not metrics_mod.lint_exposition(scrape)
+        metrics_mod.step_stats.close_log()
+        events = [json.loads(line)["autotune"]
+                  for line in jsonl.read_text().splitlines()
+                  if json.loads(line).get("event") == "autotune"]
+        kinds = {e["kind"] for e in events}
+        assert "trial" in kinds and "pin" in kinds
+        finals = [e for e in events if e.get("dimension") == "final"]
+        assert finals and finals[-1]["config"] == t.pinned
+    finally:
+        metrics_mod.reset()
+
+
+def test_model_fingerprint_identity():
+    a = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((7,), jnp.int32)}
+    b = {"w": jnp.ones((4, 4)), "b": jnp.ones((7,), jnp.int32)}
+    assert model_fingerprint(a) == model_fingerprint(b)  # value-free
+    # shape-inferred trees fingerprint identically to concrete ones
+    abstract = jax.eval_shape(lambda: a)
+    assert model_fingerprint(abstract) == model_fingerprint(a)
+    c = {"w": jnp.zeros((4, 5)), "b": jnp.zeros((7,), jnp.int32)}
+    d = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((7,), jnp.float32)}
+    e = {"w2": jnp.zeros((4, 4)), "b": jnp.zeros((7,), jnp.int32)}
+    fps = {model_fingerprint(t) for t in (a, c, d, e)}
+    assert len(fps) == 4  # shape, dtype and path all distinguish
+
+
+def test_warm_start_numerics_opt_in(tmp_path):
+    """Cached numerics-changing winners (wire dtype/block, fast-path
+    warmup) transfer only under the explicit opt-in."""
+    cache = str(tmp_path / "cache.json")
+    tree = {"w": jnp.zeros((8, 8))}
+    fp = model_fingerprint(tree)
+    TuneCache(cache).store(cache_key(fp), {
+        "config": {"fusion_threshold_bytes": 1 << 20,
+                   "compression": "int8", "compression_block": 128,
+                   "eager_fast_path_warmup": 8},
+        "schema": KNOB_SCHEMA_VERSION, "step_s": 0.001,
+        "time_unix": 1.0})
+
+    knobs = Knobs()
+    cfg = warm_start(tree, knobs, cache_path=cache)
+    assert cfg == {"fusion_threshold_bytes": 1 << 20}
+    assert knobs.compression == "none"  # untouched
+
+    knobs2 = Knobs()
+    cfg2 = warm_start(tree, knobs2, cache_path=cache,
+                      allow_numerics=True)
+    assert cfg2["compression"] == "int8"
+    assert knobs2.compression == "int8"
+    assert knobs2.compression_block == 128
+    assert knobs2.eager_fast_path_warmup == 8
+
+
+def test_tune_lm_train_step_pins_and_warm_starts(hvd8, tmp_path):
+    """parallel/train.tune_lm_train_step rebuilds the REAL train step
+    per candidate (the overlap-schedule dimension recompiles through
+    make_lm_train_step) and a second run warm-starts from the cache
+    with zero tuning compiles."""
+    import optax
+
+    from horovod_tpu.models.transformer import TransformerConfig
+    from horovod_tpu.parallel.train import tune_lm_train_step
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=2, num_heads=2,
+                            hidden_size=32, max_seq_len=16,
+                            dtype=jnp.float32)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (16, 16)), jnp.int32)
+    cache = str(tmp_path / "cache.json")
+    mesh = hvd8.mesh()
+
+    t1 = OnlineTuner(thresholds=[8 << 10], warmup=0, measure=2,
+                     tune_ordered=False, tune_overlap=True,
+                     overlap_modes=["off", "stage"], cache_path=cache)
+    init_fn, step_fn, _, pinned = tune_lm_train_step(
+        cfg, lambda: hvd8.DistributedOptimizer(optax.sgd(0.1)), mesh,
+        jax.random.PRNGKey(0), toks, tuner=t1)
+    assert t1.pin_source == "sweep"
+    assert not [r for r in t1.trials if "error" in r]
+    assert pinned["overlap_schedule"] in ("off", "stage")
+    params, state = init_fn(jax.random.PRNGKey(0), toks)
+    _, _, loss = step_fn(params, state, toks)
+    assert np.isfinite(float(loss))
+
+    t2 = OnlineTuner(thresholds=[8 << 10], warmup=0, measure=2,
+                     tune_ordered=False, tune_overlap=True,
+                     overlap_modes=["off", "stage"], cache_path=cache)
+    _, _, _, pinned2 = tune_lm_train_step(
+        cfg, lambda: hvd8.DistributedOptimizer(optax.sgd(0.1)), mesh,
+        jax.random.PRNGKey(0), toks, tuner=t2)
+    assert t2.compiles == 0 and t2.pin_source == "cache"
+    assert pinned2 == {k: pinned[k] for k in pinned2}
+
+
+def test_all_failing_sweep_emits_parseable_jsonl(tmp_path):
+    """An all-candidates-failed sweep must not leak Infinity into the
+    JSONL event lines (json.dumps would emit a bare non-RFC token)."""
+    jsonl = tmp_path / "steps.jsonl"
+    metrics_mod.reset()
+    metrics_mod.enable()
+    metrics_mod.step_stats.open_log(str(jsonl))
+    try:
+        knobs = Knobs()
+
+        def factory(overrides):
+            raise RuntimeError("nothing compiles")
+
+        t = OnlineTuner(knobs,
+                        thresholds=[knobs.fusion_threshold_bytes,
+                                    1 << 20],
+                        warmup=0, measure=1)
+        cfg = t.tune(factory)
+        assert cfg["fusion_threshold_bytes"] == \
+            Knobs().fusion_threshold_bytes  # incumbent kept
+        metrics_mod.step_stats.close_log()
+
+        def no_constants(name):
+            raise AssertionError(f"non-RFC JSON token {name} in JSONL")
+
+        pins = []
+        for line in jsonl.read_text().splitlines():
+            rec = json.loads(line, parse_constant=no_constants)
+            if rec.get("event") == "autotune" and \
+                    rec["autotune"]["kind"] in ("pin", "reject"):
+                pins.append(rec["autotune"])
+        assert pins and all(p["step_s"] is None for p in pins)
+    finally:
+        metrics_mod.reset()
