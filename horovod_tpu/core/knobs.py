@@ -301,6 +301,35 @@ class Knobs:
     # default per-request deadline (queue wait + execution)
     serving_request_timeout_seconds: float = 30.0
 
+    # --- autoregressive generation (serving/decode.py, scheduler.py,
+    # docs/generation.md) ---
+    # KV cache storage: fp32 | bf16 | int8 (int8 = block-quantized
+    # with optim/compression.py's primitives, quantize-once-on-write)
+    serving_kv_dtype: str = "fp32"
+    # int8 scale granularity along head_dim; 0 = one scale per row
+    serving_kv_block: int = 0
+    # (slots x max_len) decode bucket ladder; the engine runs the
+    # largest bucket and AOT-compiles one decode program per pair
+    serving_decode_buckets: str = "4x128"
+    # prompt-length prefill ladder; "" = powers of two up to max_len
+    serving_prefill_buckets: str = ""
+    # default generation cap when a request names no max_new_tokens
+    serving_decode_max_new: int = 64
+    # scheduler stats cadence: one "decode" StepStats JSONL event per
+    # this many iterations (0 = no event lines)
+    serving_decode_stats_every: int = 50
+    # --- replica autoscaler (serving/replica_set.py ReplicaAutoscaler) ---
+    serving_autoscale_interval_s: float = 2.0
+    serving_autoscale_hi_occupancy: float = 0.85
+    serving_autoscale_lo_occupancy: float = 0.25
+    serving_autoscale_queue_wait_s: float = 0.5
+    serving_autoscale_min_replicas: int = 1
+    serving_autoscale_max_replicas: int = 4
+    # consecutive over/under-threshold polls before acting
+    serving_autoscale_sustain: int = 2
+    # seconds after an action before the next is considered
+    serving_autoscale_cooldown_s: float = 10.0
+
     @staticmethod
     def from_env() -> "Knobs":
         return Knobs(
@@ -395,5 +424,39 @@ class Knobs:
             serving_queue_limit=_env_int("SERVING_QUEUE_LIMIT", 256),
             serving_request_timeout_seconds=_env_float(
                 "SERVING_REQUEST_TIMEOUT", 30.0
+            ),
+            serving_kv_dtype=_env("SERVING_KV_DTYPE", "fp32") or "fp32",
+            serving_kv_block=_env_int("SERVING_KV_BLOCK", 0),
+            serving_decode_buckets=_env(
+                "SERVING_DECODE_BUCKETS", "4x128") or "4x128",
+            serving_prefill_buckets=_env(
+                "SERVING_PREFILL_BUCKETS", "") or "",
+            serving_decode_max_new=_env_int("SERVING_DECODE_MAX_NEW", 64),
+            serving_decode_stats_every=_env_int(
+                "SERVING_DECODE_STATS_EVERY", 50
+            ),
+            serving_autoscale_interval_s=_env_float(
+                "SERVING_AUTOSCALE_INTERVAL_S", 2.0
+            ),
+            serving_autoscale_hi_occupancy=_env_float(
+                "SERVING_AUTOSCALE_HI_OCCUPANCY", 0.85
+            ),
+            serving_autoscale_lo_occupancy=_env_float(
+                "SERVING_AUTOSCALE_LO_OCCUPANCY", 0.25
+            ),
+            serving_autoscale_queue_wait_s=_env_float(
+                "SERVING_AUTOSCALE_QUEUE_WAIT_S", 0.5
+            ),
+            serving_autoscale_min_replicas=_env_int(
+                "SERVING_AUTOSCALE_MIN_REPLICAS", 1
+            ),
+            serving_autoscale_max_replicas=_env_int(
+                "SERVING_AUTOSCALE_MAX_REPLICAS", 4
+            ),
+            serving_autoscale_sustain=_env_int(
+                "SERVING_AUTOSCALE_SUSTAIN", 2
+            ),
+            serving_autoscale_cooldown_s=_env_float(
+                "SERVING_AUTOSCALE_COOLDOWN_S", 10.0
             ),
         )

@@ -148,6 +148,34 @@ def apply_rope(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
+def cached_attention(q, k, v, valid):
+    """Attention of new-token queries over a KV cache slice.
+
+    q ``[B, T, H, D]`` (the T tokens being appended this call — the
+    whole prompt at prefill, one token at decode); k/v ``[B, KH, M, D]``
+    (the cache layout's per-layer slice, already containing the new
+    rows); ``valid`` ``[B, T, M]`` bool — cache position j is
+    attendable by query t iff ``j <= position(t)``, which is both the
+    causal mask and the "written yet" mask (rows above a slot's length
+    hold stale bytes from the slot's previous occupant).
+
+    float32 softmax accumulation like :func:`dot_product_attention`;
+    masked positions get -1e30 so stale-but-finite cache rows
+    contribute exactly zero probability.
+    """
+    B, T, H, D = q.shape
+    KH = k.shape[1]
+    if KH != H:  # GQA: repeat kv heads
+        rep = H // KH
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scale = 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bthd,bhmd->bhtm", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(valid[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhtm,bhmd->bthd", probs, v)
+
+
 def dot_product_attention(q, k, v, *, causal: bool, mask=None):
     """Default attention: q,k,v [B, T, H, D] -> [B, T, H, D].
 
@@ -177,7 +205,7 @@ class Attention(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, positions, mask=None):
+    def __call__(self, x, positions, mask=None, kv_cache=None, layer=0):
         cfg = self.cfg
         B, T, _ = x.shape
         H, KH, D = cfg.num_heads, cfg.kv_heads, cfg.head_dim
@@ -195,12 +223,25 @@ class Attention(nn.Module):
             cos, sin = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-        attn = self.attention_fn or functools.partial(
-            dot_product_attention, causal=cfg.causal
-        )
-        if self.attention_fn is None:
+        if kv_cache is not None:
+            # autoregressive serving path (serving/decode.py): the
+            # new tokens' K/V append into the slotted cache (quantized
+            # there when the cache is int8 — rows are quantized once,
+            # on write, never re-quantized) and attention runs over
+            # the full cache slice under the position-validity mask
+            if mask is not None:
+                raise ValueError(
+                    "kv_cache decoding derives its own validity mask "
+                    "from positions; an explicit padding mask is not "
+                    "composable with it")
+            k_full, v_full, valid = kv_cache.update(layer, k, v, positions)
+            out = cached_attention(q, k_full, v_full, valid)
+        elif self.attention_fn is None:
+            attn = functools.partial(
+                dot_product_attention, causal=cfg.causal)
             out = attn(q, k, v, mask=mask)
         else:
+            attn = self.attention_fn
             if mask is not None:
                 raise ValueError(
                     "a custom attention_fn (flash/ring/Ulysses) takes only "
@@ -246,11 +287,12 @@ class Block(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, positions, mask=None):
+    def __call__(self, x, positions, mask=None, kv_cache=None, layer=0):
         cfg = self.cfg
         y = _norm(cfg, "ln_attn")(x)
         x = x + Attention(cfg, attention_fn=self.attention_fn,
-                          name="attn")(y, positions, mask)
+                          name="attn")(y, positions, mask,
+                                       kv_cache=kv_cache, layer=layer)
         y = _norm(cfg, "ln_mlp")(x)
         x = x + Mlp(cfg, name="mlp")(y)
         return x
@@ -265,7 +307,16 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, mask=None,
-                 return_hidden=False):
+                 return_hidden=False, kv_cache=None):
+        """``kv_cache`` opens the autoregressive serving path: a
+        duck-typed cache carrier (``update(layer, k, v, positions) ->
+        (k_full, v_full, valid)``, serving/decode.SlottedKVCache) whose
+        buffers the caller threads through its compiled step. With it,
+        ``tokens`` are the NEW tokens only (the whole prompt at
+        prefill, one token per sequence at decode) and ``positions``
+        their absolute positions; attention runs over the cache, not
+        the ``tokens`` window. ``None`` (every training/one-shot path)
+        is byte-identical to the pre-cache model."""
         cfg = self.cfg
         B, T = tokens.shape
         if positions is None:
@@ -289,8 +340,15 @@ class Transformer(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, static_argnums=())
         for i in range(cfg.num_layers):
-            x = block(cfg, attention_fn=self.attention_fn,
-                      name=f"block_{i}")(x, positions, mask)
+            if kv_cache is None:
+                # training/one-shot path: exact pre-cache call shape so
+                # remat'd and jitted programs lower identically
+                x = block(cfg, attention_fn=self.attention_fn,
+                          name=f"block_{i}")(x, positions, mask)
+            else:
+                x = block(cfg, attention_fn=self.attention_fn,
+                          name=f"block_{i}")(x, positions, mask,
+                                             kv_cache=kv_cache, layer=i)
         x = _norm(cfg, "ln_final")(x)
         if return_hidden:
             # pre-head activations for the fused LM-head cross-entropy

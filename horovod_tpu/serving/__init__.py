@@ -17,10 +17,21 @@ serving tier needs; this package adds the one genuinely new piece
   /v1/predict + /healthz + /metrics over the per-job shared secret;
 * :class:`~horovod_tpu.serving.replica_set.ReplicaSet` — least-loaded
   multi-replica dispatch with transparent failover and SIGTERM
-  drain-then-exit (exit code 83).
+  drain-then-exit (exit code 83);
+* :class:`~horovod_tpu.serving.decode.GenerationEngine` +
+  :class:`~horovod_tpu.serving.scheduler.DecodeScheduler` — the
+  autoregressive workload: AOT prefill/decode executables over a
+  slotted (optionally int8 block-quantized) KV cache, continuously
+  batched at iteration granularity with SLO-class admission and token
+  streaming (docs/generation.md);
+* :class:`~horovod_tpu.serving.replica_set.ReplicaAutoscaler` +
+  :class:`~horovod_tpu.serving.replica_set.ReplicaSupervisor` —
+  metrics-driven replica growth/drain over the preemption (exit 83)
+  contract.
 
 See docs/serving.md for architecture, knobs and the load-generator
-recipe (scripts/serving_loadgen.py).
+recipe (scripts/serving_loadgen.py); docs/generation.md for the
+decode path.
 """
 
 from .batcher import (  # noqa: F401
@@ -29,6 +40,16 @@ from .batcher import (  # noqa: F401
     QueueFull,
     RequestTimeout,
 )
+from .decode import (  # noqa: F401
+    GenerationEngine,
+    KVCacheSpec,
+    SlottedKVCache,
+    TRANSFORMER_LM,
+    config_from_meta,
+    config_to_meta,
+    parse_decode_buckets,
+    parse_kv_dtype,
+)
 from .engine import (  # noqa: F401
     InferenceEngine,
     SERVING_META_KEY,
@@ -36,9 +57,19 @@ from .engine import (  # noqa: F401
     parse_buckets,
 )
 from .replica_set import (  # noqa: F401
+    SERVING_DECODE_KIND,
     SERVING_KIND,
+    ReplicaAutoscaler,
     ReplicaSet,
+    ReplicaSupervisor,
+    generate_remote,
+    generate_stream_remote,
     predict_remote,
     serve_replica,
+)
+from .scheduler import (  # noqa: F401
+    DecodeScheduler,
+    GenRequest,
+    SLO_CLASSES,
 )
 from .server import AUTH_HEADER, ServingServer, sign_body  # noqa: F401

@@ -49,6 +49,11 @@ from .batcher import RequestTimeout
 from .server import AUTH_HEADER, REQUEST_ID_HEADER, ServingServer, sign_body
 
 SERVING_KIND = "serving"
+#: decode replicas register under their own kind: a front door pools
+#: ONE capability, so /v1/generate can never be least-loaded-routed to
+#: a predict replica (whose 404 is a terminal client error, not a
+#: retryable failover) in a mixed fleet
+SERVING_DECODE_KIND = "serving-decode"
 
 
 def _build_body(x: np.ndarray,
@@ -92,6 +97,59 @@ def predict_remote(
     the ReplicaSet's job). Raises urllib.error.HTTPError / OSError."""
     return _post_body(addr, _build_body(x, timeout_s),
                       (timeout_s or 30.0) + 5.0, key=key)
+
+
+def generate_stream_remote(
+    addr: str,
+    req: Dict,
+    timeout_s: Optional[float] = None,
+    key: Optional[bytes] = None,
+    request_id: str = "",
+):
+    """One streaming POST /v1/generate against ``host:port``: a
+    generator of parsed chunk dicts, yielded as the replica's chunked
+    response delivers them (urllib reassembles the chunked framing;
+    each line is one JSON object — server.py's stream contract). No
+    retries; failover is :meth:`ReplicaSet.generate`'s job."""
+    body_obj = dict(req)
+    body_obj["stream"] = True
+    if timeout_s:
+        body_obj["timeout_ms"] = int(timeout_s * 1e3)
+    body = json.dumps(body_obj).encode()
+    r = urllib.request.Request(
+        f"http://{addr}/v1/generate", data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    if key is not None:
+        r.add_header(AUTH_HEADER, sign_body(key, body))
+    if request_id:
+        r.add_header(REQUEST_ID_HEADER, request_id)
+    with urllib.request.urlopen(r, timeout=(timeout_s or 30.0) + 5.0) \
+            as resp:
+        for line in resp:
+            line = line.strip()
+            if not line:
+                continue
+            chunk = json.loads(line)
+            yield chunk
+            if chunk.get("done"):
+                return
+
+
+def generate_remote(addr: str, req: Dict,
+                    timeout_s: Optional[float] = None,
+                    key: Optional[bytes] = None):
+    """Blocking convenience over :func:`generate_stream_remote`:
+    returns ``(tokens, finish_reason)``."""
+    tokens, reason = [], None
+    for chunk in generate_stream_remote(addr, req, timeout_s, key):
+        tokens.extend(int(t) for t in chunk.get("tokens", ()))
+        if chunk.get("done"):
+            reason = chunk.get("finish_reason")
+            if chunk.get("error"):
+                raise RuntimeError(f"generation failed mid-stream: "
+                                   f"{chunk['error']}")
+    return tokens, reason
 
 
 def _dispatch_retryable(exc: BaseException) -> bool:
@@ -199,6 +257,36 @@ class ReplicaSet:
             else:
                 self._dead.pop(idx, None)
 
+    # -- live membership (the autoscaler's hooks) ---------------------------
+
+    def add_replica(self, idx: int, addr: str) -> None:
+        """Bring a new replica into rotation (autoscaler grow path).
+        Idempotent on the same (idx, addr); re-adding a dead index
+        revives it — the spawned process is fresh."""
+        with self._lock:
+            self._replicas[idx] = addr
+            self._inflight.setdefault(idx, 0)
+            self._dead.pop(idx, None)
+            n = len(self._replicas)
+        metrics.set_serving_replicas(n)
+
+    def remove_replica(self, idx: int) -> None:
+        """Take a replica out of rotation BEFORE draining it
+        (autoscaler shrink path): no new requests route to it, its
+        in-flight work finishes under the SIGTERM drain contract. The
+        last replica cannot be removed — an empty set would turn every
+        request into an instant failure."""
+        with self._lock:
+            if idx not in self._replicas:
+                return
+            if len(self._replicas) <= 1:
+                raise ValueError(
+                    "refusing to remove the last serving replica")
+            self._replicas.pop(idx)
+            self._dead.pop(idx, None)
+            n = len(self._replicas)
+        metrics.set_serving_replicas(n)
+
     # -- dispatch -----------------------------------------------------------
 
     def predict(self, x: np.ndarray,
@@ -254,6 +342,321 @@ class ReplicaSet:
                  timeout_s: Optional[float] = None) -> np.ndarray:
         return self.predict(x, timeout_s)
 
+    def generate(self, req: Dict, timeout_s: Optional[float] = None):
+        """Route one generation request, streaming chunks through as
+        the chosen replica produces them. Failover is
+        **pre-first-chunk only**: a replica that fails before emitting
+        anything (draining 503, queue-full 429, death) is retried on a
+        peer exactly like predict; once tokens flowed, the stream is
+        committed to that replica and a mid-stream death ends it with
+        an in-band ``{"done": true, "error": ...}`` chunk — the
+        front-door 200 is already on the wire, and replaying a prefix
+        of generated tokens on another replica would emit them twice.
+        """
+        if timeout_s is None:
+            timeout_s = self._default_timeout_s
+        deadline = retry.Deadline(timeout_s)
+        rid = tracing.current_request_id()
+        attempts = max(len(self._replicas) + 2, 4)
+        last_exc: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if deadline.expired():
+                raise last_exc or RequestTimeout(
+                    f"request budget {timeout_s}s exhausted during "
+                    "generate dispatch/failover")
+            idx, addr = self._pick()
+            flight.record("serving_dispatch", str(idx), req=rid,
+                          route="generate")
+            yielded = False
+            try:
+                faults.inject("serving.dispatch", replica=idx)
+                for chunk in generate_stream_remote(
+                        addr, req, max(deadline.remaining(), 0.5),
+                        key=self._key, request_id=rid):
+                    yielded = True
+                    yield chunk
+                return
+            except GeneratorExit:
+                # the consumer stopped reading (done chunk seen,
+                # client hung up): not a replica failure
+                raise
+            except BaseException as e:
+                if _ejects_replica(e):
+                    self._mark_dead(idx, e)
+                    flight.record("serving_failover", str(idx),
+                                  error=str(e)[:120])
+                if yielded:
+                    yield {"done": True,
+                           "error": f"{type(e).__name__}: {e}"}
+                    return
+                if not _dispatch_retryable(e):
+                    raise
+                last_exc = e
+                metrics.record_retry("serving.dispatch")
+                time.sleep(min(0.05 * (attempt + 1), 0.5))
+            finally:
+                self._release(idx)
+        raise last_exc or RuntimeError("generate dispatch exhausted")
+
+
+# ---------------------------------------------------------------------------
+# replica autoscaling: supervisor (spawn/drain) + the metrics-driven
+# control loop (docs/generation.md)
+# ---------------------------------------------------------------------------
+
+class ReplicaSupervisor:
+    """Owns autoscaler-spawned replicas: process lifecycle only.
+
+    ``spawn_fn(index) -> (addr, handle)`` starts one replica and blocks
+    until it is serving (the decode_check spawns the real
+    ``python -m horovod_tpu.serving.replica_set --decode`` subprocess
+    and waits for its READY line; tests pass fakes). ``handle`` needs
+    ``send_signal``/``wait`` (a ``subprocess.Popen`` works as-is).
+
+    Drain reuses the preemption contract the elastic driver
+    established: SIGTERM → the replica stops admission, finishes every
+    resident sequence, exits ``PREEMPTED_EXIT_CODE`` (83) — "host went
+    away healthy", never blacklisted (elastic/preemption.py). The
+    replica is removed from dispatch BEFORE the signal, so the drain
+    is invisible to clients.
+    """
+
+    def __init__(self, spawn_fn, replica_set: ReplicaSet,
+                 *, base_index: int = 100):
+        self._spawn = spawn_fn
+        self._rs = replica_set
+        self._next_index = base_index
+        self._owned: Dict[int, object] = {}  # index -> handle
+        self._lock = threading.Lock()
+
+    @property
+    def owned(self) -> Dict[int, object]:
+        with self._lock:
+            return dict(self._owned)
+
+    def grow(self) -> int:
+        with self._lock:
+            idx = self._next_index
+            self._next_index += 1
+        addr, handle = self._spawn(idx)
+        with self._lock:
+            self._owned[idx] = handle
+        self._rs.add_replica(idx, addr)
+        flight.record("autoscale_grow", str(idx), addr=addr)
+        return idx
+
+    def shrink(self, timeout_s: float = 60.0) -> Optional[int]:
+        """Drain the newest supervisor-owned replica; returns its
+        index (None when this supervisor owns nothing — replicas it
+        did not spawn are never its to kill)."""
+        import signal as signal_mod
+
+        with self._lock:
+            if not self._owned:
+                return None
+            idx = max(self._owned)
+            handle = self._owned[idx]
+        # out of rotation first: no new work routes to it while the
+        # SIGTERM drain flushes what it already accepted. The handle
+        # leaves _owned only once the process is actually reaped — a
+        # refused removal (last replica) or a drain timeout must not
+        # orphan a live subprocess nobody can signal again.
+        self._rs.remove_replica(idx)
+        handle.send_signal(signal_mod.SIGTERM)
+        rc = handle.wait(timeout=timeout_s)
+        with self._lock:
+            self._owned.pop(idx, None)
+        flight.record("autoscale_shrink", str(idx), exit_code=rc)
+        return idx
+
+    def stop_all(self, timeout_s: float = 30.0) -> None:
+        while True:
+            with self._lock:
+                if not self._owned:
+                    return
+            try:
+                self.shrink(timeout_s=timeout_s)
+            except ValueError:
+                # last replica in the set: leave it serving
+                return
+
+
+class ReplicaAutoscaler:
+    """Grow/shrink the replica fleet off the live ``hvd_serving_*``
+    decode signals: slot occupancy (``hvd_serving_decode_slots``,
+    surfaced as ``slots{}`` on every replica's unauthenticated
+    /healthz) and admission queue wait
+    (``hvd_serving_queue_wait_seconds`` deltas from /metrics).
+
+    Policy: a poll is *hot* when aggregate occupancy ≥ ``hi_occupancy``
+    or prefills are queueing while recent queue wait ≥
+    ``queue_wait_hi_s``; *cold* when occupancy ≤ ``lo_occupancy`` with
+    an empty queue. ``sustain`` consecutive hot (cold) polls outside
+    the ``cooldown_s`` window grow (shrink) by one replica, clamped to
+    [min_replicas, max_replicas]. Every action lands in
+    ``hvd_serving_autoscale_events_total{action=}`` and the flight
+    ring, so a scaling decision is as traceable as a failover.
+    """
+
+    def __init__(
+        self,
+        supervisor: ReplicaSupervisor,
+        replica_set: ReplicaSet,
+        *,
+        signal_fn=None,
+        min_replicas: Optional[int] = None,
+        max_replicas: Optional[int] = None,
+        hi_occupancy: Optional[float] = None,
+        lo_occupancy: Optional[float] = None,
+        queue_wait_hi_s: Optional[float] = None,
+        sustain: Optional[int] = None,
+        cooldown_s: Optional[float] = None,
+        clock=time.monotonic,
+    ):
+        from .engine import serving_knobs
+
+        k = serving_knobs()
+
+        def _k(v, name, default):
+            return v if v is not None else getattr(k, name, default)
+
+        self._sup = supervisor
+        self._rs = replica_set
+        self._signal_fn = signal_fn or self._scrape_signals
+        self.min_replicas = int(_k(min_replicas,
+                                   "serving_autoscale_min_replicas", 1))
+        self.max_replicas = int(_k(max_replicas,
+                                   "serving_autoscale_max_replicas", 4))
+        self.hi_occupancy = float(_k(hi_occupancy,
+                                     "serving_autoscale_hi_occupancy",
+                                     0.85))
+        self.lo_occupancy = float(_k(lo_occupancy,
+                                     "serving_autoscale_lo_occupancy",
+                                     0.25))
+        self.queue_wait_hi_s = float(_k(queue_wait_hi_s,
+                                        "serving_autoscale_queue_wait_s",
+                                        0.5))
+        self.sustain = int(_k(sustain, "serving_autoscale_sustain", 2))
+        self.cooldown_s = float(_k(cooldown_s,
+                                   "serving_autoscale_cooldown_s", 10.0))
+        self._clock = clock
+        self._hot_streak = 0
+        self._cold_streak = 0
+        self._last_action_t = -1e9
+        self._last_wait: Dict[str, Tuple[float, float]] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self.decisions: list = []  # (t, action) trail for tests/checks
+
+    # -- signals -------------------------------------------------------------
+
+    def _scrape_signals(self) -> Dict:
+        """Aggregate occupancy/queue state across the live replicas:
+        slots{} from /healthz, queue-wait sum/count deltas from
+        /metrics. A replica that fails to answer contributes nothing
+        (the dispatch tier's failover owns dead-replica handling)."""
+        total = occupied = queued = 0
+        dsum = dcount = 0.0
+        for idx, addr in self._rs.replicas.items():
+            try:
+                with urllib.request.urlopen(
+                        f"http://{addr}/healthz", timeout=2.0) as r:
+                    h = json.loads(r.read())
+                slots = h.get("slots") or {}
+                total += int(slots.get("total", 0))
+                occupied += int(slots.get("occupied", 0))
+                queued += int(slots.get("queued_prefills", 0))
+            except Exception:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://{addr}/metrics", timeout=2.0) as r:
+                    text = r.read().decode()
+                s = c = 0.0
+                for line in text.splitlines():
+                    if line.startswith(
+                            "hvd_serving_queue_wait_seconds_sum"):
+                        s = float(line.rsplit(" ", 1)[1])
+                    elif line.startswith(
+                            "hvd_serving_queue_wait_seconds_count"):
+                        c = float(line.rsplit(" ", 1)[1])
+                ps, pc = self._last_wait.get(addr, (0.0, 0.0))
+                self._last_wait[addr] = (s, c)
+                dsum += max(s - ps, 0.0)
+                dcount += max(c - pc, 0.0)
+            except Exception:
+                continue
+        return {
+            "occupancy": (occupied / total) if total else 0.0,
+            "queued": queued,
+            "queue_wait_s": (dsum / dcount) if dcount else 0.0,
+        }
+
+    # -- the control loop ----------------------------------------------------
+
+    def poll_once(self) -> Optional[str]:
+        """One observe-decide-act cycle; returns "grow"/"shrink" when
+        an action fired, else None."""
+        sig = self._signal_fn()
+        now = self._clock()
+        n = len(self._rs.replicas)
+        hot = (sig.get("occupancy", 0.0) >= self.hi_occupancy
+               or (sig.get("queued", 0) > 0
+                   and sig.get("queue_wait_s", 0.0)
+                   >= self.queue_wait_hi_s))
+        cold = (sig.get("occupancy", 0.0) <= self.lo_occupancy
+                and sig.get("queued", 0) == 0)
+        self._hot_streak = self._hot_streak + 1 if hot else 0
+        self._cold_streak = self._cold_streak + 1 if cold else 0
+        if now - self._last_action_t < self.cooldown_s:
+            return None
+        action = None
+        if (self._hot_streak >= self.sustain
+                and n < self.max_replicas):
+            self._sup.grow()
+            action = "grow"
+        elif (self._cold_streak >= self.sustain
+                and n > self.min_replicas
+                and self._sup.owned):
+            if self._sup.shrink() is not None:
+                action = "shrink"
+        if action:
+            self._last_action_t = now
+            self._hot_streak = self._cold_streak = 0
+            self.decisions.append((now, action))
+            metrics.record_autoscale(action)
+        return action
+
+    def start(self, interval_s: Optional[float] = None) -> None:
+        from .engine import serving_knobs
+
+        if interval_s is None:
+            interval_s = float(getattr(
+                serving_knobs(), "serving_autoscale_interval_s", 2.0))
+        if self._thread is not None:
+            return
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.poll_once()
+                except Exception as e:  # noqa: BLE001 — keep scaling
+                    flight.record("autoscale_error", "",
+                                  error=str(e)[:120])
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name="hvd-serving-autoscaler")
+        t.start()
+        self._thread, self._stop = t, stop
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self._thread = None
+            self._stop = None
+
 
 # ---------------------------------------------------------------------------
 # process entry points: one replica, or the front door
@@ -285,7 +688,7 @@ def _install_drain_handler(server: ServingServer, batcher,
 
 
 def _register(register: str, index: int, port: int,
-              key: Optional[bytes]) -> None:
+              key: Optional[bytes], kind: str = SERVING_KIND) -> None:
     from ..runner.compute_service import ComputeClient
     from ..runner.util.network import routable_host_address
 
@@ -296,7 +699,7 @@ def _register(register: str, index: int, port: int,
     host, _, p = register.rpartition(":")
     client = ComputeClient([(host, int(p))], key)
     client.register_worker(
-        SERVING_KIND, index, f"{routable_host_address()}:{port}")
+        kind, index, f"{routable_host_address()}:{port}")
 
 
 def serve_replica(argv=None) -> int:
@@ -312,6 +715,11 @@ def serve_replica(argv=None) -> int:
                     help="host:port of the ComputeService registry")
     ap.add_argument("--buckets", default="",
                     help="override HOROVOD_SERVING_BUCKETS")
+    ap.add_argument("--decode", action="store_true",
+                    help="serve autoregressive generation "
+                         "(/v1/generate) from a transformer_lm "
+                         "checkpoint instead of one-shot predict "
+                         "(docs/generation.md)")
     ap.add_argument("--max-wait-ms", type=float, default=None)
     ap.add_argument("--queue-limit", type=int, default=None)
     ap.add_argument("--no-warmup", action="store_true",
@@ -357,6 +765,12 @@ def serve_replica(argv=None) -> int:
             svc.shutdown()
             return 0
 
+    # a front door pools ONE capability: --decode selects the
+    # serving-decode registry kind and mounts /v1/generate; otherwise
+    # the predict kind and /v1/predict. Mixed fleets run one front
+    # door per capability — pooling both would least-loaded-route
+    # generates onto predict replicas, whose 404 is terminal.
+    fleet_kind = SERVING_DECODE_KIND if args.decode else SERVING_KIND
     batcher = None
     if args.front_door:
         if args.replicas:
@@ -370,7 +784,7 @@ def serve_replica(argv=None) -> int:
                 raise RuntimeError("--register needs HVD_TPU_SECRET_KEY")
             client = ComputeClient([(host, int(p))], key)
             replicas = client.wait_for_workers(
-                SERVING_KIND, args.wait_replicas,
+                fleet_kind, args.wait_replicas,
                 timeout_s=args.wait_timeout)
             if len(replicas) < args.wait_replicas:
                 # the registry returns whatever registered on timeout;
@@ -378,7 +792,7 @@ def serve_replica(argv=None) -> int:
                 # --wait-replicas N would hide a broken replica fleet
                 raise RuntimeError(
                     f"only {len(replicas)}/{args.wait_replicas} "
-                    f"serving replicas registered within "
+                    f"{fleet_kind} replicas registered within "
                     f"{args.wait_timeout}s")
         else:
             raise RuntimeError(
@@ -386,10 +800,46 @@ def serve_replica(argv=None) -> int:
                 "--wait-replicas")
         rs = ReplicaSet(replicas, key=key)
         server = ServingServer(
-            rs.predict, port=args.port, key=key,
+            predict_fn=None if args.decode else rs.predict,
+            generate_fn=rs.generate if args.decode else None,
+            port=args.port, key=key,
             health_extra=lambda: {"replicas": rs.replicas,
                                   "dead": rs.dead})
         role = "front-door"
+    elif args.decode:
+        from .decode import GenerationEngine
+        from .scheduler import DecodeScheduler
+
+        if not args.checkpoint:
+            ap.error("--checkpoint is required for a replica")
+        engine = GenerationEngine.from_checkpoint(args.checkpoint)
+        if not args.no_warmup:
+            engine.warmup()
+        scheduler = DecodeScheduler(
+            engine, queue_limit=args.queue_limit).start()
+        batcher = scheduler  # close(drain=) shares the batcher contract
+
+        def generate_local(req, timeout_s, _s=scheduler):
+            pending = _s.submit(
+                req["prompt"],
+                max_new_tokens=req.get("max_new_tokens"),
+                timeout_s=timeout_s,
+                slo=req.get("slo", "standard"))
+            return pending.stream(
+                timeout_s=(timeout_s
+                           or _s._default_timeout_s) + 5.0)
+
+        server = ServingServer(
+            generate_fn=generate_local, port=args.port, key=key,
+            # probe body: the slots triple is what lets probes (and
+            # the autoscaler) tell "full" from "wedged"
+            health_extra=lambda: {
+                "slots": scheduler.slot_stats(),
+                "queued": scheduler.pending,
+                "bucket_cache": engine.cached_executables,
+            },
+        )
+        role = "replica"
     else:
         from .batcher import DynamicBatcher
         from .engine import InferenceEngine, SERVING_META_KEY, parse_buckets
@@ -423,7 +873,8 @@ def serve_replica(argv=None) -> int:
 
     port = server.start()
     if args.register and not args.front_door:
-        _register(args.register, args.index, port, key)
+        _register(args.register, args.index, port, key,
+                  kind=fleet_kind)
     _install_drain_handler(server, batcher, args.drain_timeout)
     print(f"SERVING_{role.upper().replace('-', '_')}_READY "
           f"index={args.index} port={port}", flush=True)

@@ -1,4 +1,5 @@
-"""HTTP front end for inference: POST /v1/predict, /healthz, /metrics.
+"""HTTP front end for inference: POST /v1/predict + /v1/generate,
+/healthz, /metrics.
 
 Same transport family as the control plane: a threaded stdlib HTTP
 server in the mold of ``runner/http/http_server.py`` (per-request
@@ -19,6 +20,17 @@ Protocol::
        401 bad/missing auth        413 oversized body
        429 admission queue full    503 draining / injected failure
        504 request deadline expired
+
+    POST /v1/generate                     (decode replicas / front door)
+    {"prompt": [17, 4, ...], "max_new_tokens": 64, "timeout_ms": 5000,
+     "slo": "interactive", "stream": true}
+    -> 200 chunked, one JSON object per line:
+       {"tokens": [92]} ... {"done": true, "finish_reason": "eos", "n": 7}
+       (stream=false collapses to one {"tokens": [...], "n",
+       "finish_reason"} body; the error statuses mirror /v1/predict,
+       and an error AFTER streaming began arrives as a final
+       {"done": true, "error": ...} chunk — the 200 is already on the
+       wire)
 
 The same class fronts a single replica (predict_fn = the batcher) and
 the multi-replica dispatch tier (predict_fn = ReplicaSet.predict) — the
@@ -66,6 +78,7 @@ def sign_body(key: bytes, body: bytes) -> str:
 class _ServingHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     _request_id = ""  # set per predict request; echoed on the reply
+    _streamed = False  # a chunked 200 is already on the wire
 
     # -- helpers ------------------------------------------------------------
 
@@ -89,6 +102,28 @@ class _ServingHandler(BaseHTTPRequestHandler):
     def _reply_json(self, code: int, obj: Dict) -> None:
         self._reply(code, json.dumps(obj).encode())
 
+    # manual chunked framing (token streaming): the stdlib server never
+    # writes Transfer-Encoding itself, so the handler frames each JSON
+    # line as one HTTP/1.1 chunk — clients see tokens the iteration
+    # they were generated, and urllib's chunked decoding reassembles
+    # the line stream transparently on the other end
+    def _start_stream(self) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/jsonl")
+        self.send_header("Transfer-Encoding", "chunked")
+        if self._request_id:
+            self.send_header(REQUEST_ID_HEADER, self._request_id)
+        self.end_headers()
+        self._streamed = True
+
+    def _stream_chunk(self, obj: Dict) -> None:
+        data = json.dumps(obj).encode() + b"\n"
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_stream(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+
     def log_message(self, *args):  # silence per-request logging
         pass
 
@@ -108,9 +143,17 @@ class _ServingHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         srv: "ServingServer" = self.server.serving  # type: ignore[attr-defined]
-        if self.path.split("?", 1)[0].rstrip("/") != "/v1/predict":
+        path = self.path.split("?", 1)[0].rstrip("/")
+        generate = path == "/v1/generate"
+        if generate and srv.generate_fn is None:
+            self._reply_json(404, {"error": "no generation engine "
+                                            "behind this server"})
+            return
+        if not generate and (path != "/v1/predict"
+                             or srv.predict_fn is None):
             self._reply_json(404, {"error": "not found"})
             return
+        self._streamed = False
         t0 = time.perf_counter()
         # request trace id: the client's X-Request-Id (sanitized) or a
         # fresh one — bound to this handler thread's context so the
@@ -149,6 +192,19 @@ class _ServingHandler(BaseHTTPRequestHandler):
                         return
                 if srv.draining:
                     code, resp = 503, {"error": "draining"}
+                    return
+                if generate:
+                    try:
+                        req = json.loads(body)
+                        if not isinstance(req, dict) \
+                                or "prompt" not in req:
+                            raise KeyError("prompt")
+                        timeout_s = (float(req["timeout_ms"]) / 1e3
+                                     if req.get("timeout_ms") else None)
+                    except (ValueError, KeyError, TypeError) as e:
+                        code, resp = 400, {"error": f"bad request: {e}"}
+                        return
+                    code, resp = self._generate(srv, req, timeout_s)
                     return
                 try:
                     req = json.loads(body)
@@ -202,12 +258,60 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 tracing.reset_request_id(rid_token)
                 self._request_id = ""
 
+    def _generate(self, srv: "ServingServer", req: Dict,
+                  timeout_s: Optional[float]):
+        """Run one /v1/generate request through ``srv.generate_fn``
+        (an iterator of chunk dicts — scheduler.GenRequest.stream or
+        the front door's upstream relay). Admission errors raise
+        BEFORE anything is written, so the do_POST ladder maps them to
+        429/503/504 like predict."""
+        chunks = iter(srv.generate_fn(req, timeout_s))
+        if not req.get("stream"):
+            tokens, fin = [], {}
+            for chunk in chunks:  # admission errors raise on first next
+                tokens.extend(int(t) for t in chunk.get("tokens", ()))
+                if chunk.get("done"):
+                    fin = chunk
+                    break
+            resp = {"tokens": tokens, "n": len(tokens),
+                    "finish_reason": fin.get("finish_reason")}
+            if fin.get("error"):
+                # tokens flowed, then the engine failed: the partial
+                # output is real — deliver it with the error attached
+                resp["error"] = fin["error"]
+            return 200, resp
+        first = next(chunks)
+        self._start_stream()
+        try:
+            self._stream_chunk(first)
+            if not first.get("done"):
+                for chunk in chunks:
+                    self._stream_chunk(chunk)
+                    if chunk.get("done"):
+                        break
+        except Exception as e:  # noqa: BLE001 — 200 is on the wire
+            # the in-band error contract: a generator failure
+            # mid-stream must reach the client as an explicit error
+            # chunk, or a truncated generation reads as a completed
+            # one. Best-effort (the socket itself may be the failure),
+            # then re-raise so the request is METERED as a failure.
+            try:
+                self._stream_chunk({"done": True,
+                                    "error": f"{type(e).__name__}: {e}"})
+            except Exception:
+                pass
+            raise
+        finally:
+            self._end_stream()
+        return 200, None
+
     def _finish(self, code: int, resp: Dict, t0: float) -> None:
         dt = time.perf_counter() - t0
         metrics.record_serving_request(dt, code)
         flight.record("serving_request", self._request_id,
                       code=code, ms=round(dt * 1e3, 3))
-        self._reply_json(code, resp)
+        if not self._streamed:
+            self._reply_json(code, resp)
 
 
 class ServingServer:
@@ -222,13 +326,22 @@ class ServingServer:
 
     def __init__(
         self,
-        predict_fn: Callable[[np.ndarray, Optional[float]], np.ndarray],
+        predict_fn: Optional[Callable[[np.ndarray, Optional[float]],
+                                      np.ndarray]] = None,
         *,
+        generate_fn: Optional[Callable] = None,
         port: int = 0,
         key: Optional[bytes] = None,
         health_extra: Optional[Callable[[], Dict]] = None,
     ):
+        if predict_fn is None and generate_fn is None:
+            raise ValueError(
+                "ServingServer needs predict_fn and/or generate_fn")
         self.predict_fn = predict_fn
+        #: ``generate_fn(req_dict, timeout_s) -> iterator of chunk
+        #: dicts`` — the /v1/generate backend (decode scheduler on a
+        #: replica, upstream relay on the front door)
+        self.generate_fn = generate_fn
         self.key = key
         self.draining = False
         self._health_extra = health_extra

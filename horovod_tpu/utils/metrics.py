@@ -1306,6 +1306,100 @@ def record_serving_failover(replica: str) -> None:
     ).labels(replica).inc()
 
 
+# -- autoregressive decode (serving/decode.py + serving/scheduler.py) --------
+
+def record_decode_prefill(bucket: int, seconds: float) -> None:
+    """One prompt prefilled into a claimed slot, by prompt-length
+    bucket."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_decode_prefills_total",
+        "Prompts prefilled into cache slots, by prompt-length bucket",
+        ("bucket",),
+    ).labels(str(bucket)).inc()
+    registry.histogram(
+        "hvd_serving_decode_prefill_seconds",
+        "Prefill executable wall time per admitted prompt",
+    ).observe(seconds)
+
+
+def record_decode_iteration(slots: int, seconds: float) -> None:
+    """One decode iteration executed (every slot advances one
+    position; callers ignore inactive slots' outputs)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_decode_iterations_total",
+        "Decode iterations executed").inc()
+    registry.histogram(
+        "hvd_serving_decode_iteration_seconds",
+        "Decode-iteration executable wall time",
+    ).observe(seconds)
+
+
+def record_decode_tokens(n: int) -> None:
+    """Tokens actually delivered to live sequences this iteration
+    (excludes inactive-slot ride-along outputs)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_decode_tokens_total",
+        "Tokens generated for live sequences").inc(n)
+
+
+def set_decode_slots(total: int, occupied: int, queued: int) -> None:
+    """Slot occupancy + queued prefills after a scheduler iteration —
+    the live signals the replica autoscaler scales on
+    (docs/generation.md)."""
+    if not _enabled:
+        return
+    g = registry.gauge(
+        "hvd_serving_decode_slots",
+        "Decode cache slots, by state", ("state",))
+    g.labels("total").set(total)
+    g.labels("occupied").set(occupied)
+    registry.gauge(
+        "hvd_serving_decode_queued_prefills",
+        "Requests admitted but waiting for a free slot").set(queued)
+    registry.gauge(
+        "hvd_serving_decode_slot_occupancy",
+        "Occupied fraction of decode cache slots").set(
+            occupied / total if total else 0.0)
+
+
+def record_decode_eviction(reason: str) -> None:
+    """One sequence leaving its slot (or the queue), by reason:
+    eos / length / deadline / shed / drain."""
+    _flight.record("decode_evict", reason)
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_decode_evictions_total",
+        "Sequences evicted from decode, by reason", ("reason",),
+    ).labels(reason).inc()
+
+
+def record_autoscale(action: str) -> None:
+    """One autoscaler decision acted on (grow / shrink)."""
+    _flight.record("autoscale", action)
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_serving_autoscale_events_total",
+        "Replica autoscaler actions, by direction", ("action",),
+    ).labels(action).inc()
+
+
+def set_serving_replicas(n: int) -> None:
+    """Live replicas currently in dispatch rotation (front door)."""
+    if not _enabled:
+        return
+    registry.gauge(
+        "hvd_serving_replicas",
+        "Replicas in the dispatch rotation").set(n)
+
+
 # ---------------------------------------------------------------------------
 # native runtime stats bridge (pull model)
 # ---------------------------------------------------------------------------

@@ -119,11 +119,34 @@ def summarize_autotune(events):
               f"{_fmt_overrides(final.get('config', {}))}")
 
 
+def summarize_decode(events):
+    """Render the decode scheduler's periodic event lines
+    (serving/scheduler.py emits one per
+    HOROVOD_SERVING_DECODE_STATS_EVERY iterations): cumulative
+    iterations/tokens, last-seen occupancy, evictions by reason."""
+    if not events:
+        return
+    last = events[-1]
+    total = last.get("slots_total", 0)
+    occ = last.get("slots_occupied", 0)
+    print(f"\ndecode: {last.get('iterations', 0)} iterations, "
+          f"{last.get('tokens', 0)} tokens "
+          f"({len(events)} stat events); last occupancy "
+          f"{occ}/{total}, queued {last.get('queued_prefills', 0)}")
+    ev = last.get("evictions") or {}
+    if ev:
+        print("decode evictions: " + ", ".join(
+            f"{k}={int(v)}" for k, v in sorted(ev.items())))
+
+
 def summarize(records):
     autotune_events = [r["autotune"] for r in records
                        if r.get("event") == "autotune" and "autotune" in r]
+    decode_events = [r["decode"] for r in records
+                     if r.get("event") == "decode" and "decode" in r]
     records = [r for r in records if "event" not in r]
     if not records:
+        summarize_decode(decode_events)
         summarize_autotune(autotune_events)
         return
     times = sorted(r["step_time_s"] for r in records)
@@ -248,6 +271,7 @@ def summarize(records):
             print("retry GIVE-UPS: " + ", ".join(
                 f"{p}={int(n)}" for p, n in sorted(giveups.items())))
 
+    summarize_decode(decode_events)
     summarize_autotune(autotune_events)
 
 

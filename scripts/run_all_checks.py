@@ -39,6 +39,13 @@ locally before the full pytest tier:
   than the incumbent default, a cache-hit rerun performs 0 tuning
   compiles, pin-then-rebuild is bitwise, and the decision trail is
   visible in /metrics + the StepStats JSONL + metrics_summary);
+* ``decode`` — ``scripts/decode_check.py --check`` (continuous-
+  batching generation: mixed-length streaming requests >= 2x aggregate
+  tokens/sec over a static-batch baseline on the same engine, greedy
+  outputs bitwise-equal to the one-at-a-time reference with fp32 KV,
+  int8 KV within the documented tolerance, and the replica autoscaler
+  grows then SIGTERM-drains (exit 83) a world-2 replica off the live
+  queue-wait/occupancy gauges with zero client-visible failures);
 * ``perf`` — ``scripts/perf_baseline.py --check`` (the perf-regression
   gate: structural invariants — fast-path engaged, zero steady
   negotiated bytes, profiler sampled + attributed inside its duty
@@ -234,6 +241,18 @@ def check_autotune():
     ], env=env)
 
 
+def check_decode():
+    """The continuous-batching decode gate (12th): parity, int8 KV
+    tolerance, >= 2x over static batching, autoscale grow/drain."""
+    env = _env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "decode_check.py"),
+        "--check",
+    ], env=env)
+
+
 def check_perf():
     """The perf-regression gate + the merged-trace smoke (one gate:
     both run the unified-observability stack end-to-end)."""
@@ -261,6 +280,7 @@ GATES = [
     ("overlap", check_overlap),
     ("fsdp", check_fsdp),
     ("autotune", check_autotune),
+    ("decode", check_decode),
     ("perf", check_perf),
 ]
 

@@ -18,6 +18,13 @@ framework's seeded rules. ``--scrape`` URLs (each replica's /metrics)
 are read after the run and the serving histograms folded into the
 artifact: batch fill ratio, padding waste, queue-wait quantiles.
 
+``--decode`` switches the workload to streaming ``POST /v1/generate``
+(the continuous-batching decode tier, docs/generation.md): prompts and
+output caps drawn from ``--prompt-len``/``--max-new`` distributions,
+and the artifact gains aggregate **tokens/sec**, **time-to-first-
+token** and **per-output-token** p50/p95/p99, plus slot occupancy and
+the shed rate from the scraped ``hvd_serving_decode_*`` series.
+
 ``--check`` is the smoke gate (metrics_summary.py --check /
 chaos_check.py idiom): exit 1 with a one-line reason unless every
 request succeeded, the latency percentiles are nonzero, and — when
@@ -66,11 +73,24 @@ class _Stats:
         self.latencies = []
         self.errors = []
         self.examples = 0
+        # decode mode: time-to-first-token, per-output-token gaps,
+        # generated-token count
+        self.ttft = []
+        self.tpot = []
+        self.tokens = 0
 
     def ok(self, seconds, n):
         with self.lock:
             self.latencies.append(seconds)
             self.examples += n
+
+    def ok_decode(self, seconds, ttft, gaps, n_tokens):
+        with self.lock:
+            self.latencies.append(seconds)
+            self.ttft.append(ttft)
+            self.tpot.extend(gaps)
+            self.tokens += n_tokens
+            self.examples += 1
 
     def fail(self, why):
         with self.lock:
@@ -107,6 +127,61 @@ def _one_request(url, key, rng_seed, shape, n_examples, dtype,
         stats.fail(f"{type(e).__name__}: {e}")
 
 
+def _one_decode_request(url, key, rng_seed, plen, max_new, vocab, slo,
+                        timeout_ms, stats):
+    """One streaming POST /v1/generate: seeded random prompt, chunked
+    line-delimited response; TTFT = first chunk's arrival, TPOT = the
+    gaps between subsequent token chunks."""
+    rng = np.random.RandomState(rng_seed)
+    prompt = rng.randint(1, vocab, size=plen).tolist()
+    body_obj = {"prompt": prompt, "max_new_tokens": int(max_new),
+                "stream": True, "slo": slo}
+    if timeout_ms:
+        body_obj["timeout_ms"] = int(timeout_ms)
+    body = json.dumps(body_obj).encode()
+    req = urllib.request.Request(
+        url, data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    if key:
+        req.add_header(
+            AUTH_HEADER, hmac.new(key, body, hashlib.sha256).hexdigest())
+    t0 = time.perf_counter()
+    ttft = None
+    gaps = []
+    n_tokens = 0
+    last_t = t0
+    try:
+        with urllib.request.urlopen(
+                req, timeout=(timeout_ms or 30000) / 1e3 + 5.0) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                chunk = json.loads(line)
+                now = time.perf_counter()
+                if chunk.get("error"):
+                    stats.fail(f"in-stream error: {chunk['error']}")
+                    return
+                toks = chunk.get("tokens", ())
+                if toks:
+                    if ttft is None:
+                        ttft = now - t0
+                    else:
+                        gaps.append(now - last_t)
+                    last_t = now
+                    n_tokens += len(toks)
+                if chunk.get("done"):
+                    break
+        if ttft is None or n_tokens == 0:
+            stats.fail("stream delivered no tokens")
+            return
+        stats.ok_decode(time.perf_counter() - t0, ttft, gaps, n_tokens)
+    except urllib.error.HTTPError as e:
+        stats.fail(f"HTTP {e.code}: {e.read()[:120]!r}")
+    except Exception as e:  # noqa: BLE001 — every failure is a data point
+        stats.fail(f"{type(e).__name__}: {e}")
+
+
 def _scrape(url):
     """Pull the serving families out of one Prometheus exposition."""
     try:
@@ -126,6 +201,13 @@ def _scrape(url):
         except ValueError:
             continue
         vals[name] = vals.get(name, 0.0) + v
+        # eviction reasons matter individually (shed rate vs deadline
+        # misses); keep the labeled breakdown as name:reason keys
+        if (name == "hvd_serving_decode_evictions_total"
+                and 'reason="' in line):
+            reason = line.split('reason="', 1)[1].split('"', 1)[0]
+            k = f"{name}:{reason}"
+            vals[k] = vals.get(k, 0.0) + v
     return vals
 
 
@@ -147,6 +229,23 @@ def main(argv=None):
     ap.add_argument("--examples", default="1:4",
                     help="examples per request, 'n' or 'lo:hi' uniform")
     ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--decode", action="store_true",
+                    help="drive POST /v1/generate (continuous-batching "
+                         "decode) instead of /v1/predict; reports "
+                         "tokens/sec, TTFT and per-output-token "
+                         "latency (docs/generation.md)")
+    ap.add_argument("--prompt-len", default="4:12",
+                    help="decode: prompt tokens per request, 'n' or "
+                         "'lo:hi' uniform")
+    ap.add_argument("--max-new", default="8:32",
+                    help="decode: output-length cap per request, 'n' "
+                         "or 'lo:hi' uniform")
+    ap.add_argument("--vocab", type=int, default=90,
+                    help="decode: prompt token ids drawn from "
+                         "[1, vocab)")
+    ap.add_argument("--slo", default="standard",
+                    help="decode: SLO class stamped on every request "
+                         "(interactive|standard|batch)")
     ap.add_argument("--timeout-ms", type=int, default=10000)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--secret-env", default="HVD_TPU_SECRET_KEY",
@@ -161,22 +260,50 @@ def main(argv=None):
                          "succeeded and batching metrics are live")
     args = ap.parse_args(argv)
 
-    url = _predict_url(args.url)
+    def _span(spec):
+        if ":" in spec:
+            a, b = (int(v) for v in spec.split(":"))
+            return a, b
+        return int(spec), int(spec)
+
+    base = args.url.rstrip("/")
+    for suffix in ("/v1/predict", "/v1/generate"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+    url = (base + "/v1/generate" if args.decode
+           else _predict_url(args.url))
     key = (os.environ.get(args.secret_env, "").encode()
            if args.secret_env else b"") or None
     shape = tuple(int(d) for d in args.input_shape.split(",") if d)
-    if ":" in args.examples:
-        lo, hi = (int(v) for v in args.examples.split(":"))
-    else:
-        lo = hi = int(args.examples)
+    lo, hi = _span(args.examples)
+    plo, phi = _span(args.prompt_len)
+    nlo, nhi = _span(args.max_new)
     size_rng = np.random.RandomState(args.seed)
+
+    def draw_params(i):
+        """Deterministic per-request parameters (seeded sizes, same
+        idiom as the fault framework's seeded rules)."""
+        if args.decode:
+            return (args.seed + 1 + i,
+                    int(size_rng.randint(plo, phi + 1)),
+                    int(size_rng.randint(nlo, nhi + 1)))
+        return (args.seed + 1 + i, int(size_rng.randint(lo, hi + 1)))
+
+    def fire(entry):
+        if args.decode:
+            seed, plen, max_new = entry
+            _one_decode_request(url, key, seed, plen, max_new,
+                                args.vocab, args.slo, args.timeout_ms,
+                                stats)
+        else:
+            seed, n = entry
+            _one_request(url, key, seed, shape, n, args.dtype,
+                         args.timeout_ms, stats)
 
     stats = _Stats()
     t_start = time.perf_counter()
     if args.mode == "closed":
-        plan = [(args.seed + 1 + i,
-                 int(size_rng.randint(lo, hi + 1)))
-                for i in range(args.requests)]
+        plan = [draw_params(i) for i in range(args.requests)]
         cursor = {"i": 0}
         cursor_lock = threading.Lock()
 
@@ -185,10 +312,9 @@ def main(argv=None):
                 with cursor_lock:
                     if cursor["i"] >= len(plan):
                         return
-                    seed, n = plan[cursor["i"]]
+                    entry = plan[cursor["i"]]
                     cursor["i"] += 1
-                _one_request(url, key, seed, shape, n, args.dtype,
-                             args.timeout_ms, stats)
+                fire(entry)
 
         threads = [threading.Thread(target=worker, daemon=True)
                    for _ in range(max(args.concurrency, 1))]
@@ -209,12 +335,8 @@ def main(argv=None):
             if now < next_t:
                 time.sleep(min(next_t - now, 0.01))
                 continue
-            n = int(size_rng.randint(lo, hi + 1))
-            t = threading.Thread(
-                target=_one_request,
-                args=(url, key, args.seed + 1 + i, shape, n,
-                      args.dtype, args.timeout_ms, stats),
-                daemon=True)
+            t = threading.Thread(target=fire, args=(draw_params(i),),
+                                 daemon=True)
             t.start()
             threads.append(t)
             i += 1
@@ -263,6 +385,32 @@ def main(argv=None):
         "errors_sample": stats.errors[:5],
         "scrape": scraped or None,
     }
+    if args.decode:
+        ttft = sorted(stats.ttft)
+        tpot = sorted(stats.tpot)
+        occ = scraped.get("hvd_serving_decode_slot_occupancy")
+        shed = scraped.get("hvd_serving_decode_evictions_total:shed",
+                           0.0)
+        report.update({
+            "metric": "decode_tokens_per_sec",
+            "value": round(stats.tokens / wall_s, 2) if wall_s else 0.0,
+            "unit": "tokens/sec",
+            "tokens_generated": stats.tokens,
+            "ttft_ms": {
+                "p50": round(percentile(ttft, 0.50) * 1e3, 3),
+                "p95": round(percentile(ttft, 0.95) * 1e3, 3),
+                "p99": round(percentile(ttft, 0.99) * 1e3, 3),
+            },
+            "tpot_ms": {
+                "p50": round(percentile(tpot, 0.50) * 1e3, 3),
+                "p95": round(percentile(tpot, 0.95) * 1e3, 3),
+                "p99": round(percentile(tpot, 0.99) * 1e3, 3),
+            },
+            "slot_occupancy_last": occ,
+            "shed_rate": (
+                round(shed / (n_ok + n_err), 4)
+                if (n_ok + n_err) and shed else 0.0),
+        })
     print(json.dumps(report))
     if args.out:
         with open(args.out, "w") as f:
@@ -279,7 +427,19 @@ def main(argv=None):
         if n_ok and not all(
                 report["latency_ms"][q] > 0 for q in ("p50", "p95", "p99")):
             failures.append("latency percentiles not all nonzero")
-        if args.scrape:
+        if args.decode:
+            if stats.tokens == 0:
+                failures.append("no tokens generated")
+            if n_ok and not all(
+                    report["ttft_ms"][q] > 0
+                    for q in ("p50", "p95", "p99")):
+                failures.append("TTFT percentiles not all nonzero")
+            if args.scrape and not scraped.get(
+                    "hvd_serving_decode_tokens_total"):
+                failures.append(
+                    "no hvd_serving_decode_tokens_total scraped "
+                    "(decode metrics dead or metrics off)")
+        elif args.scrape:
             if not fill_count:
                 failures.append(
                     "no hvd_serving_batch_fill_ratio samples scraped "
@@ -290,9 +450,15 @@ def main(argv=None):
             print(f"serving check FAILED: {msg}")
         if failures:
             return 1
-        print(f"serving check OK: {n_ok} requests, "
-              f"p50 {report['latency_ms']['p50']} ms, "
-              f"fill {report['batch_fill_ratio_mean']}")
+        if args.decode:
+            print(f"serving check OK: {n_ok} requests, "
+                  f"{report['value']} tokens/sec, "
+                  f"TTFT p50 {report['ttft_ms']['p50']} ms, "
+                  f"TPOT p50 {report['tpot_ms']['p50']} ms")
+        else:
+            print(f"serving check OK: {n_ok} requests, "
+                  f"p50 {report['latency_ms']['p50']} ms, "
+                  f"fill {report['batch_fill_ratio_mean']}")
     return 0
 
 

@@ -1,0 +1,518 @@
+"""Continuous-batching autoregressive generation (serving/decode.py +
+serving/scheduler.py, docs/generation.md).
+
+The scheduler tests run under a FAKE clock with manual ``step_once``
+driving — no background thread, no sleeps, fully deterministic:
+admission into freed slots mid-batch, deadline eviction that leaves
+co-resident sequences bitwise-undisturbed, greedy parity between
+continuous batching and the one-at-a-time reference (fp32 KV), the
+int8-KV tolerance bound, and SLO-class shedding order.
+"""
+
+import json
+import pathlib
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_REPO_ROOT))
+
+from horovod_tpu.serving.batcher import (  # noqa: E402
+    Draining,
+    QueueFull,
+    RequestTimeout,
+)
+from horovod_tpu.serving.decode import (  # noqa: E402
+    GenerationEngine,
+    KVCacheSpec,
+    config_from_meta,
+    config_to_meta,
+    default_prefill_buckets,
+    parse_decode_buckets,
+    parse_kv_dtype,
+)
+from horovod_tpu.serving.scheduler import DecodeScheduler  # noqa: E402
+
+VOCAB = 61
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, num_layers=2, num_heads=2, hidden_size=16,
+        max_seq_len=32, dtype=jnp.float32)
+    mod = Transformer(cfg)
+    params = mod.init(jax.random.PRNGKey(0),
+                      jnp.ones((1, 4), jnp.int32))["params"]
+    return cfg, mod, params
+
+
+@pytest.fixture(scope="module")
+def _shared_engine(tiny_lm):
+    _, mod, params = tiny_lm
+    eng = GenerationEngine(mod, params, slots=2, max_len=24,
+                           prefill_buckets=(8,), kv_dtype="fp32")
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture
+def engine(_shared_engine):
+    """The module engine with slot bookkeeping restored afterwards, so
+    one failing test can't leak claimed slots into the next."""
+    yield _shared_engine
+    with _shared_engine._slot_lock:
+        _shared_engine._free = list(range(_shared_engine.spec.slots))
+
+
+def _make_sched(engine, clock, **kw):
+    kw.setdefault("queue_limit", 16)
+    kw.setdefault("default_timeout_s", 1000.0)
+    kw.setdefault("default_max_new", 6)
+    kw.setdefault("stats_every", 0)
+    return DecodeScheduler(engine, clock=clock, **kw)
+
+
+def _run_alone(engine, prompt, max_new):
+    """One-at-a-time reference through the SAME compiled programs."""
+    clock = FakeClock()
+    s = _make_sched(engine, clock)
+    r = s.submit(prompt, max_new_tokens=max_new)
+    for _ in range(3 * max_new + 8):
+        if r.done:
+            break
+        s.step_once()
+    toks, reason = r.result(1.0)
+    return toks, reason
+
+
+# ---------------------------------------------------------------------------
+# parsing / spec units
+# ---------------------------------------------------------------------------
+
+def test_kv_dtype_and_bucket_parsing():
+    assert parse_kv_dtype("fp32") == "fp32"
+    assert parse_kv_dtype("bfloat16") == "bf16"
+    assert parse_kv_dtype("INT8") == "int8"
+    with pytest.raises(ValueError, match="KV cache dtype"):
+        parse_kv_dtype("fp8")
+    assert parse_decode_buckets("4x128,2x64") == ((2, 64), (4, 128))
+    with pytest.raises(ValueError, match="decode bucket"):
+        parse_decode_buckets("4y128")
+    assert default_prefill_buckets(48) == (8, 16, 32, 48)
+
+
+def test_kv_cache_spec_layout_and_quant_bytes():
+    spec = KVCacheSpec(slots=4, layers=2, kv_heads=2, max_len=16,
+                       head_dim=8, dtype="fp32")
+    assert spec.shape == (4, 2, 2, 16, 8)
+    fp32_bytes = spec.nbytes()
+    q = KVCacheSpec(slots=4, layers=2, kv_heads=2, max_len=16,
+                    head_dim=8, dtype="int8", block=8)
+    # int8 codes + one f32 scale per 8-element block: ~2x smaller than
+    # fp32 here (4x on payload, scales cost 1 f32 per 8 bytes)
+    assert q.nbytes() < fp32_bytes / 2 + 1
+    structs = q.buffer_structs()
+    assert set(structs) == {"k", "v", "k_scale", "v_scale"}
+    assert structs["k_scale"].shape == (4, 2, 2, 16, 1)
+    # block not dividing head_dim falls back to per-row scales
+    odd = KVCacheSpec(slots=1, layers=1, kv_heads=1, max_len=4,
+                      head_dim=6, dtype="int8", block=4)
+    assert odd.resolved_block == 6
+
+
+def test_config_meta_roundtrip(tiny_lm):
+    cfg, _, _ = tiny_lm
+    meta = config_to_meta(cfg)
+    json.dumps(meta)  # must be JSON-safe for checkpoint metadata
+    cfg2 = config_from_meta(meta)
+    assert cfg2 == cfg
+
+
+# ---------------------------------------------------------------------------
+# engine: cache-carrying apply path vs the full forward pass
+# ---------------------------------------------------------------------------
+
+def test_engine_greedy_matches_full_forward_reference(tiny_lm, engine):
+    import jax.numpy as jnp
+
+    _, mod, params = tiny_lm
+    prompt = [5, 17, 3, 44]
+
+    def full_forward_greedy(n_new):
+        toks = list(prompt)
+        for _ in range(n_new):
+            lg = mod.apply({"params": params},
+                           jnp.asarray([toks], jnp.int32))
+            toks.append(int(jnp.argmax(lg[0, -1])))
+        return toks[len(prompt):]
+
+    slot = engine.claim_slot()
+    first, _ = engine.prefill(slot, prompt)
+    out = [first]
+    t = np.zeros(engine.slots, np.int32)
+    ln = np.zeros(engine.slots, np.int32)
+    t[slot] = first
+    ln[slot] = len(prompt)
+    for _ in range(5):
+        nxt, _ = engine.decode(t, ln)
+        out.append(int(nxt[slot]))
+        t[slot] = nxt[slot]
+        ln[slot] += 1
+    engine.release_slot(slot)
+    assert out == full_forward_greedy(6)
+
+
+def test_engine_rope_gqa_variant_matches_full_forward():
+    """The decode path must also hold for rope positions (absolute
+    offsets into the rotary tables) and grouped-query attention."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import (Transformer,
+                                                TransformerConfig)
+
+    cfg = TransformerConfig(
+        vocab_size=VOCAB, num_layers=2, num_heads=4, num_kv_heads=2,
+        hidden_size=32, max_seq_len=32, dtype=jnp.float32,
+        norm="rmsnorm", position="rope", activation="swiglu",
+        tie_embeddings=False)
+    mod = Transformer(cfg)
+    params = mod.init(jax.random.PRNGKey(1),
+                      jnp.ones((1, 4), jnp.int32))["params"]
+    eng = GenerationEngine(mod, params, slots=2, max_len=24,
+                           prefill_buckets=(8,), kv_dtype="fp32")
+    prompt = [9, 2, 33]
+    slot = eng.claim_slot()
+    first, _ = eng.prefill(slot, prompt)
+    out = [first]
+    t = np.zeros(2, np.int32)
+    ln = np.zeros(2, np.int32)
+    t[slot], ln[slot] = first, len(prompt)
+    for _ in range(4):
+        nxt, _ = eng.decode(t, ln)
+        out.append(int(nxt[slot]))
+        t[slot] = nxt[slot]
+        ln[slot] += 1
+
+    toks = list(prompt)
+    for _ in range(5):
+        lg = mod.apply({"params": params},
+                       jnp.asarray([toks], jnp.int32))
+        toks.append(int(jnp.argmax(lg[0, -1])))
+    assert out == toks[len(prompt):]
+
+
+def test_engine_serves_remat_trained_config(tiny_lm):
+    """remat trades activation memory for backward recompute; the
+    engine must force it off (inference has no backward, and nn.remat
+    cannot carry the cache object) so remat-trained checkpoints still
+    serve — and with identical numerics (remat never changes math)."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import Transformer
+
+    cfg, _, params = tiny_lm
+    remat_model = Transformer(dc.replace(cfg, remat=True))
+    eng = GenerationEngine(remat_model, params, slots=2, max_len=24,
+                           prefill_buckets=(8,), kv_dtype="fp32")
+    assert eng.cfg.remat is False
+    prompt = [5, 17, 3]
+    slot = eng.claim_slot()
+    first, _ = eng.prefill(slot, prompt)
+    toks = list(prompt) + [first]
+    ref_model = Transformer(cfg)
+    lg = ref_model.apply({"params": params},
+                         jnp.asarray([list(prompt)], jnp.int32))
+    assert first == int(jnp.argmax(lg[0, -1]))
+
+
+def test_engine_rejects_unservable_prompts(engine):
+    with pytest.raises(ValueError, match="no room to generate"):
+        engine.prefill(0, list(range(1, 25)))  # == max_len
+    with pytest.raises(ValueError, match="prefill bucket"):
+        engine.prefill_bucket_for(9)  # top bucket is 8
+
+
+# ---------------------------------------------------------------------------
+# scheduler invariants (fake clock, manual stepping)
+# ---------------------------------------------------------------------------
+
+def test_admission_into_freed_slots_mid_batch(engine):
+    """With both slots busy, a queued request must enter the iteration
+    after a slot frees — no batch restart, co-residents untouched."""
+    clock = FakeClock()
+    s = _make_sched(engine, clock)
+    # one step_once = admit+prefill (first token) AND one decode
+    # iteration, so max_new=3 finishes on its second iteration
+    a = s.submit([1, 2, 3], max_new_tokens=3)   # finishes fast
+    b = s.submit([4, 5, 6], max_new_tokens=10)  # keeps its slot
+    c = s.submit([7, 8], max_new_tokens=3)      # queued: slots full
+    s.step_once()  # a+b admitted (prefill+decode), c waits
+    assert s.slot_stats() == {"total": 2, "occupied": 2,
+                              "queued_prefills": 1}
+    s.step_once()  # a reaches 3 tokens -> finishes, frees its slot
+    assert a.done and a.finish_reason == "length"
+    assert s.slot_stats()["occupied"] == 1
+    s.step_once()  # c admitted into a's old slot, b still resident
+    assert s.slot_stats()["occupied"] == 2
+    assert s.slot_stats()["queued_prefills"] == 0
+    for _ in range(12):
+        if b.done and c.done:
+            break
+        s.step_once()
+    assert b.result(1.0)[0] == _run_alone(engine, [4, 5, 6], 10)[0]
+    assert c.result(1.0)[0] == _run_alone(engine, [7, 8], 3)[0]
+
+
+def test_deadline_eviction_leaves_coresident_undisturbed(engine):
+    """A sequence evicted at its deadline mid-generation ends with
+    partial output (finish_reason="deadline"); the co-resident
+    sequence's tokens are bitwise what it produces running alone."""
+    clock = FakeClock()
+    s = _make_sched(engine, clock)
+    doomed = s.submit([1, 2, 3], max_new_tokens=12, timeout_s=5.0)
+    keeper = s.submit([4, 5, 6], max_new_tokens=8, timeout_s=1000.0)
+    for _ in range(3):
+        s.step_once()
+    assert not doomed.done
+    clock.advance(10.0)  # doomed's deadline passes mid-generation
+    s.step_once()
+    assert doomed.done
+    toks, reason = doomed.result(1.0)
+    assert reason == "deadline"
+    assert 0 < len(toks) < 12  # partial output, not dropped
+    for _ in range(10):
+        if keeper.done:
+            break
+        s.step_once()
+    assert keeper.result(1.0)[0] == _run_alone(engine, [4, 5, 6], 8)[0]
+    # the freed slot is reusable immediately
+    again = s.submit([9, 9], max_new_tokens=2)
+    s.step_once()
+    s.step_once()
+    assert again.done
+
+
+def test_queued_deadline_expiry_is_timeout_not_slot_waste(engine):
+    clock = FakeClock()
+    s = _make_sched(engine, clock)
+    a = s.submit([1, 2, 3], max_new_tokens=20, timeout_s=1000.0)
+    b = s.submit([4, 5], max_new_tokens=20, timeout_s=1000.0)
+    s.step_once()  # a+b take both slots
+    # queued behind a full batch with a deadline it cannot make
+    stale = s.submit([6, 7], max_new_tokens=5, timeout_s=2.0)
+    clock.advance(5.0)
+    s.step_once()
+    with pytest.raises(RequestTimeout, match="decode admission queue"):
+        stale.result(0.1)
+    assert not a.done and not b.done
+
+
+def test_continuous_matches_one_at_a_time_bitwise(engine):
+    """Greedy fp32-KV parity: mixed-length requests streamed through
+    the continuous batch equal the one-at-a-time reference."""
+    rng = np.random.RandomState(3)
+    reqs = [(rng.randint(1, VOCAB - 1,
+                         size=int(rng.randint(2, 7))).tolist(),
+             int(rng.randint(2, 9))) for _ in range(6)]
+    clock = FakeClock()
+    s = _make_sched(engine, clock, queue_limit=16)
+    pendings = [s.submit(p, max_new_tokens=mn) for p, mn in reqs]
+    for _ in range(200):
+        if all(p.done for p in pendings):
+            break
+        s.step_once()
+    outs = [p.result(1.0)[0] for p in pendings]
+    for (prompt, mn), got in zip(reqs, outs):
+        assert got == _run_alone(engine, prompt, mn)[0]
+
+
+def test_int8_kv_within_documented_tolerance(tiny_lm):
+    """Teacher-forced decode logits on the int8 cache stay within the
+    documented bound of the fp32 reference (docs/generation.md), and
+    the cache buffers really are int8."""
+    import jax.numpy as jnp
+
+    _, mod, params = tiny_lm
+    eng8 = GenerationEngine(mod, params, slots=2, max_len=24,
+                            prefill_buckets=(8,), kv_dtype="int8")
+    engf = GenerationEngine(mod, params, slots=2, max_len=24,
+                            prefill_buckets=(8,), kv_dtype="fp32")
+    assert eng8._cache["k"].dtype == jnp.int8
+    assert "k_scale" in eng8._cache
+    prompt = [5, 17, 3, 44]
+    s8, sf = eng8.claim_slot(), engf.claim_slot()
+    f8, l8 = eng8.prefill(s8, prompt)
+    ff, lf = engf.prefill(sf, prompt)
+    # prefill attends over its local fp32 cache on both engines
+    np.testing.assert_allclose(l8, lf, atol=1e-6)
+    worst = 0.0
+    drive = ff
+    t8 = np.zeros(2, np.int32)
+    tf = np.zeros(2, np.int32)
+    n8 = np.zeros(2, np.int32)
+    nf = np.zeros(2, np.int32)
+    n8[s8] = nf[sf] = len(prompt)
+    for _ in range(8):
+        t8[s8] = tf[sf] = drive
+        _, lg8 = eng8.decode(t8, n8, return_logits=True)
+        nxf, lgf = engf.decode(tf, nf, return_logits=True)
+        worst = max(worst, float(np.abs(lg8[s8] - lgf[sf]).max()))
+        drive = int(nxf[sf])
+        n8[s8] += 1
+        nf[sf] += 1
+    assert worst < 0.1, f"int8 KV drift {worst} out of tolerance"
+
+
+def test_slo_class_shedding_order(engine):
+    """Queue at capacity: an arriving higher-SLO request sheds the
+    NEWEST strictly-lower-class queued request; equal-or-better
+    classes are never shed (429 instead)."""
+    clock = FakeClock()
+    s = _make_sched(engine, clock, queue_limit=3)
+    occ = [s.submit([1, 2], max_new_tokens=20),
+           s.submit([2, 3], max_new_tokens=20)]
+    s.step_once()  # both slots busy; queue empties
+    q_std = s.submit([3, 4], slo="standard")
+    q_b1 = s.submit([4, 5], slo="batch")
+    q_b2 = s.submit([5, 6], slo="batch")
+    # batch arriving at a full queue with no lower class queued: 429
+    with pytest.raises(QueueFull, match="at capacity"):
+        s.submit([6, 7], slo="batch")
+    # interactive sheds the NEWEST batch request, not the standard one
+    q_int = s.submit([7, 8], slo="interactive")
+    assert q_b2.done and not q_b1.done and not q_std.done
+    with pytest.raises(QueueFull, match="shed for an arriving"):
+        q_b2.result(0.1)
+    # admission order once a slot frees: interactive first
+    occ[0].deadline_t = -1.0  # force-evict an occupier
+    s.step_once()
+    active = {r.seq for r in s._active.values()}
+    assert q_int.seq in active, "interactive must be admitted first"
+
+
+def test_drain_contract(engine):
+    clock = FakeClock()
+    s = _make_sched(engine, clock)
+    r = s.submit([1, 2, 3], max_new_tokens=3)
+    s.close(drain=True, timeout_s=30.0)
+    assert r.done and r.finish_reason == "length"
+    with pytest.raises(Draining):
+        s.submit([4, 5])
+
+
+# ---------------------------------------------------------------------------
+# /healthz slots body + streaming route (the probe/server contract)
+# ---------------------------------------------------------------------------
+
+def test_healthz_slots_distinguishes_full_from_wedged(engine):
+    """The replica /healthz body carries slots{total, occupied,
+    queued_prefills} next to queued/inflight/bucket_cache, so a probe
+    can tell a saturated-but-moving replica from a wedged one."""
+    from horovod_tpu.serving.server import ServingServer
+
+    clock = FakeClock()
+    s = _make_sched(engine, clock)
+
+    def generate_local(req, timeout_s):
+        p = s.submit(req["prompt"],
+                     max_new_tokens=req.get("max_new_tokens"),
+                     timeout_s=timeout_s,
+                     slo=req.get("slo", "standard"))
+        return p.stream(timeout_s=30.0)
+
+    srv = ServingServer(
+        generate_fn=generate_local,
+        health_extra=lambda: {"slots": s.slot_stats(),
+                              "queued": s.pending,
+                              "bucket_cache": engine.cached_executables})
+    port = srv.start()
+    try:
+        # idle: all slots free
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5.0) as r:
+            h = json.loads(r.read())
+        assert h["slots"] == {"total": 2, "occupied": 0,
+                              "queued_prefills": 0}
+        assert h["bucket_cache"] >= 1
+        # saturate: both slots + one queued, visible through the probe
+        s.submit([1, 2], max_new_tokens=20)
+        s.submit([2, 3], max_new_tokens=20)
+        s.submit([3, 4], max_new_tokens=20)
+        s.step_once()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5.0) as r:
+            h = json.loads(r.read())
+        assert h["slots"] == {"total": 2, "occupied": 2,
+                              "queued_prefills": 1}
+        assert h["status"] == "ok"  # full != wedged
+    finally:
+        srv.shutdown()
+        s.close(drain=False)
+
+
+def test_generate_stream_http_roundtrip(engine):
+    """Streaming /v1/generate: chunked line-delimited tokens, the
+    request id echoed, and the non-stream body equal to the collected
+    stream."""
+    from horovod_tpu.serving.server import ServingServer
+
+    clock = FakeClock()
+    s = _make_sched(engine, clock).start()
+
+    def generate_local(req, timeout_s):
+        p = s.submit(req["prompt"],
+                     max_new_tokens=req.get("max_new_tokens"),
+                     timeout_s=timeout_s)
+        return p.stream(timeout_s=30.0)
+
+    srv = ServingServer(generate_fn=generate_local)
+    port = srv.start()
+    try:
+        body = json.dumps({"prompt": [5, 17, 3], "max_new_tokens": 4,
+                           "stream": True}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate", data=body,
+            method="POST", headers={"X-Request-Id": "gen-test-1"})
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            assert resp.headers.get("X-Request-Id") == "gen-test-1"
+            chunks = [json.loads(ln) for ln in resp if ln.strip()]
+        assert chunks[-1]["done"]
+        assert chunks[-1]["finish_reason"] == "length"
+        streamed = [t for c in chunks for t in c.get("tokens", ())]
+        assert len(streamed) == chunks[-1]["n"] == 4
+
+        body2 = json.dumps({"prompt": [5, 17, 3],
+                            "max_new_tokens": 4}).encode()
+        req2 = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate", data=body2,
+            method="POST")
+        with urllib.request.urlopen(req2, timeout=30.0) as resp:
+            payload = json.loads(resp.read())
+        assert payload["tokens"] == streamed
+    finally:
+        srv.shutdown()
+        s.close(drain=False)

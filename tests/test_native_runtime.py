@@ -845,7 +845,7 @@ def test_deregistered_set_fails_stranded_tensors():
 # must surface as a consistent error on every survivor, never a hang)
 
 
-def _worker_crash(rank, size, port, victim, q):
+def _worker_crash(rank, size, port, victim, q, barrier):
     import os
     import signal
 
@@ -859,9 +859,29 @@ def _worker_crash(rank, size, port, victim, q):
         q.put((rank, "warm-failed", rt.last_error()))
         rt.shutdown()
         return
+    # every rank must see its OWN warm complete before the victim
+    # dies: rank 0's DONE only proves the coordinator got ITS result —
+    # a coordinator victim SIGKILLing itself here could still beat the
+    # workers' warm responses onto the wire, and their warm (not the
+    # post-crash op this test is about) would fail. Out-of-band
+    # barrier, because any in-band sync has the same race.
+    try:
+        barrier.wait(timeout=30.0)
+    except Exception:
+        q.put((rank, "warm-barrier-failed", rt.last_error()))
+        return
     if rank == victim:
         os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, mid-world
-    h2 = rt.enqueue("after", native.OP_ALLREDUCE, "float32", [4])
+    # the post-crash op can surface the death at either API point:
+    # enqueue itself raising "lost connection" (the background loop
+    # already observed the dead transport) or a successful enqueue
+    # whose handle polls FAILED. Both are the non-hang contract this
+    # test asserts; which one a survivor sees is a pure timing race.
+    try:
+        h2 = rt.enqueue("after", native.OP_ALLREDUCE, "float32", [4])
+    except RuntimeError as e:
+        q.put((rank, rt_mod_FAILED, str(e)))
+        return
     deadline = time.time() + 45.0
     state = rt.poll(h2)
     while state in (0, 1) and time.time() < deadline:
@@ -878,8 +898,10 @@ def _run_crash_world(size, victim, timeout_s=90.0):
     port = _free_port()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
+    barrier = ctx.Barrier(size)
     procs = [
-        ctx.Process(target=_worker_crash, args=(r, size, port, victim, q))
+        ctx.Process(target=_worker_crash,
+                    args=(r, size, port, victim, q, barrier))
         for r in range(size)
     ]
     for p in procs:
