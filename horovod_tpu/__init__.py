@@ -131,6 +131,7 @@ from .utils import prof  # noqa: F401  (hvd.prof.set_step_flops, summary)
 from .checkpoint import LoadedModel, load_model, save_model  # noqa: F401
 from . import data  # noqa: F401
 from . import elastic  # noqa: F401
+from . import multipod  # noqa: F401  (hvd.multipod.pod_topology, LocalSGD)
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401
 
 

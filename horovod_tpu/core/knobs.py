@@ -218,6 +218,30 @@ class Knobs:
     retry_max_attempts: int = 5
     retry_base_delay_seconds: float = 0.1
     retry_max_delay_seconds: float = 2.0
+    # "full" (default): AWS-style full jitter — a fleet reconnecting
+    # after a rendezvous failover spreads uniformly over the backoff
+    # window instead of retrying in ±25% lockstep waves. "bounded"
+    # restores the historical symmetric band.
+    retry_jitter: str = "full"
+    # shared cap on TOTAL elapsed retry time per call, applied even to
+    # deadline-less call sites; <=0 disables
+    retry_max_elapsed_seconds: float = 60.0
+
+    # --- multi-pod federation (multipod/, docs/multipod.md) ---
+    # pod count; 0/1 = single pod (no federation — every path below is
+    # knob-free and identical to the pre-multipod world)
+    multipod_pods: int = 0
+    # cross-pod sync discipline: "sync" (every step spans the world) or
+    # "localK" (e.g. "local8": K pod-local steps between cross-pod
+    # parameter averages over DCN). K<=1 normalizes to sync, which is
+    # what makes the K=1 parity guarantee bitwise (multipod/localsgd.py)
+    multipod_sync: str = "sync"
+    # outer-loop step size / momentum on the averaged update (SlowMo
+    # family); defaults = plain parameter averaging
+    multipod_outer_lr: float = 1.0
+    multipod_outer_momentum: float = 0.0
+    # worst-case DCN hops between pods (scaling-projection input)
+    multipod_dcn_hops: int = 1
 
     # --- process sets ---
     dynamic_process_sets: bool = False
@@ -393,6 +417,17 @@ class Knobs:
             retry_max_attempts=_env_int("RETRY_MAX_ATTEMPTS", 5),
             retry_base_delay_seconds=_env_float("RETRY_BASE_DELAY", 0.1),
             retry_max_delay_seconds=_env_float("RETRY_MAX_DELAY", 2.0),
+            retry_jitter=_env("RETRY_JITTER", "full") or "full",
+            retry_max_elapsed_seconds=_env_float(
+                "RETRY_MAX_ELAPSED", 60.0
+            ),
+            multipod_pods=_env_int("MULTIPOD_PODS", 0),
+            multipod_sync=_env("MULTIPOD_SYNC", "") or "sync",
+            multipod_outer_lr=_env_float("MULTIPOD_OUTER_LR", 1.0),
+            multipod_outer_momentum=_env_float(
+                "MULTIPOD_OUTER_MOMENTUM", 0.0
+            ),
+            multipod_dcn_hops=_env_int("MULTIPOD_DCN_HOPS", 1),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
             native_eager=_env_bool("NATIVE", False),
             eager_fast_path=_env_bool("EAGER_FAST_PATH", True),

@@ -197,6 +197,24 @@ def add_process_set(ranks_or_set) -> ProcessSet:
     return ps
 
 
+def add_or_get_process_set(ranks: Sequence[int]) -> ProcessSet:
+    """Idempotent registration: return the existing set with exactly
+    these ranks, or register a new one. The pod topology
+    (multipod/topology.py) resolves its per-pod set through this, so
+    repeated ``PodTopology.process_set()`` calls — one per subsystem
+    consuming the pod view — share one registration instead of
+    tripping the duplicate-ranks error."""
+    st = global_state()
+    if st.process_set_table is None:
+        raise ProcessSetError("horovod_tpu is not initialized")
+    want = sorted(int(r) for r in ranks)
+    for pid in st.process_set_table.ids():
+        ps = st.process_set_table.get(pid)
+        if ps.ranks == want:
+            return ps
+    return add_process_set(want)
+
+
 def remove_process_set(ps_or_id) -> None:
     """Unregister (reference: process_sets.py:147)."""
     st = global_state()

@@ -186,10 +186,17 @@ class Replicator:
     def __init__(self, rank: int, size: int, partners: Sequence[int],
                  rendezvous: Tuple[str, int],
                  chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                 duty_cycle: float = DEFAULT_DUTY_CYCLE):
+                 duty_cycle: float = DEFAULT_DUTY_CYCLE,
+                 push: Optional[Tuple[str, int]] = None):
         self.rank = int(rank)
         self.size = int(size)
         self.partners = list(partners)
+        # where control-plane WRITES (the manifest mirror) go: the pod
+        # relay when one is configured (multipod/relay.py) — it batches
+        # the pod's manifests into one upward PUT — else the root.
+        # Reads (partner store lookups) always go to the root, which
+        # holds the cluster-global view.
+        self._push = push or rendezvous
         self.chunk_bytes = max(int(chunk_bytes), 1024)
         # adaptive rate control: after a ship that took T seconds the
         # thread idles >= T*(1/d - 1) before the next one, bounding
@@ -401,7 +408,7 @@ class Replicator:
             self.stats["last_epoch"] = epoch
             manifest["holders"] = shipped
             try:
-                addr, port = self._rendezvous
+                addr, port = self._push
                 self._policy.call(
                     _http_put, addr, port, MANIFEST_SCOPE,
                     f"rank_{self.rank}", json.dumps(manifest).encode(),
@@ -781,9 +788,19 @@ def configure(
                               DEFAULT_DUTY_CYCLE))
     stop()  # idempotent re-init (elastic _reinitialize path)
     _store = ReplicaStore(backing=_backing)
+    # registration + manifest mirrors are WRITES: route them through
+    # the pod relay when one is configured so the root sees one batched
+    # PUT per pod instead of one per host (multipod/relay.py). Reads
+    # (fetch_replica, partner lookups) stay on the root.
+    try:
+        from ..multipod.relay import push_endpoint
+
+        push_ep = push_endpoint(root=rdv) or rdv
+    except Exception:
+        push_ep = rdv
     try:
         _http_put(
-            rdv[0], rdv[1], STORE_SCOPE, f"rank_{my_rank}",
+            push_ep[0], push_ep[1], STORE_SCOPE, f"rank_{my_rank}",
             json.dumps(_store.addresses()).encode(),
         )
     except Exception as e:
@@ -794,7 +811,7 @@ def configure(
         )
     _replicator = Replicator(
         my_rank, world, ring_partners(my_rank, world, k), rdv,
-        chunk_bytes=chunk, duty_cycle=duty,
+        chunk_bytes=chunk, duty_cycle=duty, push=push_ep,
     )
     _configured = True
     _enabled = True

@@ -1,0 +1,38 @@
+"""Multi-pod federation: pod-sharded control plane + DCN outer loop.
+
+Everything below this package assumed one pod-slice and one rendezvous
+KV server. This subsystem scales both stories to N pods
+(docs/multipod.md):
+
+* :mod:`~horovod_tpu.multipod.topology` — the pod descriptor
+  (pod_id, member ranks, DCN hop count) derived from env/mesh, the
+  pod-aware view every other layer consumes, integrated with
+  `core/process_sets.py`;
+* :mod:`~horovod_tpu.multipod.relay` — per-pod relay servers that
+  batch and forward pod-local control-plane pushes (metrics, flight
+  dumps, replication manifests, serving registrations) to the root
+  rendezvous server, so the root sees O(pods) connections instead of
+  O(hosts);
+* :mod:`~horovod_tpu.multipod.localsgd` — the opt-in local-SGD outer
+  loop (``HOROVOD_MULTIPOD_SYNC=localK``): each pod runs K local steps
+  on the existing SPMD path and periodically averages parameters
+  cross-pod over the quantized DCN leg, with outer momentum and a
+  bitwise-parity guarantee at K=1 versus the plain path.
+"""
+
+from .localsgd import (  # noqa: F401
+    LocalSGD,
+    OuterState,
+    local_sgd_active,
+    parse_sync_mode,
+)
+from .relay import (  # noqa: F401
+    PodRelayServer,
+    push_endpoint,
+    relay_endpoint_from_env,
+)
+from .topology import (  # noqa: F401
+    PodTopology,
+    pod_topology,
+    pod_topology_from_env,
+)

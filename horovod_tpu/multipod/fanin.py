@@ -1,0 +1,132 @@
+"""Shared direct-vs-relayed control-plane fan-in harness.
+
+One implementation of the "simulated fleet pushing expositions"
+measurement both `scripts/multipod_check.py` (the gate) and
+`scripts/control_plane_scaling.py --pods` (the bench) consume —
+threads simulate hosts on this box, pods are relay servers, the
+scoreboard is the root KVStoreServer's request count.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Tuple
+
+from ..runner.http.http_server import KVStoreServer
+from ..utils.metrics import METRICS_PUSH_SCOPE
+from .relay import PodRelayServer
+
+
+def put_with_retry(addr: str, port: int, path: str, body: bytes,
+                   attempts: int = 5) -> None:
+    """One PUT with a small retry ladder: a contended 1-core server
+    resets some connections under a burst, and losing pushes would
+    flatter the direct-mode request count."""
+    req = urllib.request.Request(
+        f"http://{addr}:{port}/{path}", data=body, method="PUT")
+    for attempt in range(attempts):
+        try:
+            with urllib.request.urlopen(req, timeout=5.0):
+                return
+        except OSError:
+            if attempt == attempts - 1:
+                raise
+            time.sleep(0.02 * (attempt + 1))
+
+
+def _exposition_body(i: int) -> bytes:
+    return (
+        "# HELP hvd_steps_total steps\n"
+        "# TYPE hvd_steps_total counter\n"
+        f"hvd_steps_total {i + 1}\n"
+    ).encode()
+
+
+def _fleet_push(targets: List[Tuple[str, int]], n_pods: int,
+                hosts_per_pod: int, pushes_per_host: int) -> float:
+    """Every simulated host pushes its expositions at its pod's
+    target; returns the fleet's push wall time."""
+    def host(pod: int, h: int) -> None:
+        rank = pod * hosts_per_pod + h
+        addr, port = targets[pod]
+        for i in range(pushes_per_host):
+            put_with_retry(
+                addr, port, f"{METRICS_PUSH_SCOPE}/{rank}",
+                _exposition_body(i))
+
+    threads = [
+        threading.Thread(target=host, args=(p, h))
+        for p in range(n_pods) for h in range(hosts_per_pod)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0
+
+
+def measure_fanin(n_pods: int, hosts_per_pod: int,
+                  pushes_per_host: int = 10,
+                  flush_interval_s: float = 0.05,
+                  settle_timeout_s: float = 20.0) -> Dict:
+    """Run the fleet twice — direct to the root, then through per-pod
+    relays — and return the raw scoreboard: root request counts, push
+    wall times, per-pod relay stats, and the root's pushed
+    metrics_push scope after the relayed run (for exposition checks).
+    """
+    # direct mode: every host hits the root
+    root = KVStoreServer()
+    rport = root.start_server()
+    direct_s = _fleet_push([("127.0.0.1", rport)] * n_pods, n_pods,
+                           hosts_per_pod, pushes_per_host)
+    direct_requests = root.request_count
+    root.shutdown_server()
+
+    # relayed mode: hosts hit their pod relay, relays batch upward
+    root = KVStoreServer()
+    rport = root.start_server()
+    relays = [
+        PodRelayServer(f"pod{p}", ("127.0.0.1", rport),
+                       flush_interval_s=flush_interval_s)
+        for p in range(n_pods)
+    ]
+    targets = [("127.0.0.1", r.start_server()) for r in relays]
+    relayed_s = _fleet_push(targets, n_pods, hosts_per_pod,
+                            pushes_per_host)
+    deadline = time.time() + settle_timeout_s
+    want = n_pods * hosts_per_pod
+    while time.time() < deadline:
+        with root.lock:
+            if len(root.store.get(METRICS_PUSH_SCOPE, {})) >= want:
+                break
+        time.sleep(0.05)
+    relayed_requests = root.request_count
+    with root.lock:
+        pushed = dict(root.store.get(METRICS_PUSH_SCOPE, {}))
+    per_pod = [dict(pod=f"pod{p}", **relays[p].stats())
+               for p in range(n_pods)]
+    for r in relays:
+        r.shutdown_server()
+    root.shutdown_server()
+
+    return {
+        "pods": n_pods,
+        "hosts": n_pods * hosts_per_pod,
+        "pushes_per_host": pushes_per_host,
+        "direct": {
+            "root_requests": direct_requests,
+            "push_wall_s": round(direct_s, 3),
+        },
+        "relayed": {
+            "root_requests": relayed_requests,
+            "push_wall_s": round(relayed_s, 3),
+            "per_pod_relays": per_pod,
+        },
+        "root_request_reduction_x": round(
+            direct_requests / max(relayed_requests, 1), 2),
+        "pod_fanin_factor": hosts_per_pod,
+        "pushed": pushed,
+    }

@@ -1,0 +1,253 @@
+"""Opt-in local-SGD outer loop: pod-local steps, periodic DCN averaging.
+
+``HOROVOD_MULTIPOD_SYNC`` selects the cross-pod sync discipline:
+
+* ``sync`` (default) — every step's gradient reduction spans the whole
+  world, exactly today's SPMD path. Single-pod jobs and
+  ``localK`` with K<=1 resolve to this **by construction**: the plain
+  code path runs, so parity with it is bitwise, not approximate (the
+  K=1 guarantee scripts/multipod_check.py asserts).
+* ``localK`` (e.g. ``local8``) — each pod runs K steps with gradient
+  reductions confined to its own ICI domain (the inner groups of the
+  pod topology), and every K-th step the PARAMETERS are averaged
+  cross-pod over the DCN outer groups, optionally on the compressed
+  wire (the int8 quantize→gather→dequant-accumulate leg
+  ops/hierarchical.py already runs for hierarchical allreduce), with
+  an outer momentum in the SlowMo/Lookahead family applied to the
+  averaged step.
+
+Numerics: for plain SGD the K=1 *mathematical* equivalence is exact
+(mean-of-pod-means = global mean); for stateful optimizers and K>1 the
+pods genuinely diverge between syncs — that is the latency tolerance
+being bought. The convergence envelope versus the sync baseline is
+measured, not assumed: ``scripts/multipod_check.py`` trains both on
+the simulated 4-pod world and gates the final-loss ratio
+(docs/multipod.md documents the envelope and its caveats).
+
+Outer update (per leaf, at each sync):
+
+    delta  = cross_pod_mean(params - anchor)
+    v      = outer_momentum * v + delta
+    params = anchor + outer_lr * v;  anchor = params
+
+With ``outer_momentum=0`` and ``outer_lr=1`` this is plain parameter
+averaging (anchors are identical across pods after every sync, so
+``mean(p - a) = mean(p) - a``); the momentum term recovers part of the
+information K local steps accumulate in divergent directions (SlowMo,
+PAPERS.md lineage). What crosses DCN is the pod's **K-step delta from
+the anchor**, not the raw parameters — the payload the int8 wire
+quantizes accurately (deltas are small and zero-centered; quantizing
+raw weights would put the block-scale noise on the full parameter
+magnitude).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional, Tuple
+
+from ..core.exceptions import HorovodInternalError
+from .topology import PodTopology
+
+_SYNC_RE = re.compile(r"^local\s*(\d+)$")
+
+
+def parse_sync_mode(spec: str) -> Tuple[str, int]:
+    """``HOROVOD_MULTIPOD_SYNC`` → ("sync", 1) or ("local", K).
+
+    ``localK`` with K<=1 normalizes to ("sync", 1): one local step
+    between syncs IS the synchronous discipline, and routing it through
+    the plain path is what makes the K=1 parity guarantee bitwise."""
+    s = (spec or "sync").strip().lower()
+    if s in ("", "sync"):
+        return "sync", 1
+    m = _SYNC_RE.match(s)
+    if not m:
+        raise HorovodInternalError(
+            f"HOROVOD_MULTIPOD_SYNC={spec!r}: expected 'sync' or "
+            f"'localK' (e.g. local8)")
+    k = int(m.group(1))
+    if k <= 1:
+        return "sync", 1
+    return "local", k
+
+
+def local_sgd_active(topology: Optional[PodTopology],
+                     sync_spec: str) -> bool:
+    """Whether the localK outer loop actually engages: needs >1 pod
+    AND a localK spec with K>1. Everything else takes the plain
+    path."""
+    if topology is None or not topology.multi_pod:
+        return False
+    mode, _k = parse_sync_mode(sync_spec)
+    return mode == "local"
+
+
+@dataclasses.dataclass
+class OuterState:
+    """Per-leaf outer-loop state, a pytree of the params' structure:
+    ``anchor`` is the last synchronized point, ``velocity`` the outer
+    momentum buffer. Registered as a JAX pytree so it carries through
+    jit/lax.cond like optimizer state."""
+
+    anchor: Any
+    velocity: Any
+
+
+def _register_outer_state() -> None:
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        OuterState,
+        lambda s: ((s.anchor, s.velocity), None),
+        lambda _aux, children: OuterState(*children),
+    )
+
+
+_register_outer_state()
+
+
+class LocalSGD:
+    """The outer loop over one :class:`PodTopology`.
+
+    All array methods are traceable: they run inside the existing
+    jitted shard_map step over the flat ``axis`` (default ``"hvd"``),
+    addressing pods via axis_index_groups — no second mesh, no new
+    lowering path. ``wire`` is an optional
+    :class:`~horovod_tpu.optim.compression.WireSpec`; the DCN leg then
+    moves the compressed payload exactly as the hierarchical outer leg
+    does."""
+
+    def __init__(self, topology: PodTopology, k: int,
+                 outer_lr: float = 1.0, outer_momentum: float = 0.0,
+                 wire=None, axis: str = "hvd"):
+        if k < 2:
+            raise HorovodInternalError(
+                "LocalSGD requires K >= 2; K<=1 must take the plain "
+                "synchronous path (parse_sync_mode normalizes this)")
+        if not topology.multi_pod:
+            raise HorovodInternalError(
+                "LocalSGD over a single pod is the plain path; do not "
+                "construct the outer loop")
+        self.topology = topology
+        self.k = int(k)
+        self.outer_lr = float(outer_lr)
+        self.outer_momentum = float(outer_momentum)
+        self.wire = wire
+        self.axis = axis
+        self._inner = topology.inner_groups()
+        self._outer = topology.outer_groups()
+
+    # -- inner (pod-local) leg ---------------------------------------------
+
+    def inner_mean(self, x):
+        """Pod-local mean of ``x`` — the gradient reduction of a local
+        step, confined to the ICI domain."""
+        from jax import lax
+
+        return lax.psum(
+            x, self.axis, axis_index_groups=self._inner,
+        ) / self.topology.pod_size
+
+    def inner_mean_tree(self, tree):
+        import jax
+
+        return jax.tree_util.tree_map(self.inner_mean, tree)
+
+    # -- outer (cross-pod, DCN) leg ----------------------------------------
+
+    def cross_pod_mean(self, x):
+        """Mean of ``x`` across pods at equal pod-local offset, over
+        the (optionally compressed) DCN leg."""
+        from jax import lax
+
+        n = self.topology.n_pods
+        if self.wire is None:
+            return lax.psum(
+                x, self.axis, axis_index_groups=self._outer) / n
+        from ..ops.hierarchical import _outer_wire_sum
+
+        return _outer_wire_sum(
+            x, self.axis, self._outer, n, self.wire, None) / n
+
+    def should_sync(self, step: int) -> bool:
+        """Host-side cadence check: sync after steps K-1, 2K-1, ...
+        (i.e. every K-th completed local step)."""
+        return (int(step) + 1) % self.k == 0
+
+    def init_outer(self, params) -> OuterState:
+        import jax
+        import jax.numpy as jnp
+
+        return OuterState(
+            anchor=jax.tree_util.tree_map(jnp.asarray, params),
+            velocity=jax.tree_util.tree_map(jnp.zeros_like, params),
+        )
+
+    def outer_sync(self, params, state: OuterState,
+                   ) -> Tuple[Any, OuterState]:
+        """One cross-pod synchronization (traceable): average the
+        K-step anchor deltas over DCN (the well-conditioned payload
+        for the quantized wire — module docstring), apply outer
+        momentum, re-anchor. Three plain per-leaf maps — no
+        tuple-valued leaves, so tuple/namedtuple-structured params
+        pytrees are safe."""
+        import jax
+
+        tree_map = jax.tree_util.tree_map
+        mean_delta = tree_map(
+            lambda p, a: self.cross_pod_mean(p - a),
+            params, state.anchor)
+        new_vel = tree_map(
+            lambda v, d: self.outer_momentum * v + d,
+            state.velocity, mean_delta)
+        new_params = tree_map(
+            lambda a, v: a + self.outer_lr * v,
+            state.anchor, new_vel)
+        return new_params, OuterState(anchor=new_params,
+                                      velocity=new_vel)
+
+    def maybe_outer_sync(self, params, state: OuterState, step,
+                         ) -> Tuple[Any, OuterState]:
+        """Traced-cadence form for fully-jitted loops: ``step`` may be
+        a traced scalar; a lax.cond selects sync vs pass-through."""
+        import jax
+        from jax import lax
+
+        do = (step + 1) % self.k == 0
+
+        def _sync(operand):
+            p, s = operand
+            return self.outer_sync(p, s)
+
+        def _skip(operand):
+            return operand
+
+        return lax.cond(do, _sync, _skip, (params, state))
+
+
+def from_knobs(topology: Optional[PodTopology] = None,
+               knobs=None, wire=None, axis: str = "hvd",
+               ) -> Optional[LocalSGD]:
+    """Build the outer loop from the knob snapshot, or None when the
+    plain synchronous path applies (single pod, sync mode, or K<=1) —
+    callers branch on None exactly once, at step-build time."""
+    from .topology import pod_topology
+
+    if knobs is None:
+        from ..core.state import global_state
+
+        knobs = global_state().knobs
+    topo = topology if topology is not None else pod_topology()
+    spec = str(getattr(knobs, "multipod_sync", "sync") or "sync")
+    if not local_sgd_active(topo, spec):
+        return None
+    _mode, k = parse_sync_mode(spec)
+    return LocalSGD(
+        topo, k,
+        outer_lr=float(getattr(knobs, "multipod_outer_lr", 1.0)),
+        outer_momentum=float(
+            getattr(knobs, "multipod_outer_momentum", 0.0)),
+        wire=wire, axis=axis,
+    )

@@ -1,0 +1,244 @@
+"""Per-pod control-plane relay: O(pods) root fan-in instead of O(hosts).
+
+The root rendezvous/KV server is hammered by four push families —
+metric expositions, flight dumps, replication manifests/store
+registrations, and serving registrations — each arriving as its own
+HTTP PUT from every host. At hundreds of hosts the root's accept queue
+and handler threads become the cluster's single point of contention
+(ROADMAP item 5; the MPI characterization work, PAPERS.md 1810.11112,
+finds control-plane fan-in breaks before wire bandwidth).
+
+A :class:`PodRelayServer` is a KVStoreServer (the exact scope/key HTTP
+surface workers already speak — no client changes beyond pointing
+``HVD_TPU_RELAY_ADDR``/``PORT`` at the relay) that
+
+* accepts its pod's pushes locally (a worker's PUT returns as soon as
+  the relay stored it — pod-local RTT, not cross-DCN),
+* **coalesces** them by (scope, key) — KV semantics are last-write-
+  wins, so a metrics exposition superseded before the flush never
+  crosses DCN at all,
+* forwards one batched ``PUT /relay_batch/<pod_id>`` to the root per
+  flush interval under the shared control-plane RetryPolicy
+  (full-jitter backoff — utils/retry.py — so relays recovering from a
+  root failover don't stampede it), and
+* rewrites ``metrics_push`` keys from ``<rank>`` to
+  ``<rank>@<pod_label>`` so the root's aggregated ``/metrics`` can
+  label every series with its pod (utils/metrics.exposition).
+
+Root-state handoff rides the PR 7 failover path unchanged: the root is
+a KVStoreServer with ``state_path``, so a restarted root rebinds the
+same port and the relays' forward retry ladder reconnects without any
+relay-side state loss (pending entries are re-merged on failure, never
+dropped). ``scripts/multipod_check.py`` gates all of this.
+
+GETs are NOT proxied: reads (recovery-ladder fetches, poll-waits) go
+to the root directly — they are rare, pull-shaped, and need the
+cluster-global view only the root has. The relay exists for the hot
+push fan-in.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import threading
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..runner.http.http_server import RELAY_BATCH_PATH, KVStoreServer
+from ..utils import retry as _retry
+from ..utils.metrics import METRICS_PUSH_SCOPE
+
+LOG = logging.getLogger("horovod_tpu.multipod")
+
+_TIMEOUT_S = 5.0
+
+#: env pair a pod's workers read to find their relay (the launcher
+#: exports them per host; scripts/tests set them directly). When unset,
+#: every push path falls back to the root rendezvous address — the
+#: single-pod world is exactly the pre-federation one.
+RELAY_ADDR_ENVS = ("HVD_TPU_RELAY_ADDR", "HOROVOD_RELAY_ADDR")
+RELAY_PORT_ENVS = ("HVD_TPU_RELAY_PORT", "HOROVOD_RELAY_PORT")
+
+
+def relay_endpoint_from_env() -> Optional[Tuple[str, int]]:
+    """This pod's relay (addr, port), or None when no relay is
+    configured."""
+    addr = next((os.environ[n] for n in RELAY_ADDR_ENVS
+                 if os.environ.get(n)), None)
+    port = next((os.environ[n] for n in RELAY_PORT_ENVS
+                 if os.environ.get(n)), None)
+    if not addr or not port:
+        return None
+    try:
+        return addr, int(port)
+    except ValueError:
+        return None
+
+
+def push_endpoint(root: Optional[Tuple[str, int]] = None,
+                  ) -> Optional[Tuple[str, int]]:
+    """Where control-plane PUSHES go: the pod relay when one is
+    configured, else ``root`` (or the env-published rendezvous
+    address). The one routing decision utils/metrics.py,
+    elastic/replication.py, utils/flight.py and serving/replica_set.py
+    all share."""
+    relay = relay_endpoint_from_env()
+    if relay is not None:
+        return relay
+    if root is not None:
+        return root
+    addr = (os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
+            or os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"))
+    port = (os.environ.get("HVD_TPU_RENDEZVOUS_PORT")
+            or os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT"))
+    if not addr or not port:
+        return None
+    try:
+        return addr, int(port)
+    except ValueError:
+        return None
+
+
+class PodRelayServer(KVStoreServer):
+    """One pod's control-plane aggregation point.
+
+    Parameters: ``pod_label`` names the pod on forwarded telemetry
+    (PodTopology.pod_label()); ``root`` is the rendezvous server's
+    (addr, port); ``flush_interval_s`` is the fixed forward cadence —
+    at most one upward PUT per interval, and at most one interval of
+    staleness per relayed record; ``forward_scopes``
+    restricts forwarding to the named scopes (None = forward every
+    scope — flight dumps, manifests, registrations and all)."""
+
+    def __init__(self, pod_label: str, root: Tuple[str, int],
+                 port: int = 0, flush_interval_s: float = 1.0,
+                 forward_scopes: Optional[List[str]] = None,
+                 state_path: Optional[str] = None,
+                 policy: Optional[_retry.RetryPolicy] = None):
+        super().__init__(port=port, state_path=state_path)
+        self.pod_label = pod_label
+        self.root = root
+        self.flush_interval_s = float(flush_interval_s)
+        self.forward_scopes = (
+            set(forward_scopes) if forward_scopes is not None else None)
+        self._policy = policy or _retry.RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+            jitter="full")
+        self._outage = _retry.Outage(
+            LOG, f"relay {pod_label} forward to the root server")
+        self._pending: Dict[Tuple[str, str], bytes] = {}
+        self._pending_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._forwarder: Optional[threading.Thread] = None
+        self.forwarded_batches = 0
+        self.forwarded_entries = 0
+        self.set_mutation_hook(self._observe)
+
+    # -- ingest -------------------------------------------------------------
+
+    def _observe(self, scope: str, key: str, value: bytes) -> None:
+        if self.forward_scopes is not None \
+                and scope not in self.forward_scopes:
+            return
+        if scope == METRICS_PUSH_SCOPE and "@" not in key:
+            # pod-label the rank key so the root's aggregated /metrics
+            # emits rank="<r>",pod="<label>" series (docs/multipod.md)
+            key = f"{key}@{self.pod_label}"
+        with self._pending_lock:
+            self._pending[(scope, key)] = value
+
+    # -- forward ------------------------------------------------------------
+
+    def _take_pending(self) -> List[Tuple[str, str, bytes]]:
+        with self._pending_lock:
+            batch = [(s, k, v) for (s, k), v in self._pending.items()]
+            self._pending.clear()
+        return batch
+
+    def _restore_pending(self,
+                         batch: List[Tuple[str, str, bytes]]) -> None:
+        """A failed forward re-merges its entries — newer pod-local
+        writes of the same (scope, key) win, so nothing is lost and
+        nothing stale overwrites fresh."""
+        with self._pending_lock:
+            for scope, key, value in batch:
+                self._pending.setdefault((scope, key), value)
+
+    def flush_once(self) -> int:
+        """Forward everything pending as ONE batched PUT. Returns the
+        entry count forwarded (0 = nothing pending). Raises nothing:
+        failures re-merge the batch and count on the outage tracker."""
+        batch = self._take_pending()
+        if not batch:
+            return 0
+        # JSON + base64, matching http_server.decode_relay_batch (the
+        # root refuses to unpickle network input)
+        body = json.dumps([
+            {"scope": s, "key": k,
+             "value_b64": base64.b64encode(v).decode()}
+            for s, k, v in batch
+        ]).encode()
+        addr, port = self.root
+
+        def _do() -> None:
+            req = urllib.request.Request(
+                f"http://{addr}:{port}/{RELAY_BATCH_PATH}/"
+                f"{self.pod_label}",
+                data=body, method="PUT",
+            )
+            with urllib.request.urlopen(req, timeout=_TIMEOUT_S):
+                pass
+
+        try:
+            self._policy.call(_do, point="relay.forward")
+        except Exception as e:
+            self._restore_pending(batch)
+            self._outage.failure(e)
+            return 0
+        self._outage.success()
+        self.forwarded_batches += 1
+        self.forwarded_entries += len(batch)
+        return len(batch)
+
+    def _forward_loop(self) -> None:
+        # fixed cadence: ONE upward PUT per interval regardless of the
+        # pod's arrival pattern (a per-record wake would let steady
+        # traffic degrade the relay into a per-arrival forwarder and
+        # erode the O(pods) fan-in contract). Worst-case record
+        # staleness = one interval; an empty interval costs nothing
+        # (flush_once returns before any network on empty pending).
+        while not self._stop.wait(self.flush_interval_s):
+            self.flush_once()
+        self.flush_once()  # final drain: clean shutdowns lose nothing
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start_server(self) -> int:
+        port = super().start_server()
+        if self._forwarder is None:
+            self._stop.clear()
+            self._forwarder = threading.Thread(
+                target=self._forward_loop, daemon=True,
+                name=f"relay-{self.pod_label}")
+            self._forwarder.start()
+        return port
+
+    def shutdown_server(self) -> None:
+        self._stop.set()
+        if self._forwarder is not None:
+            self._forwarder.join(timeout=10)
+            self._forwarder = None
+        super().shutdown_server()
+
+    def stats(self) -> Dict[str, int]:
+        with self._pending_lock:
+            pending = len(self._pending)
+        return {
+            "forwarded_batches": self.forwarded_batches,
+            "forwarded_entries": self.forwarded_entries,
+            "pending": pending,
+            "received_requests": self.request_count,
+        }

@@ -1,0 +1,231 @@
+"""Pod topology: the pod-aware view of the world every layer shares.
+
+A **pod** is one ICI domain — the rank block whose collectives stay on
+the fast torus — and the unit the federation scales by: per-pod relay
+servers (relay.py), per-pod local-SGD groups (localsgd.py), per-pod
+metric rollups (scripts/metrics_summary.py). This module derives one
+:class:`PodTopology` from, in priority order,
+
+1. explicit knobs/env (``HOROVOD_MULTIPOD_PODS`` +
+   ``HOROVOD_MULTIPOD_POD_ID``; the launcher exports both per host),
+2. a factored mesh (an outer ``dcn`` axis names the pod level,
+   parallel/mesh.py),
+3. the flat world + ``HOROVOD_MULTIPOD_PODS`` (contiguous rank blocks,
+   the launcher's rank model: local ranks contiguous, hosts/pods the
+   outer level — the same block convention ops/hierarchical.py uses,
+   so the localsgd outer groups and the hierarchical outer leg always
+   agree on who is cross-pod).
+
+Rank blocks are contiguous: pod ``p`` of ``n_pods`` over ``world``
+ranks owns ``[p*world/n_pods, (p+1)*world/n_pods)``. ``world %
+n_pods != 0`` is a configuration error (a lopsided pod would make the
+outer averaging groups ragged — XLA replica groups must be uniform).
+
+Integration with core/process_sets.py: :meth:`PodTopology.process_set`
+registers (or reuses) the pod's member ranks as a ProcessSet, so
+pod-scoped collectives ride the existing set machinery — SPMD
+axis_index_groups and the eager sub-mesh form both come for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from ..core.exceptions import HorovodInternalError
+
+
+def _env_first(*names: str) -> Optional[str]:
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class PodTopology:
+    """The federation's shape: which pod this process is in, who else
+    is, and how far away the other pods are.
+
+    ``dcn_hops`` is the worst-case DCN hop count between any two pods
+    (1 = every pod pair is one switch hop apart — the flat-fabric
+    default; the scaling projection's DCN tier consumes it as a latency
+    multiplier)."""
+
+    n_pods: int
+    pod_id: int
+    world: int
+    dcn_hops: int = 1
+
+    def __post_init__(self):
+        if self.n_pods < 1:
+            raise HorovodInternalError(
+                f"n_pods must be >= 1, got {self.n_pods}")
+        if self.world % self.n_pods:
+            raise HorovodInternalError(
+                f"world size {self.world} is not divisible by "
+                f"{self.n_pods} pods (pods are uniform rank blocks)")
+        if not 0 <= self.pod_id < self.n_pods:
+            raise HorovodInternalError(
+                f"pod_id {self.pod_id} out of range for "
+                f"{self.n_pods} pods")
+
+    # -- shape queries ------------------------------------------------------
+
+    @property
+    def pod_size(self) -> int:
+        return self.world // self.n_pods
+
+    @property
+    def multi_pod(self) -> bool:
+        return self.n_pods > 1
+
+    def members(self, pod_id: Optional[int] = None) -> List[int]:
+        """Global ranks of ``pod_id`` (default: this pod)."""
+        p = self.pod_id if pod_id is None else int(pod_id)
+        k = self.pod_size
+        return list(range(p * k, (p + 1) * k))
+
+    def pod_of_rank(self, rank: int) -> int:
+        if not 0 <= rank < self.world:
+            raise HorovodInternalError(
+                f"rank {rank} out of range for world {self.world}")
+        return rank // self.pod_size
+
+    def pod_label(self, pod_id: Optional[int] = None) -> str:
+        """The string label telemetry carries (``pod="<label>"`` on the
+        aggregated exposition, the ``pod`` field of step records)."""
+        return f"pod{self.pod_id if pod_id is None else int(pod_id)}"
+
+    # -- collective group forms --------------------------------------------
+
+    def inner_groups(self) -> List[List[int]]:
+        """axis_index_groups for pod-LOCAL collectives: one group per
+        pod (the contiguous blocks — ops/hierarchical._block_groups'
+        inner form)."""
+        k = self.pod_size
+        return [list(range(p * k, (p + 1) * k))
+                for p in range(self.n_pods)]
+
+    def outer_groups(self) -> List[List[int]]:
+        """axis_index_groups for CROSS-pod collectives: the strided
+        groups joining equal pod-local offsets across pods — the DCN
+        leg's communicators."""
+        k = self.pod_size
+        return [[off + p * k for p in range(self.n_pods)]
+                for off in range(k)]
+
+    # -- process-set integration -------------------------------------------
+
+    def process_set(self):
+        """This pod's member ranks as a registered ProcessSet (created
+        on first use, reused afterwards) — pod-scoped collectives get
+        the SPMD axis_index_groups and eager sub-mesh forms through the
+        existing set machinery. Requires an initialized runtime."""
+        from ..core import process_sets
+
+        return process_sets.add_or_get_process_set(self.members())
+
+    def __str__(self) -> str:
+        return (f"PodTopology({self.n_pods} pods x {self.pod_size} "
+                f"ranks, this={self.pod_label()}, "
+                f"dcn_hops={self.dcn_hops})")
+
+
+# ---------------------------------------------------------------------------
+# derivation
+# ---------------------------------------------------------------------------
+
+def pod_topology_from_env(world: Optional[int] = None,
+                          rank: Optional[int] = None,
+                          ) -> Optional[PodTopology]:
+    """Build the topology from launcher env alone (no jax, no init):
+    ``HOROVOD_MULTIPOD_PODS`` (``HVD_TPU_`` prefix wins, as for every
+    knob) names the pod count; ``HOROVOD_MULTIPOD_POD_ID`` pins this
+    host's pod, defaulting to ``rank // pod_size`` when a rank env is
+    visible. Returns None when no multipod env is set — the single-pod
+    world stays knob-free."""
+    raw = _env_first("HVD_TPU_MULTIPOD_PODS", "HOROVOD_MULTIPOD_PODS")
+    if not raw:
+        return None
+    try:
+        n_pods = int(raw)
+    except ValueError:
+        return None
+    if n_pods <= 0:
+        return None
+    if world is None:
+        w = _env_first("HVD_TPU_SIZE", "HOROVOD_SIZE")
+        world = int(w) if w else n_pods
+    if rank is None:
+        r = _env_first("HVD_TPU_RANK", "HOROVOD_RANK")
+        rank = int(r) if r else 0
+    pod_raw = _env_first(
+        "HVD_TPU_MULTIPOD_POD_ID", "HOROVOD_MULTIPOD_POD_ID")
+    if pod_raw is not None:
+        pod_id = int(pod_raw)
+    else:
+        pod_id = rank // max(world // n_pods, 1)
+    hops_raw = _env_first(
+        "HVD_TPU_MULTIPOD_DCN_HOPS", "HOROVOD_MULTIPOD_DCN_HOPS")
+    dcn_hops = int(hops_raw) if hops_raw else 1
+    return PodTopology(n_pods=n_pods, pod_id=pod_id, world=world,
+                       dcn_hops=dcn_hops)
+
+
+def pod_topology() -> Optional[PodTopology]:
+    """The initialized runtime's topology: knobs first, then a factored
+    mesh's ``dcn`` axis, else None (single pod, no federation).
+
+    Mesh derivation: a mesh carrying a ``dcn`` axis IS a multipod
+    declaration — the axis extent is the pod count and the pod id is
+    this process's coordinate along it (single-controller SPMD sees
+    every pod, so the coordinate defaults to 0 unless the env pins
+    it)."""
+    from ..core.state import global_state
+
+    st = global_state()
+    if not st.initialized:
+        return pod_topology_from_env()
+    world = 1
+    if st.mesh is not None:
+        import numpy as np
+
+        world = int(np.asarray(st.mesh.devices).size)
+    n_pods = int(getattr(st.knobs, "multipod_pods", 0) or 0)
+    if n_pods > 1:
+        from ..core import basics
+
+        try:
+            rank = basics.rank()
+        except Exception:
+            rank = 0
+        env = pod_topology_from_env(world=world, rank=rank)
+        if env is not None and env.n_pods == n_pods:
+            return env
+        return PodTopology(
+            n_pods=n_pods,
+            pod_id=rank // max(world // n_pods, 1),
+            world=world,
+            dcn_hops=int(getattr(st.knobs, "multipod_dcn_hops", 1) or 1),
+        )
+    if st.mesh is not None and "dcn" in getattr(st.mesh, "axis_names", ()):
+        sizes = dict(zip(st.mesh.axis_names, st.mesh.devices.shape))
+        n = int(sizes["dcn"])
+        if n > 1:
+            env = pod_topology_from_env(world=world)
+            pod_id = env.pod_id if env is not None and env.n_pods == n \
+                else 0
+            return PodTopology(n_pods=n, pod_id=pod_id, world=world)
+    return pod_topology_from_env(world=world)
+
+
+def pod_block_groups(world: int, n_pods: int,
+                     ) -> Tuple[List[List[int]], List[List[int]]]:
+    """(inner, outer) axis_index_groups for ``n_pods`` contiguous rank
+    blocks — the standalone form check scripts use without a live
+    topology. Inner = pod-local, outer = cross-pod strided."""
+    topo = PodTopology(n_pods=n_pods, pod_id=0, world=world)
+    return topo.inner_groups(), topo.outer_groups()

@@ -27,6 +27,41 @@ from ..util.hosts import SlotInfo
 
 RENDEZVOUS_SCOPE = "rendezvous"
 
+# one batched relay forward (multipod/relay.py): a per-pod relay PUTs
+# /relay_batch/<pod_id> with a JSON array of {scope, key, value_b64}
+# entries and the root unpacks it into the store under the original
+# scopes — the O(pods) replacement for O(hosts) individual
+# control-plane PUTs. JSON+base64, NOT pickle: this is an
+# unauthenticated network surface and unpickling it would hand remote
+# code execution to anyone who can reach the port.
+RELAY_BATCH_PATH = "relay_batch"
+
+
+def decode_relay_batch(body: bytes):
+    """Parse + validate one relay batch; returns [(scope, key, value)]
+    or raises ValueError. Validation is all-or-nothing so a malformed
+    batch never half-applies."""
+    import base64
+
+    entries = json.loads(body)
+    if not isinstance(entries, list):
+        raise ValueError("relay batch is not a list")
+    out = []
+    for e in entries:
+        if not isinstance(e, dict):
+            raise ValueError("relay entry is not an object")
+        scope, key = e.get("scope"), e.get("key")
+        if not isinstance(scope, str) or not isinstance(key, str) \
+                or not scope or not key or "/" in scope:
+            raise ValueError("bad relay entry scope/key")
+        try:
+            value = base64.b64decode(e.get("value_b64", ""),
+                                     validate=True)
+        except Exception:
+            raise ValueError("bad relay entry payload")
+        out.append((scope, key, value))
+    return out
+
 # driver-side receipt stamps for worker flight dumps (PUT /flight/<r>):
 # scripts/flight_analyze.py reads them as a second clock-alignment
 # signal next to each dump's own /clock-probe offset
@@ -42,6 +77,15 @@ class _KVHandler(BaseHTTPRequestHandler):
             return None
         return parts[0], parts[1]
 
+    def _count(self) -> None:
+        """Request-count instrumentation: the control-plane fan-in
+        scoreboard the relay reduction is measured against
+        (scripts/multipod_check.py, scripts/control_plane_scaling.py
+        --pods)."""
+        with self.server.lock:  # type: ignore[attr-defined]
+            self.server.request_count = getattr(  # type: ignore[attr-defined]
+                self.server, "request_count", 0) + 1
+
     def _injected_503(self) -> bool:
         """Server-side fault point: an ``http.server`` error rule turns
         this request into a 503 — the retryable-status path clients
@@ -54,6 +98,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         return False
 
     def do_GET(self):
+        self._count()
         path = self.path.split("?", 1)[0].rstrip("/")
         if path == "/metrics":
             # cluster-aggregated telemetry scrape (utils/metrics.py):
@@ -98,7 +143,24 @@ class _KVHandler(BaseHTTPRequestHandler):
         else:
             self._reply(200, value)
 
+    def _store_one(self, scope: str, key: str, body: bytes) -> None:
+        """One mutation into the store (lock held by the caller)."""
+        store = self.server.store  # type: ignore[attr-defined]
+        store.setdefault(scope, {})[key] = body
+        if scope == FLIGHT_SCOPE:
+            # PUT /flight/<rank>: stamp the driver-side receipt so
+            # post-hoc analysis has a second alignment anchor and
+            # an arrival order even for dumps whose /clock probe
+            # failed
+            store.setdefault(FLIGHT_META_SCOPE, {})[key] = (
+                json.dumps({
+                    "recv_time_unix": time.time(),
+                    "bytes": len(body),
+                }).encode()
+            )
+
     def do_PUT(self):
+        self._count()
         if self._injected_503():
             return
         sk = self._split()
@@ -107,24 +169,39 @@ class _KVHandler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if sk[0] == RELAY_BATCH_PATH:
+            # one pod relay's coalesced forward: unpack into the store
+            # under the original scopes, exactly as if each entry had
+            # arrived as its own PUT — every reader (aggregated
+            # /metrics, recovery GETs, last_assignments) is oblivious
+            # to whether a record came direct or relayed
+            try:
+                entries = decode_relay_batch(body)
+            except Exception:
+                self._reply(400, b"bad relay batch")
+                return
+            with self.server.lock:  # type: ignore[attr-defined]
+                for scope, key, value in entries:
+                    self._store_one(str(scope), str(key), value)
+            self.server.dirty.set()  # type: ignore[attr-defined]
+            self._reply(200, b"ok")
+            return
+        on_mutation = getattr(self.server, "on_mutation", None)
         with self.server.lock:  # type: ignore[attr-defined]
-            store = self.server.store  # type: ignore[attr-defined]
-            store.setdefault(sk[0], {})[sk[1]] = body
-            if sk[0] == FLIGHT_SCOPE:
-                # PUT /flight/<rank>: stamp the driver-side receipt so
-                # post-hoc analysis has a second alignment anchor and
-                # an arrival order even for dumps whose /clock probe
-                # failed
-                store.setdefault(FLIGHT_META_SCOPE, {})[sk[1]] = (
-                    json.dumps({
-                        "recv_time_unix": time.time(),
-                        "bytes": len(body),
-                    }).encode()
-                )
+            self._store_one(sk[0], sk[1], body)
+            if on_mutation is not None:
+                # relay hook (multipod/relay.py): observe the mutation
+                # for batched upward forwarding. UNDER the store lock:
+                # two same-key PUTs racing outside it could reach the
+                # hook in reverse order and forward the stale value
+                # while the store holds the fresh one. The hook only
+                # touches its own pending dict — no lock cycle.
+                on_mutation(sk[0], sk[1], body)
         self.server.dirty.set()  # type: ignore[attr-defined]
         self._reply(200, b"ok")
 
     def do_DELETE(self):
+        self._count()
         if self._injected_503():
             return
         sk = self._split()
@@ -195,6 +272,8 @@ class KVStoreServer:
         self._httpd.store = init_store  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.dirty = threading.Event()  # type: ignore[attr-defined]
+        self._httpd.request_count = 0  # type: ignore[attr-defined]
+        self._httpd.on_mutation = None  # type: ignore[attr-defined]
         if restored is not None:
             self._apply_state_extra(restored.get("extra", {}))
         self._thread = threading.Thread(
@@ -222,6 +301,19 @@ class KVStoreServer:
     @property
     def lock(self):
         return self._httpd.lock  # type: ignore[attr-defined]
+
+    @property
+    def request_count(self) -> int:
+        """Requests served since start — the fan-in scoreboard
+        (multipod relay reduction is measured against this)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return int(self._httpd.request_count)  # type: ignore[attr-defined]
+
+    def set_mutation_hook(self, fn) -> None:
+        """Install a (scope, key, value) observer called after every
+        direct PUT (relay forwarding, multipod/relay.py). None
+        removes."""
+        self._httpd.on_mutation = fn  # type: ignore[attr-defined]
 
     def shutdown_server(self) -> None:
         # BaseServer.shutdown() blocks on the serve_forever loop's ack, so

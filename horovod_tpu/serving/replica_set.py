@@ -698,8 +698,42 @@ def _register(register: str, index: int, port: int,
             "(HVD_TPU_SECRET_KEY) — the registry authenticates")
     host, _, p = register.rpartition(":")
     client = ComputeClient([(host, int(p))], key)
-    client.register_worker(
-        kind, index, f"{routable_host_address()}:{port}")
+    address = f"{routable_host_address()}:{port}"
+    client.register_worker(kind, index, address)
+    _mirror_registration_kv(kind, index, address)
+
+
+#: rendezvous KV scope mirroring serving registrations — the
+#: federation's pod relays batch these upward so the root's view of
+#: the serving fleet costs O(pods) requests, and ops tooling can list
+#: replicas from the KV surface without speaking the authenticated
+#: ComputeService protocol (docs/multipod.md)
+SERVING_REGISTRY_SCOPE = "serving_registry"
+
+
+def _mirror_registration_kv(kind: str, index: int, address: str) -> None:
+    """Best-effort KV mirror of one replica registration, sent ONLY
+    when a pod relay is configured (``HVD_TPU_RELAY_ADDR/PORT``) — a
+    non-federated deployment must not grow new direct-to-root PUTs.
+    The authoritative registry stays the ComputeService; a mirror
+    failure costs nothing but the federated view."""
+    try:
+        from ..multipod.relay import relay_endpoint_from_env
+
+        ep = relay_endpoint_from_env()
+        if ep is None:
+            return
+        body = json.dumps({
+            "kind": kind, "index": int(index), "address": address,
+            "time_unix": time.time(),
+        }).encode()
+        req = urllib.request.Request(
+            f"http://{ep[0]}:{ep[1]}/{SERVING_REGISTRY_SCOPE}/"
+            f"{kind}_{index}", data=body, method="PUT")
+        with urllib.request.urlopen(req, timeout=2.0):
+            pass
+    except Exception as e:
+        flight.record("serving_registry_mirror_failed", str(e))
 
 
 def serve_replica(argv=None) -> int:

@@ -550,15 +550,23 @@ def configure(knobs=None, *, enabled_override: Optional[bool] = None,
     if sink_addr is not None:
         set_sink(sink_addr, sink_port or 0)
     elif _sink is None:
-        addr = _env_first(
-            "HVD_TPU_RENDEZVOUS_ADDR", "HOROVOD_GLOO_RENDEZVOUS_ADDR")
-        port = _env_first(
-            "HVD_TPU_RENDEZVOUS_PORT", "HOROVOD_GLOO_RENDEZVOUS_PORT")
-        if addr and port:
-            try:
-                set_sink(addr, int(port))
-            except ValueError:
-                pass
+        # prefer the pod relay when one is configured: dumps land
+        # pod-locally and the relay batches them to the root with the
+        # other control-plane pushes (multipod/relay.py); the root's
+        # relay-batch unpack stamps FLIGHT_META receipts exactly as a
+        # direct PUT would. push_endpoint() resolves the relay as a
+        # PAIR (addr+port both set, else the rendezvous pair) —
+        # independent per-var fallbacks could mix a relay address with
+        # the rendezvous port and lose every dump.
+        endpoint = None
+        try:
+            from ..multipod.relay import push_endpoint
+
+            endpoint = push_endpoint()
+        except Exception:
+            pass
+        if endpoint is not None:
+            set_sink(endpoint[0], endpoint[1])
     if directory is not None:
         _dir = directory
     elif not _dir:

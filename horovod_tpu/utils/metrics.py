@@ -352,7 +352,11 @@ def exposition(
     the rendezvous server from worker ``PUT /metrics_push/<rank>``
     calls) the scrape is **cluster-aggregated**: this process's series
     stay unlabeled and every pushed series gains a ``rank="<r>"``
-    label, so one endpoint answers for the whole world."""
+    label, so one endpoint answers for the whole world. A pod relay
+    (multipod/relay.py) forwards its pod's pushes under
+    ``<rank>@<pod>`` keys; those series additionally gain a
+    ``pod="<pod>"`` label, so the aggregated scrape rolls up by pod
+    with one PromQL ``sum by (pod)``."""
     if not pushed:
         return PROM_CONTENT_TYPE, scrape().encode()
     payloads: List[Tuple[str, str]] = [("", scrape())]
@@ -371,9 +375,11 @@ METRICS_PUSH_SCOPE = "metrics_push"
 
 def merge_expositions(payloads: Iterable[Tuple[str, str]]) -> str:
     """Merge Prometheus text payloads into one exposition, injecting a
-    ``rank`` label into every sample of a non-empty-labeled payload.
-    Families are regrouped so HELP/TYPE headers appear once, before all
-    of a family's samples (what parsers and :func:`lint_exposition`
+    ``rank`` label into every sample of a non-empty-labeled payload
+    (and a ``pod`` label when the payload key is ``<rank>@<pod>`` —
+    the relay-forwarded form, multipod/relay.py). Families are
+    regrouped so HELP/TYPE headers appear once, before all of a
+    family's samples (what parsers and :func:`lint_exposition`
     require)."""
     help_: Dict[str, str] = {}
     type_: Dict[str, str] = {}
@@ -402,7 +408,10 @@ def merge_expositions(payloads: Iterable[Tuple[str, str]]) -> str:
                 else name
             )
             if rank_label:
-                extra = f'rank="{_escape_label(str(rank_label))}"'
+                rank_part, _, pod_part = str(rank_label).partition("@")
+                extra = f'rank="{_escape_label(rank_part)}"'
+                if pod_part:
+                    extra += f',pod="{_escape_label(pod_part)}"'
                 inner = labels[:-1] if brace else ""
                 line = (
                     f"{name}{{"
@@ -732,6 +741,11 @@ class StepStats:
                 record["retries"] = dict(self.retries)
             if self.retry_giveups:
                 record["retry_giveups"] = dict(self.retry_giveups)
+            if _pod_label:
+                # federation view: the pod this process belongs to
+                # (multipod/topology.py) — scripts/metrics_summary.py
+                # rolls step records up per pod on it
+                record["pod"] = _pod_label
             if native:
                 delta = {
                     k: native[k] - self._last_native.get(k, 0.0)
@@ -1554,6 +1568,19 @@ _push_stop: Optional[threading.Event] = None
 _push_policy = None
 _push_outage = None
 
+# pod label of this process under a multipod topology ("" = single
+# pod); stamps step records and names this pod in docs/telemetry
+_pod_label = ""
+
+
+def set_pod_label(label: str) -> None:
+    global _pod_label
+    _pod_label = str(label or "")
+
+
+def pod_label() -> str:
+    return _pod_label
+
 
 def _push_degradation():
     """Lazy (import-cycle-safe) bounded policy + outage tracker for the
@@ -1657,18 +1684,35 @@ def configure(knobs) -> None:
         start_http_server(knobs.metrics_port)
     # launcher-spawned worker: feed the rendezvous server's aggregated
     # /metrics (the driver process itself has no rank env and does not
-    # push — its registry is the unlabeled series of the merge)
+    # push — its registry is the unlabeled series of the merge).
+    # Under a multipod topology the push targets the pod's RELAY, not
+    # the root — the relay batches the pod's expositions into one
+    # upward PUT so the root sees O(pods) pushers (multipod/relay.py).
     interval = float(
         getattr(knobs, "metrics_push_interval_s", 0.0) or 0.0)
-    addr = (os.environ.get("HVD_TPU_RENDEZVOUS_ADDR")
-            or os.environ.get("HOROVOD_GLOO_RENDEZVOUS_ADDR"))
-    port = (os.environ.get("HVD_TPU_RENDEZVOUS_PORT")
-            or os.environ.get("HOROVOD_GLOO_RENDEZVOUS_PORT"))
+    try:
+        from ..multipod.relay import push_endpoint
+
+        endpoint = push_endpoint()
+    except Exception:
+        endpoint = None
+    try:
+        # separate guard: a malformed multipod env (bad pod id, a pod
+        # count that doesn't divide the world) must cost the pod
+        # label, never the push loop itself
+        from ..multipod.topology import pod_topology_from_env
+
+        topo = pod_topology_from_env()
+        if topo is not None:
+            set_pod_label(topo.pod_label())
+    except Exception:
+        pass
     rank = (os.environ.get("HVD_TPU_RANK")
             or os.environ.get("HOROVOD_RANK"))
-    if interval > 0 and addr and port and rank is not None:
+    if interval > 0 and endpoint is not None and rank is not None:
         try:
-            start_metrics_push(addr, int(port), int(rank), interval)
+            start_metrics_push(
+                endpoint[0], endpoint[1], int(rank), interval)
         except ValueError:
             pass
 
@@ -1691,6 +1735,7 @@ def reset() -> None:
     return to the disabled state."""
     global _configured, _push_policy, _push_outage
     _push_policy = _push_outage = None
+    set_pod_label("")
     set_step_wrapper(None)
     on_shutdown()
     disable()

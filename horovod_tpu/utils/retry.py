@@ -56,7 +56,24 @@ def _default_retryable(exc: BaseException) -> bool:
 
 
 class RetryPolicy:
-    """Exponential backoff with bounded jitter and an overall deadline.
+    """Exponential backoff with jitter and an overall deadline.
+
+    Two jitter disciplines (``jitter=``):
+
+    * ``"bounded"`` — the historical ±``jitter_frac`` symmetric band
+      around the exponential delay. Fine for one isolated caller;
+      useless against synchronized fleets: after a rendezvous failover
+      every host computes the SAME schedule ±25%, so hundreds of
+      reconnects land on the root in tight waves (thundering herd).
+    * ``"full"`` — AWS-style full jitter: the delay is uniform on
+      ``[0, exp_backoff]``, spreading a fleet's retries across the
+      whole backoff window. The shared :func:`default_policy` uses
+      this (``HOROVOD_RETRY_JITTER=bounded`` restores the old band).
+
+    ``max_elapsed_s`` is a shared cap on TOTAL elapsed time across
+    attempts, applied even when no per-call ``deadline_s`` was given —
+    the fleet-wide bound that keeps a reconnect storm finite
+    (``HOROVOD_RETRY_MAX_ELAPSED``; <=0 disables).
 
     All time arithmetic runs on an injectable monotonic ``clock`` and
     ``sleep`` so tests exercise the exact schedule with zero real
@@ -76,9 +93,13 @@ class RetryPolicy:
         sleep: Callable[[float], None] = time.sleep,
         seed: Optional[int] = None,
         record_metrics: bool = True,
+        jitter: str = "bounded",
+        max_elapsed_s: Optional[float] = None,
     ):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
+        if jitter not in ("bounded", "full"):
+            raise ValueError(f"unknown jitter mode {jitter!r}")
         self.max_attempts = int(max_attempts)
         self.base_delay_s = float(base_delay_s)
         self.max_delay_s = float(max_delay_s)
@@ -89,6 +110,10 @@ class RetryPolicy:
         self.clock = clock
         self.sleep = sleep
         self.seed = seed
+        self.jitter = jitter
+        self.max_elapsed_s = (
+            float(max_elapsed_s)
+            if max_elapsed_s and max_elapsed_s > 0 else None)
         # record_metrics=False is for callers that may run inside a
         # signal handler (the flight recorder's dump push): the metrics
         # registry locks must never be touched there
@@ -97,14 +122,18 @@ class RetryPolicy:
 
     def delay_for_attempt(self, attempt: int,
                           rng: Optional[random.Random] = None) -> float:
-        """Backoff before retry number ``attempt`` (1-based), jittered
+        """Backoff before retry number ``attempt`` (1-based): full
+        jitter draws uniformly on [0, exp_backoff]; bounded jitters
         symmetrically by ±jitter_frac."""
         d = min(
             self.base_delay_s * (self.multiplier ** (attempt - 1)),
             self.max_delay_s,
         )
-        if self.jitter_frac and rng is not None:
-            d *= 1.0 + self.jitter_frac * (2.0 * rng.random() - 1.0)
+        if rng is not None:
+            if self.jitter == "full":
+                d *= rng.random()
+            elif self.jitter_frac:
+                d *= 1.0 + self.jitter_frac * (2.0 * rng.random() - 1.0)
         return max(d, 0.0)
 
     def call(self, fn: Callable, *args, point: str = "",
@@ -118,7 +147,13 @@ class RetryPolicy:
         ``deadline_s`` budget is spent, whichever comes first.
         """
         is_retryable = retryable or self.retryable
-        deadline = Deadline(self.deadline_s, clock=self.clock)
+        budget = self.deadline_s
+        if self.max_elapsed_s is not None:
+            # the shared cap binds even deadline-less callers, and
+            # tightens any caller deadline that exceeds it
+            budget = (self.max_elapsed_s if budget is None
+                      else min(budget, self.max_elapsed_s))
+        deadline = Deadline(budget, clock=self.clock)
         rng = random.Random(self.seed)
         attempt = 0
         while True:
@@ -209,12 +244,21 @@ def default_policy() -> RetryPolicy:
     snapshot."""
     global _default_policy
     if _default_policy is None:
-        from ..core.knobs import _env_float, _env_int
+        from ..core.knobs import _env, _env_float, _env_int
 
+        jitter = (_env("RETRY_JITTER", "full") or "full").strip().lower()
+        if jitter not in ("bounded", "full"):
+            jitter = "full"
         _default_policy = RetryPolicy(
             max_attempts=_env_int("RETRY_MAX_ATTEMPTS", 5),
             base_delay_s=_env_float("RETRY_BASE_DELAY", 0.1),
             max_delay_s=_env_float("RETRY_MAX_DELAY", 2.0),
+            # fleet discipline: full jitter + a shared elapsed cap, so
+            # hundreds of hosts reconnecting after a rendezvous
+            # failover spread across the backoff window instead of
+            # retrying in lockstep (thundering herd on the root)
+            jitter=jitter,
+            max_elapsed_s=_env_float("RETRY_MAX_ELAPSED", 60.0),
         )
     return _default_policy
 
@@ -230,8 +274,14 @@ def configure(knobs) -> None:
     """Rebuild the shared policy from a Knobs snapshot — the
     programmatic twin of the env path (hvd.init calls this, so
     ``Knobs(retry_max_attempts=...)`` works like every other knob)."""
+    jitter = str(getattr(knobs, "retry_jitter", "full") or "full")
+    if jitter not in ("bounded", "full"):
+        jitter = "full"
     set_default_policy(RetryPolicy(
         max_attempts=int(getattr(knobs, "retry_max_attempts", 5)),
         base_delay_s=float(getattr(knobs, "retry_base_delay_seconds", 0.1)),
         max_delay_s=float(getattr(knobs, "retry_max_delay_seconds", 2.0)),
+        jitter=jitter,
+        max_elapsed_s=float(
+            getattr(knobs, "retry_max_elapsed_seconds", 60.0)),
     ))

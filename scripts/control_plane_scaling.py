@@ -17,8 +17,17 @@ control plane must stay off the critical path. Run with
 SCALING_r05 and earlier (per-step negotiation tripled 1→4 procs there
 even at a 98.6% response-cache hit rate).
 
+With ``--pods N`` the report additionally carries a **relay fan-in**
+section: the same host simulates N pods of ``--hosts-per-pod`` workers
+pushing control-plane records (metrics expositions) either direct to
+the root KV server or through per-pod relays (multipod/relay.py), and
+emits per-pod relay rows plus the root's request count under both
+modes — the measured direct-to-root vs relayed comparison the
+SCALING_r{N}.json artifact line carries (``--fanin-only`` skips the
+eager worlds when only this section is wanted).
+
 Usage: python scripts/control_plane_scaling.py [--out SCALING_r06.json]
-       [--no-fast-path]
+       [--no-fast-path] [--pods N] [--hosts-per-pod M] [--fanin-only]
 """
 
 import argparse
@@ -147,6 +156,24 @@ def run_world(size, fast_path=True):
     }
 
 
+def run_fanin(n_pods, hosts_per_pod, pushes_per_host=10,
+              flush_interval_s=0.05):
+    """Direct-to-root vs relayed control-plane fan-in on this host:
+    per-pod relay rows + root request counts under both modes (the
+    shared harness in multipod/fanin.py — the multipod_check gate
+    measures the same thing)."""
+    from horovod_tpu.multipod.fanin import measure_fanin
+
+    m = measure_fanin(n_pods, hosts_per_pod,
+                      pushes_per_host=pushes_per_host,
+                      flush_interval_s=flush_interval_s)
+    m.pop("pushed")  # raw expositions: the gate checks those, not us
+    m["what"] = ("control-plane fan-in: direct-to-root vs per-pod "
+                 "relayed (threads simulate hosts on this box; "
+                 "multipod/relay.py)")
+    return m
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="SCALING_r06.json")
@@ -154,23 +181,40 @@ def main(argv=None):
     ap.add_argument("--no-fast-path", action="store_true",
                     help="negotiate every step (pre-plan-cache rows, "
                          "SCALING_r05 methodology)")
+    ap.add_argument("--pods", type=int, default=0,
+                    help="add the relayed-vs-direct control-plane "
+                         "fan-in section with this many simulated "
+                         "pods")
+    ap.add_argument("--hosts-per-pod", type=int, default=4)
+    ap.add_argument("--fanin-only", action="store_true",
+                    help="with --pods: skip the eager weak-scaling "
+                         "worlds")
     args = ap.parse_args(argv)
-    rows = []
-    for size in [int(s) for s in args.worlds.split(",")]:
-        row = run_world(size, fast_path=not args.no_fast_path)
-        rows.append(row)
-        print(json.dumps(row), flush=True)
-    base = rows[0]["negotiation_ms_per_step"]["median"] or 1e-9
-    report = {
-        "what": "native eager control-plane weak scaling (LoopbackExecutor "
-                "isolates control-plane cost; single host, spawn procs; "
-                "fast_path=%s)" % (not args.no_fast_path),
-        "rows": rows,
-        "median_growth_vs_1proc": [
-            round(r["negotiation_ms_per_step"]["median"] / base, 2)
-            for r in rows
-        ],
-    }
+    report = {}
+    if not (args.pods and args.fanin_only):
+        rows = []
+        for size in [int(s) for s in args.worlds.split(",")]:
+            row = run_world(size, fast_path=not args.no_fast_path)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+        base = rows[0]["negotiation_ms_per_step"]["median"] or 1e-9
+        report = {
+            "what": "native eager control-plane weak scaling "
+                    "(LoopbackExecutor isolates control-plane cost; "
+                    "single host, spawn procs; fast_path=%s)"
+                    % (not args.no_fast_path),
+            "rows": rows,
+            "median_growth_vs_1proc": [
+                round(r["negotiation_ms_per_step"]["median"] / base, 2)
+                for r in rows
+            ],
+        }
+    if args.pods:
+        fanin = run_fanin(args.pods, args.hosts_per_pod)
+        print(json.dumps(fanin), flush=True)
+        report["relay_fanin"] = fanin
+        if "what" not in report:
+            report["what"] = fanin["what"]
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps({"written": args.out}))

@@ -271,8 +271,41 @@ def summarize(records):
             print("retry GIVE-UPS: " + ", ".join(
                 f"{p}={int(n)}" for p, n in sorted(giveups.items())))
 
+    summarize_pods(records)
     summarize_decode(decode_events)
     summarize_autotune(autotune_events)
+
+
+def summarize_pods(records):
+    """Per-pod rollup alongside the per-rank view: step records carry
+    a ``pod`` field under a multipod topology (utils/metrics.py stamps
+    the relay's pod label), and a JSONL concatenated across ranks —
+    or one rank per pod — rolls up by it. Silent when no record is
+    pod-labeled (the single-pod world)."""
+    by_pod = {}
+    for r in records:
+        pod = r.get("pod")
+        if pod:
+            by_pod.setdefault(pod, []).append(r)
+    if not by_pod:
+        return
+    print("\nper-pod rollup:")
+    width = max(max(len(p) for p in by_pod), len("pod"))
+    print(f"  {'pod':<{width}}  {'steps':>6}  {'p50 ms':>8}  "
+          f"{'p90 ms':>8}  {'grad bytes':>12}  {'retries':>8}")
+    for pod in sorted(by_pod):
+        rs = by_pod[pod]
+        times = sorted(r["step_time_s"] for r in rs)
+        grad = sum(r.get("grad_bytes", 0) for r in rs)
+        retries = sum(n for r in rs
+                      for n in r.get("retries", {}).values())
+        print(f"  {pod:<{width}}  {len(rs):>6}  "
+              f"{percentile(times, 0.50) * 1e3:>8.2f}  "
+              f"{percentile(times, 0.90) * 1e3:>8.2f}  "
+              f"{_human_bytes(grad):>12}  {retries:>8}")
+    unlabeled = len(records) - sum(len(v) for v in by_pod.values())
+    if unlabeled:
+        print(f"  ({unlabeled} records without a pod label)")
 
 
 def main(argv=None):

@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""Multi-pod federation smoke gate (the 13th run_all_checks gate).
+
+Simulates an N-pod fleet on this CPU host — pods as XLA replica groups
+for the numerics, pods as relay servers + pusher threads for the
+control plane — and gates the four multipod claims (docs/multipod.md):
+
+1. **relay fan-in** — a 4-pod x 4-host world pushing metrics
+   expositions through per-pod relays cuts the root server's request
+   count by >= the pod fan-in factor (hosts per pod) versus every
+   host pushing direct, and the root's aggregated /metrics carries
+   ``pod=`` labels and lints clean;
+2. **localK convergence envelope** — the local-SGD outer loop
+   (K local steps per pod + cross-pod parameter averaging over the
+   int8-quantized DCN leg, outer momentum) trains the toy regression
+   to within the documented envelope of the fully-synchronous
+   baseline (final localK loss <= ENVELOPE x sync loss + ABS_FLOOR);
+3. **K=1 bitwise parity** — ``HOROVOD_MULTIPOD_SYNC=local1``
+   normalizes to the plain synchronous path, so its trained
+   parameters are bit-for-bit identical to the plain SPMD run;
+4. **root failover with relays attached** — a root restart from its
+   persisted state (the PR 7 same-port failover) loses nothing: pre-
+   failover relayed records survive the restart, records pushed
+   during the outage sit coalesced in the relay and land after it.
+
+Usage: python scripts/multipod_check.py [--check] [--out FILE.json]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_FLAG = "--xla_force_host_platform_device_count=8"
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+N_PODS = 4
+HOSTS_PER_POD = 4
+PUSHES_PER_HOST = 5
+
+K_LOCAL = 4
+STEPS = 120
+OUTER_MOMENTUM = 0.5
+# documented convergence envelope (docs/multipod.md): the localK final
+# loss may trail the sync baseline by at most this factor (plus a
+# floor for losses already at numerical zero)
+ENVELOPE = 1.5
+ABS_FLOOR = 1e-4
+
+
+def _put(addr, port, path, body):
+    from horovod_tpu.multipod.fanin import put_with_retry
+
+    put_with_retry(addr, port, path, body)
+
+
+# ---------------------------------------------------------------------------
+# 1. relay fan-in reduction
+# ---------------------------------------------------------------------------
+
+def check_relay_fanin():
+    from horovod_tpu.multipod.fanin import measure_fanin
+    from horovod_tpu.utils import metrics
+
+    m = measure_fanin(N_PODS, HOSTS_PER_POD,
+                      pushes_per_host=PUSHES_PER_HOST)
+    pushed = m.pop("pushed")
+    _ctype, body = metrics.exposition(pushed)
+    text = body.decode()
+    lint = metrics.lint_exposition(text)
+    pod_labeled = sum(
+        1 for line in text.splitlines()
+        if 'pod="pod' in line and 'rank="' in line)
+    row = {
+        "pods": N_PODS,
+        "hosts": m["hosts"],
+        "pushes_per_host": PUSHES_PER_HOST,
+        "root_requests_direct": m["direct"]["root_requests"],
+        "root_requests_relayed": m["relayed"]["root_requests"],
+        "reduction_x": m["root_request_reduction_x"],
+        "required_reduction_x": HOSTS_PER_POD,
+        "aggregated_series_with_pod_label": pod_labeled,
+        "exposition_lint_errors": lint,
+        "all_ranks_aggregated": len(pushed) == N_PODS * HOSTS_PER_POD,
+    }
+    ok = (row["reduction_x"] >= HOSTS_PER_POD and not lint
+          and pod_labeled > 0 and row["all_ranks_aggregated"])
+    return ok, row
+
+
+# ---------------------------------------------------------------------------
+# 2 + 3. localK convergence + K=1 bitwise parity (8-dev CPU mesh)
+# ---------------------------------------------------------------------------
+
+def _build_world():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init()
+    assert hvd.size() == 8, "check expects 8 virtual devices"
+    return hvd
+
+
+def _train(hvd, sync_spec, steps=STEPS, lr=0.1, wire=None):
+    """Toy linear regression, per-rank data shards; returns (final
+    per-rank params ndarray, loss history). sync_spec routes through
+    parse_sync_mode exactly as a user knob would."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.compat import shard_map
+    from horovod_tpu.multipod.localsgd import (
+        LocalSGD, OuterState, local_sgd_active, parse_sync_mode)
+    from horovod_tpu.multipod.topology import PodTopology
+
+    topo = PodTopology(n_pods=N_PODS, pod_id=0, world=8)
+    active = local_sgd_active(topo, sync_spec)
+    _mode, k = parse_sync_mode(sync_spec)
+    ls = LocalSGD(topo, k, outer_momentum=OUTER_MOMENTUM,
+                  wire=wire) if active else None
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(6, 1).astype(np.float32)
+    x_all = rng.randn(8, 32, 6).astype(np.float32)
+    y_all = x_all @ w_true + 0.01 * rng.randn(8, 32, 1).astype(
+        np.float32)
+    mesh = hvd.mesh()
+
+    def loss_fn(w, x, y):
+        return jnp.mean((x @ w - y) ** 2)
+
+    def plain_step(w, x, y):
+        g = jax.grad(loss_fn)(w, x, y)
+        g = jax.lax.pmean(g, "hvd")
+        return w - lr * g
+
+    def local_step(w, x, y):
+        g = jax.grad(loss_fn)(w, x, y)
+        g = ls.inner_mean(g)
+        return w - lr * g
+
+    inner = local_step if active else plain_step
+
+    def body(w, x, y):
+        # per-rank leading dim of 1 in, 1 out: the stacked global
+        # arrays keep the (world, ...) shape across steps
+        return inner(w[0], x[0], y[0])[None]
+
+    step = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P("hvd"),) * 3,
+        out_specs=P("hvd"), check_vma=False))
+    sync_step = None
+    if active:
+        def sync_body(w, a, v):
+            p, st2 = ls.outer_sync(
+                w[0], OuterState(anchor=a[0], velocity=v[0]))
+            return p[None], st2.anchor[None], st2.velocity[None]
+
+        sync_step = jax.jit(shard_map(
+            sync_body, mesh=mesh, in_specs=(P("hvd"),) * 3,
+            out_specs=(P("hvd"),) * 3, check_vma=False))
+
+    w0 = np.zeros((6, 1), np.float32)
+    w = jnp.asarray(np.tile(w0[None], (8, 1, 1)))
+    anchor = w
+    vel = jnp.zeros_like(w)
+    x = jnp.asarray(x_all)
+    y = jnp.asarray(y_all)
+    losses = []
+    for s in range(steps):
+        w = step(w, x, y)
+        if ls is not None and ls.should_sync(s):
+            w, anchor, vel = sync_step(w, anchor, vel)
+        wl = np.asarray(w)
+        losses.append(float(np.mean(
+            (np.einsum("rbi,rio->rbo", np.asarray(x_all), wl)
+             - y_all) ** 2)))
+    return np.asarray(w), losses
+
+
+def check_localsgd():
+    from horovod_tpu.optim.compression import WireSpec
+
+    hvd = _build_world()
+    try:
+        w_sync, loss_sync = _train(hvd, "sync")
+        w_local, loss_local = _train(
+            hvd, f"local{K_LOCAL}", wire=WireSpec("int8", 64))
+        # K=1: parse_sync_mode normalizes local1 to sync → plain path
+        w_k1, _ = _train(hvd, "local1")
+    finally:
+        hvd.shutdown()
+    import numpy as np
+
+    envelope_ok = (
+        loss_local[-1] <= ENVELOPE * loss_sync[-1] + ABS_FLOOR)
+    parity_ok = np.array_equal(w_k1, w_sync)
+    pods_agree = bool(np.allclose(
+        np.asarray(w_local).reshape(8, -1).std(axis=0).max(), 0.0,
+        atol=1e-6))
+    row = {
+        "k": K_LOCAL,
+        "outer_momentum": OUTER_MOMENTUM,
+        "wire": "int8/64",
+        "steps": STEPS,
+        "sync_final_loss": loss_sync[-1],
+        "localk_final_loss": loss_local[-1],
+        "envelope_factor": ENVELOPE,
+        "envelope_ok": envelope_ok,
+        "k1_bitwise_parity": parity_ok,
+        "pods_agree_after_final_sync": pods_agree,
+    }
+    return (envelope_ok and parity_ok and pods_agree), row
+
+
+# ---------------------------------------------------------------------------
+# 4. root failover with relays attached
+# ---------------------------------------------------------------------------
+
+def check_failover():
+    from horovod_tpu.multipod.relay import PodRelayServer
+    from horovod_tpu.runner.http.http_server import KVStoreServer
+
+    with tempfile.TemporaryDirectory(prefix="hvd_multipod_") as d:
+        state = os.path.join(d, "root_state.pkl")
+        root = KVStoreServer(state_path=state, flush_interval_s=0.05)
+        rport = root.start_server()
+        relay = PodRelayServer("pod0", ("127.0.0.1", rport),
+                               flush_interval_s=0.05)
+        lport = relay.start_server()
+        try:
+            _put("127.0.0.1", lport, "metrics_push/0", b"pre-failover")
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                with root.lock:
+                    if root.store.get("metrics_push"):
+                        break
+                time.sleep(0.02)
+            root.persist()
+            root.shutdown_server()  # the outage
+
+            # push during the outage: coalesces in the relay, forward
+            # retries fail quietly (Outage discipline)
+            _put("127.0.0.1", lport, "metrics_push/1", b"during-outage")
+            time.sleep(0.3)
+
+            # failover: a fresh server on the SAME state path rebinds
+            # the persisted port (PR 7) and the relay reconnects
+            root2 = KVStoreServer(state_path=state,
+                                  flush_interval_s=0.05)
+            port2 = root2.start_server()
+            same_port = port2 == rport
+            deadline = time.time() + 20.0
+            got = {}
+            while time.time() < deadline:
+                relay.flush_once()
+                with root2.lock:
+                    got = dict(root2.store.get("metrics_push", {}))
+                if "0@pod0" in got and "1@pod0" in got:
+                    break
+                time.sleep(0.05)
+            restored = got.get("0@pod0") == b"pre-failover"
+            recovered = got.get("1@pod0") == b"during-outage"
+            root2.shutdown_server()
+        finally:
+            relay.shutdown_server()
+    row = {
+        "root_rebound_same_port": same_port,
+        "pre_failover_record_restored": restored,
+        "outage_record_delivered_after_failover": recovered,
+    }
+    return (same_port and restored and recovered), row
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero on any failed claim")
+    ap.add_argument("--out", default="",
+                    help="write the verdict JSON here too")
+    args = ap.parse_args(argv)
+
+    verdict = {"what": "multipod federation smoke "
+                       f"({N_PODS} simulated pods)"}
+    ok_all = True
+    for name, fn in (("relay_fanin", check_relay_fanin),
+                     ("localsgd", check_localsgd),
+                     ("failover", check_failover)):
+        t0 = time.perf_counter()
+        try:
+            ok, row = fn()
+        except Exception as e:
+            ok, row = False, {"error": repr(e)}
+        row["ok"] = ok
+        row["wall_s"] = round(time.perf_counter() - t0, 2)
+        verdict[name] = row
+        ok_all = ok_all and ok
+        print(f"[{name}] {'OK' if ok else 'FAIL'} "
+              f"in {row['wall_s']}s", flush=True)
+    verdict["ok"] = ok_all
+    txt = json.dumps(verdict, indent=1)
+    print(txt)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(txt + "\n")
+    if args.check and not ok_all:
+        print("multipod check FAILED")
+        return 1
+    print("multipod check OK" if ok_all else
+          "multipod check FAILED (advisory)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

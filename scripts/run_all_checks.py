@@ -46,6 +46,13 @@ locally before the full pytest tier:
   int8 KV within the documented tolerance, and the replica autoscaler
   grows then SIGTERM-drains (exit 83) a world-2 replica off the live
   queue-wait/occupancy gauges with zero client-visible failures);
+* ``multipod`` — ``scripts/multipod_check.py --check`` (multi-pod
+  federation on simulated pods: per-pod relays cut the root server's
+  request count by >= the pod fan-in factor with a pod-labeled
+  aggregated /metrics, the localK outer loop trains inside the
+  documented envelope of the sync baseline over the int8 DCN leg,
+  K=1 is bitwise-identical to the plain SPMD path, and a root
+  failover with relays attached loses nothing);
 * ``perf`` — ``scripts/perf_baseline.py --check`` (the perf-regression
   gate: structural invariants — fast-path engaged, zero steady
   negotiated bytes, profiler sampled + attributed inside its duty
@@ -253,6 +260,23 @@ def check_decode():
     ], env=env)
 
 
+def check_multipod():
+    """The multi-pod federation gate (13th): relay fan-in reduction,
+    localK convergence envelope, K=1 bitwise parity, root failover
+    with relays attached."""
+    env = _env()
+    if "xla_force_host_platform_device_count" not in env.get(
+            "XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "multipod_check.py"),
+        "--check",
+    ], env=env)
+
+
 def check_perf():
     """The perf-regression gate + the merged-trace smoke (one gate:
     both run the unified-observability stack end-to-end)."""
@@ -281,6 +305,7 @@ GATES = [
     ("fsdp", check_fsdp),
     ("autotune", check_autotune),
     ("decode", check_decode),
+    ("multipod", check_multipod),
     ("perf", check_perf),
 ]
 

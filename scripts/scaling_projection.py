@@ -38,6 +38,57 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 ICI_LINKS = 4
 ICI_GBPS_PER_LINK = 50e9
 
+# -- DCN tier (multipod projection inputs; all falsifiable) -----------------
+# One pod = the 16x16 all-ICI slice of the base projection. Cross-pod
+# traffic leaves over the hosts' data-center NICs: public v5e hosts
+# carry 8 chips behind ~100 Gbps of DCN each.
+POD_CHIPS = 256
+CHIPS_PER_HOST = 8
+DCN_BYTES_PER_SEC_PER_HOST = 100e9 / 8  # 100 Gbps NIC
+# per-hop one-way DCN latency a cross-pod ring step pays (conservative
+# switched-fabric figure; HOROVOD_MULTIPOD_DCN_HOPS scales it)
+DCN_HOP_LATENCY_S = 100e-6
+# measured wire-byte reduction of the int8 block-quantized DCN leg
+# (payload + scales; compression_check.py gates >= 3.5x, measured 3.9)
+INT8_WIRE_FACTOR = 1 / 3.9
+
+
+def project_multipod(step_s, grad_bytes, ici_eff, n_pods, wire_factor,
+                     local_k, dcn_hops=1):
+    """Efficiency of N pods around the measured single-pod point.
+
+    Hierarchical allreduce moves 1/pod of the bytes per rank on the
+    outer leg, but ALL ranks' shards cross DCN: total bytes leaving a
+    pod per sync = ring-allreduce cost 2(P-1)/P x G (x wire_factor),
+    through the pod's aggregate NIC bandwidth. localK amortizes one
+    sync over K steps (multipod/localsgd.py); sync mode pays it every
+    step. Latency term: (P-1) ring steps x hop latency. The DCN leg is
+    conservatively fully exposed (no overlap credit)."""
+    hosts = POD_CHIPS // CHIPS_PER_HOST
+    pod_dcn_bw = hosts * DCN_BYTES_PER_SEC_PER_HOST
+    if n_pods == 1:
+        return {
+            "pods": n_pods, "chips": POD_CHIPS,
+            "t_dcn_ms_per_step": 0.0,
+            "efficiency": round(ici_eff, 4),
+        }
+    t_wire = 2 * (n_pods - 1) / n_pods * grad_bytes * wire_factor \
+        / pod_dcn_bw
+    t_lat = (n_pods - 1) * dcn_hops * DCN_HOP_LATENCY_S
+    t_sync = t_wire + t_lat
+    t_per_step = t_sync / local_k
+    # ici_eff already discounts the intra-pod exposed wire; the DCN
+    # term stacks on top of the same measured step time
+    t_ici_exposed = step_s / ici_eff - step_s
+    eff = step_s / (step_s + t_ici_exposed + t_per_step)
+    return {
+        "pods": n_pods,
+        "chips": n_pods * POD_CHIPS,
+        "t_dcn_sync_ms": round(t_sync * 1e3, 3),
+        "t_dcn_ms_per_step": round(t_per_step * 1e3, 3),
+        "efficiency": round(eff, 4),
+    }
+
 MODELS = {
     # params from the bench vehicles (fp32 master grads on the wire)
     "resnet50": {
@@ -153,6 +204,18 @@ def main(argv=None):
                          "replaces the unscheduled one in a second "
                          "projection (default: newest in repo root)")
     ap.add_argument("--out", default="SCALING_PROJECTION_r05.json")
+    ap.add_argument("--multipod-out", default="",
+                    help="also write the N-pod DCN-tier projection "
+                         "(MULTIPOD_PROJECTION_r01.json): sync vs "
+                         "localK outer loop x fp32 vs int8 DCN wire "
+                         "over 1/2/4/8 pods of 256 chips")
+    ap.add_argument("--dcn-hops", type=int,
+                    default=int(os.environ.get(
+                        "HVD_TPU_MULTIPOD_DCN_HOPS",
+                        os.environ.get("HOROVOD_MULTIPOD_DCN_HOPS",
+                                       "1"))),
+                    help="worst-case inter-pod DCN hops scaling the "
+                         "latency term of the multipod projection")
     args = ap.parse_args(argv)
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -295,6 +358,71 @@ def main(argv=None):
     print(txt)
     with open(os.path.join(root, args.out), "w") as f:
         f.write(txt + "\n")
+
+    if args.multipod_out:
+        # the DCN tier: each model's 256-chip projection (the measured
+        # all-ICI point, scheduled window when available) extended to
+        # N pods under the four sync x wire disciplines the multipod
+        # subsystem offers (docs/multipod.md)
+        mp = {
+            "what": "analytic N-pod DCN-tier projection around the "
+                    "256-chip all-ICI point (one pod = the base "
+                    "projection's 16x16 slice)",
+            "formula": "eff = t_step / (t_step + t_ici_exposed + "
+                       "(2(P-1)/P * G * wire / B_dcn_pod + "
+                       "(P-1)*hops*lat) / K)",
+            "inputs": {
+                "pod_chips": POD_CHIPS,
+                "chips_per_host": CHIPS_PER_HOST,
+                "dcn_bytes_per_sec_per_host":
+                    DCN_BYTES_PER_SEC_PER_HOST,
+                "dcn_hop_latency_s": DCN_HOP_LATENCY_S,
+                "dcn_hops": args.dcn_hops,
+                "int8_wire_factor": round(INT8_WIRE_FACTOR, 4),
+                "overlap_source": overlap_src,
+                "dcn_overlap": "none (conservative: the outer leg is "
+                               "fully exposed)",
+                "localk_caveat": "localK rows amortize wire+latency "
+                                 "over K steps; the numerics envelope "
+                                 "vs sync is measured separately "
+                                 "(scripts/multipod_check.py, "
+                                 "docs/multipod.md)",
+            },
+            "models": {},
+        }
+        modes = [
+            ("sync_fp32", 1.0, 1),
+            ("sync_int8", INT8_WIRE_FACTOR, 1),
+            ("local8_fp32", 1.0, 8),
+            ("local8_int8", INT8_WIRE_FACTOR, 8),
+        ]
+        eff_window = (overlap_sched if overlap_sched is not None
+                      else overlap_frac)
+        for mname, block in out["models"].items():
+            step_s = block["step_ms_per_chip"] / 1e3
+            g = block["grad_bytes"]
+            rows = (block.get("projection_scheduled")
+                    or block["projection"])
+            ici_eff = next(
+                (r["efficiency"] for r in rows
+                 if r["chips"] == POD_CHIPS), rows[-1]["efficiency"])
+            mp["models"][mname] = {
+                "step_ms_per_chip": block["step_ms_per_chip"],
+                "grad_bytes": g,
+                "ici_efficiency_256": ici_eff,
+                "overlap_window_used": eff_window,
+                "modes": {
+                    name: [project_multipod(step_s, g, ici_eff, p,
+                                            wf, k,
+                                            dcn_hops=args.dcn_hops)
+                           for p in (1, 2, 4, 8)]
+                    for name, wf, k in modes
+                },
+            }
+        mtxt = json.dumps(mp, indent=1)
+        print(mtxt)
+        with open(os.path.join(root, args.multipod_out), "w") as f:
+            f.write(mtxt + "\n")
 
 
 if __name__ == "__main__":

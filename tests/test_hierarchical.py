@@ -173,3 +173,133 @@ def test_two_axis_allgather_matches_flat(hvd8):
             check_vma=False,
         ))(xs)
     np.testing.assert_allclose(np.asarray(out_hier), np.asarray(out_flat))
+
+
+# ------------------------- non-power-of-two pod counts, int8 outer leg
+#
+# Multi-pod fleets are not power-of-two shaped (a pod is whatever the
+# scheduler granted); the DCN outer leg — including the int8
+# quantized-shards + scales-gather path — must be correct at 3 and 5
+# pods, where the outer replica groups are odd-sized and the padded
+# shard lengths don't align with the pod count (docs/multipod.md).
+
+
+def _pod_mesh(n_pods, pod_size):
+    devices = np.asarray(jax.devices()[: n_pods * pod_size]).reshape(
+        n_pods, pod_size)
+    return Mesh(devices, ("dcn", "ici"))
+
+
+def _wire(block=32):
+    from horovod_tpu.optim.compression import WireSpec
+
+    return WireSpec("int8", block)
+
+
+@pytest.mark.parametrize("n_pods,pod_size", [(3, 2), (5, 1)])
+@pytest.mark.parametrize("shape", [(17,), (4, 5)])
+def test_nonpow2_pods_int8_outer_leg(hvd8, n_pods, pod_size, shape):
+    """hierarchical_psum over dcn=3/5 pods with the int8 wire matches
+    the flat sum to quantization tolerance — exercising odd outer
+    group counts AND the scales-gather path (scales ride a second
+    all_gather whose concat order must match the payload's)."""
+    mesh = _pod_mesh(n_pods, pod_size)
+    world = n_pods * pod_size
+    x = jnp.asarray(
+        np.random.RandomState(7).uniform(-2, 2, (world,) + shape),
+        dtype=jnp.float32)
+    sizes = {"dcn": n_pods, "ici": pod_size}
+    wire = _wire()
+
+    def flat(t):
+        return jax.lax.psum(t[0][0], ("dcn", "ici"))
+
+    def hier(t):
+        return hierarchical.hierarchical_psum(
+            t[0][0], ("dcn", "ici"), sizes, wire=wire)
+
+    xs = x.reshape((n_pods, pod_size) + shape)
+    with mesh:
+        out_flat = jax.jit(shard_map(
+            flat, mesh=mesh, in_specs=P("dcn", "ici"), out_specs=P(),
+            check_vma=False))(xs)
+        out_hier = jax.jit(shard_map(
+            hier, mesh=mesh, in_specs=P("dcn", "ici"), out_specs=P(),
+            check_vma=False))(xs)
+    # int8 tolerance: per-block scale quantization of each pod's
+    # inner-reduced shard, summed over n_pods contributions
+    ref = np.asarray(out_flat)
+    tol = n_pods * np.abs(ref).max() / 127.0 + 1e-5
+    np.testing.assert_allclose(np.asarray(out_hier), ref, atol=tol)
+
+
+@pytest.mark.parametrize("n_pods", [3, 5])
+def test_nonpow2_pods_int8_scales_gather_in_hlo(hvd8, n_pods):
+    """The lowered outer leg must carry TWO all-gathers (quantized
+    payload + scales) and no outer all-reduce — the int8 leg gathers
+    and dequant-accumulates locally instead of reducing on the wire."""
+    pod_size = 8 // n_pods if 8 // n_pods >= 1 else 1
+    pod_size = max(pod_size if n_pods * pod_size <= 8 else 1, 1)
+    mesh = _pod_mesh(n_pods, pod_size)
+    sizes = {"dcn": n_pods, "ici": pod_size}
+    wire = _wire()
+
+    def hier(t):
+        return hierarchical.hierarchical_psum(
+            t[0][0], ("dcn", "ici"), sizes, wire=wire)
+
+    xs = jnp.zeros((n_pods, pod_size, 40), jnp.float32)
+    with mesh:
+        hlo = str(jax.jit(shard_map(
+            hier, mesh=mesh, in_specs=P("dcn", "ici"), out_specs=P(),
+            check_vma=False)).lower(xs).as_text())
+    assert hlo.count("all_gather") >= 2  # payload + scales legs
+    # int8 payload on the wire: an i8-typed gather operand must appear
+    assert "xi8>" in hlo
+
+
+@pytest.mark.parametrize("n_pods", [3, 5])
+def test_nonpow2_pods_int8_error_feedback_residual(hvd8, n_pods):
+    """The residual path at odd pod counts: feeding the returned
+    residual back into the next call must beat two residual-less
+    calls' accumulated bias (the error-feedback contract,
+    docs/compression.md) — and the residual equals payload minus its
+    own quantization on the rank's shard."""
+    pod_size = 1
+    mesh = _pod_mesh(n_pods, pod_size)
+    sizes = {"dcn": n_pods, "ici": pod_size}
+    wire = _wire(block=16)
+    world = n_pods * pod_size
+    shape = (23,)
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.uniform(-1, 1, (world,) + shape), jnp.float32)
+
+    def with_res(t):
+        t = t[0][0]
+        y, res = hierarchical.hierarchical_psum(
+            t, ("dcn", "ici"), sizes, wire=wire,
+            residual=jnp.zeros(shape, jnp.float32))
+        y2, _ = hierarchical.hierarchical_psum(
+            t, ("dcn", "ici"), sizes, wire=wire, residual=res)
+        return y, y2
+
+    def flat(t):
+        return jax.lax.psum(t[0][0], ("dcn", "ici"))
+
+    xs = x.reshape((n_pods, pod_size) + shape)
+    with mesh:
+        y1, y2 = jax.jit(shard_map(
+            with_res, mesh=mesh, in_specs=P("dcn", "ici"),
+            out_specs=(P(), P()), check_vma=False))(xs)
+        ref = jax.jit(shard_map(
+            flat, mesh=mesh, in_specs=P("dcn", "ici"), out_specs=P(),
+            check_vma=False))(xs)
+    ref = np.asarray(ref)
+    # second call compensated by the first's residual: its TOTAL error
+    # (bias of payload+residual) stays within one quantization step,
+    # where an uncompensated repeat would carry the same bias twice
+    err1 = np.abs(np.asarray(y1) - ref).max()
+    err2 = np.abs(np.asarray(y2) - ref).max()
+    tol = n_pods * np.abs(ref).max() / 127.0 + 1e-5
+    assert err1 <= tol
+    assert err2 <= 2 * tol
