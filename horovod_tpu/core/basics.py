@@ -242,6 +242,14 @@ def init(
 
         prof.configure(st.knobs)
 
+        # fleet-health monitor (horovod_tpu/health): detectors over the
+        # step stream, SLO rule engine and the rank-summary publisher.
+        # After metrics/flight/prof — it registers observers with
+        # metrics and triggers captures through flight/prof.
+        from .. import health
+
+        health.configure(st.knobs)
+
         # fault injection (utils/faults.py): the module already armed
         # itself from the env at import (worker processes need that);
         # an explicitly-knobbed spec re-compiles here so HVD_TPU_
@@ -337,8 +345,10 @@ def shutdown() -> None:
             st.eager_runtime.shutdown()
         if st.timeline is not None:
             st.timeline.close()
+        from .. import health
         from ..utils import flight, metrics, prof
 
+        health.on_shutdown()  # before metrics: unhooks the observers
         prof.on_shutdown()  # before metrics: joins an in-flight parse
         metrics.on_shutdown()
         flight.on_shutdown()

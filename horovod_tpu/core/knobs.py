@@ -299,6 +299,30 @@ class Knobs:
     flight_dir: str = ""  # dump directory; "" = <tmpdir>/hvd_flight
     flight_capacity: int = 4096  # events kept in the ring
 
+    # --- fleet-health monitor (horovod_tpu/health, docs/health.md) ---
+    # live straggler/anomaly detection + SLO burn-rate alerting over
+    # the StepStats/serving streams; off by default (the metrics-side
+    # observer slot stays None — zero step-path cost)
+    health_enabled: bool = False
+    # rank-summary publish cadence to the fleet evaluator (the metrics
+    # push / pod-relay route); also the serving rule-evaluation tick
+    health_interval_s: float = 2.0
+    # detector sliding-window size (steps) and warmup before envelopes
+    # may fire
+    health_window: int = 32
+    health_min_steps: int = 8
+    # step-time envelope factor vs the rolling median / the autotuner's
+    # persisted per-(model, topology) baseline
+    health_step_time_factor: float = 1.75
+    # declarative rule spec (docs/health.md grammar); "" = DEFAULT_RULES
+    health_rules: str = ""
+    # JSONL incident log (fire/clear transitions); "" = step-log events
+    # only (metrics_file out-of-band lines)
+    health_incident_file: str = ""
+    # anomaly-triggered forensics: flight dump + forced prof sample on
+    # a firing rule
+    health_capture: bool = True
+
     # --- logging ---
     log_level: str = "WARNING"
     log_hide_timestamp: bool = False
@@ -449,6 +473,16 @@ class Knobs:
             flight_recorder=_env_bool("FLIGHT_RECORDER", True),
             flight_dir=_env("FLIGHT_DIR", "") or "",
             flight_capacity=_env_int("FLIGHT_CAPACITY", 4096),
+            health_enabled=_env_bool("HEALTH", False),
+            health_interval_s=_env_float("HEALTH_INTERVAL_S", 2.0),
+            health_window=_env_int("HEALTH_WINDOW", 32),
+            health_min_steps=_env_int("HEALTH_MIN_STEPS", 8),
+            health_step_time_factor=_env_float(
+                "HEALTH_STEP_TIME_FACTOR", 1.75
+            ),
+            health_rules=_env("HEALTH_RULES", "") or "",
+            health_incident_file=_env("HEALTH_INCIDENT_FILE", "") or "",
+            health_capture=_env_bool("HEALTH_CAPTURE", True),
             log_level=_env("LOG_LEVEL", "WARNING") or "WARNING",
             log_hide_timestamp=_env_bool("LOG_HIDE_TIME", False),
             log_rank=_env_bool("LOG_RANK", False),

@@ -47,6 +47,7 @@ import threading
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
+from ..health.fleet import HEALTH_SCOPE as _HEALTH_SCOPE
 from ..runner.http.http_server import RELAY_BATCH_PATH, KVStoreServer
 from ..utils import retry as _retry
 from ..utils.metrics import METRICS_PUSH_SCOPE
@@ -143,9 +144,11 @@ class PodRelayServer(KVStoreServer):
         if self.forward_scopes is not None \
                 and scope not in self.forward_scopes:
             return
-        if scope == METRICS_PUSH_SCOPE and "@" not in key:
+        if scope in (METRICS_PUSH_SCOPE, _HEALTH_SCOPE) \
+                and "@" not in key:
             # pod-label the rank key so the root's aggregated /metrics
-            # emits rank="<r>",pod="<label>" series (docs/multipod.md)
+            # emits rank="<r>",pod="<label>" series — and the root's
+            # /health verdict names ranks per pod (docs/multipod.md)
             key = f"{key}@{self.pod_label}"
         with self._pending_lock:
             self._pending[(scope, key)] = value

@@ -121,6 +121,23 @@ class _KVHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if path == "/health":
+            # fleet-health verdict (horovod_tpu/health): fold every
+            # rank's pushed summary (/health/<rank>, pod-labeled by the
+            # relay) into one live verdict naming suspected straggler
+            # ranks — the runtime analogue of flight.straggler_report.
+            # Single-segment path like /metrics; GET /health/<rank>
+            # still reads one raw summary through the scope namespace.
+            from ...health import fleet
+
+            with self.server.lock:  # type: ignore[attr-defined]
+                pushed = dict(
+                    self.server.store.get(  # type: ignore[attr-defined]
+                        fleet.HEALTH_SCOPE, {})
+                )
+            self._reply(200, json.dumps(
+                fleet.evaluate_store(pushed)).encode())
+            return
         if path == "/clock":
             # clock-alignment ping for the flight recorder: workers
             # stamp each dump with (server time - local time) measured
@@ -446,6 +463,7 @@ class RendezvousServer(KVStoreServer):
 
     def init(self, host_assignments: List[SlotInfo]) -> int:
         """Publish a new round of slot assignments; returns server port."""
+        from ...health.fleet import HEALTH_SCOPE
         from ...utils.metrics import METRICS_PUSH_SCOPE
 
         if not self._thread.is_alive():
@@ -465,8 +483,11 @@ class RendezvousServer(KVStoreServer):
             # departed ranks' metric pushes would serve forever on the
             # aggregated scrape. The elastic driver persists dumps to
             # disk before calling init (driver._persist_flight_dumps).
+            # health summaries age out the same way: a departed rank's
+            # last summary would read as "silent" (= suspected
+            # straggler) on every later round's verdict
             for stale in (FLIGHT_SCOPE, FLIGHT_META_SCOPE,
-                          METRICS_PUSH_SCOPE):
+                          METRICS_PUSH_SCOPE, HEALTH_SCOPE):
                 self.store.pop(stale, None)
         self._round += 1
         # barrier-persist the new round before workers can see it: a

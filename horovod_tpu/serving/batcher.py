@@ -309,6 +309,11 @@ class DynamicBatcher:
                 if tl is not None:
                     tl.activity_end(self._span_key, SERVING_EXEC)
             off = 0
+            done_t = self._clock()
             for p in live:
                 p.set_result(np.asarray(y)[off:off + p.n])
                 off += p.n
+                # one-shot predict: the whole answer IS the first
+                # token, so TTFT = enqueue to result. Classless
+                # requests bill to the default "standard" SLO class.
+                metrics.record_serving_ttft(done_t - p.enqueue_t)

@@ -629,7 +629,8 @@ class GenerationEngine:
         return first, np.asarray(last)
 
     def decode(self, tokens: np.ndarray, lengths: np.ndarray,
-               return_logits: bool = False
+               return_logits: bool = False,
+               slos: Optional[Sequence[str]] = None,
                ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """One iteration: append ``tokens[i]`` at position
         ``lengths[i]`` in every slot i and return ``(next_tokens,
@@ -637,7 +638,13 @@ class GenerationEngine:
         ``return_logits`` — the steady-state program keeps logits on
         device; the flag exists for the tolerance tests). Inactive
         slots ride along (their outputs are ignored; pass length 0 so
-        their write lands in a row the next prefill overwrites)."""
+        their write lands in a row the next prefill overwrites).
+
+        ``slos`` names the SLO class of each LIVE sequence this
+        iteration advances (the scheduler passes one entry per
+        occupied slot): the iteration's wall time is then billed to
+        each as its time-per-output-token
+        (``hvd_serving_tpot_seconds{slo=...}``)."""
         import jax.numpy as jnp
 
         tokens = np.asarray(tokens, np.int32).reshape(self.spec.slots)
@@ -653,8 +660,14 @@ class GenerationEngine:
             else:
                 self._cache, nxt = out
                 last = None
-        metrics.record_decode_iteration(
-            int(self.spec.slots), time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        metrics.record_decode_iteration(int(self.spec.slots), dt)
+        if slos:
+            # every live sequence got exactly one token out of this
+            # iteration, so the iteration's wall time IS each one's
+            # per-output-token latency
+            for slo in slos:
+                metrics.record_serving_tpot(dt, slo=slo)
         return (np.asarray(nxt),
                 np.asarray(last) if last is not None else None)
 

@@ -573,13 +573,16 @@ class ReplicaAutoscaler:
                         f"http://{addr}/metrics", timeout=2.0) as r:
                     text = r.read().decode()
                 s = c = 0.0
+                # the histogram is labeled by SLO class — accumulate
+                # across the {slo="..."} lines rather than keeping
+                # whichever label happened to print last
                 for line in text.splitlines():
                     if line.startswith(
                             "hvd_serving_queue_wait_seconds_sum"):
-                        s = float(line.rsplit(" ", 1)[1])
+                        s += float(line.rsplit(" ", 1)[1])
                     elif line.startswith(
                             "hvd_serving_queue_wait_seconds_count"):
-                        c = float(line.rsplit(" ", 1)[1])
+                        c += float(line.rsplit(" ", 1)[1])
                 ps, pc = self._last_wait.get(addr, (0.0, 0.0))
                 self._last_wait[addr] = (s, c)
                 dsum += max(s - ps, 0.0)

@@ -390,7 +390,8 @@ class DecodeScheduler:
         # prefills run outside the scheduler lock (submit must not
         # block on compute; the engine serializes execution itself)
         for slot, r in admitted:
-            metrics.record_serving_queue_wait(now - r.enqueue_t)
+            metrics.record_serving_queue_wait(now - r.enqueue_t,
+                                              slo=r.slo_name)
             if r.req_id:
                 flight.record("decode_admit", r.req_id, slot=slot,
                               n=int(r.prompt.shape[0]), slo=r.slo_name)
@@ -402,6 +403,10 @@ class DecodeScheduler:
                     self._count_eviction("error")
                     self._evict_locked(slot, "error")
                 continue
+            # TTFT: admission to first emitted token, per SLO class —
+            # the scoreboard series the burn-rate rules watch
+            metrics.record_serving_ttft(self._clock() - r.enqueue_t,
+                                        slo=r.slo_name)
             with self._cv:
                 if slot not in self._active:
                     continue  # evicted between admit and prefill
@@ -422,7 +427,11 @@ class DecodeScheduler:
             lengths = self._lengths.copy()
         did_decode = False
         if active:
-            nxt, _ = self._engine.decode(tokens, lengths)
+            # the engine bills this iteration's wall time to each live
+            # sequence as its TPOT, by SLO class (decode.py)
+            nxt, _ = self._engine.decode(
+                tokens, lengths,
+                slos=[r.slo_name for r in active.values()])
             did_decode = True
             n_new = 0
             with self._cv:

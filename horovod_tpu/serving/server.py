@@ -138,6 +138,14 @@ class _ServingHandler(BaseHTTPRequestHandler):
         elif path == "/healthz":
             self._reply_json(200 if not srv.draining else 503,
                              srv.health())
+        elif path == "/health":
+            # fleet-health verdict only (rendezvous serves the same
+            # route for the training fleet — docs/health.md)
+            try:
+                from .. import health as _health
+                self._reply_json(200, _health.verdict())
+            except Exception:
+                self._reply_json(200, {"health": "off"})
         else:
             self._reply_json(404, {"error": "not found"})
 
@@ -372,6 +380,15 @@ class ServingServer:
                 h.update(self._health_extra())
             except Exception:
                 pass
+        # fold in the fleet-health verdict so the autoscaler and
+        # external probes read ONE route: "health" (off/ok/degraded),
+        # active-alert count, and the firing rule names ride alongside
+        # slots/occupancy (docs/health.md)
+        try:
+            from .. import health as _health
+            h.update(_health.verdict())
+        except Exception:
+            pass
         return h
 
     def _inflight_delta(self, d: int) -> None:

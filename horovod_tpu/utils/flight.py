@@ -324,6 +324,29 @@ def dump_count() -> int:
     return _dump_count
 
 
+# one rate-limit slot per firing rule: a rule that flaps must not turn
+# the recorder into a dump firehose, but two DIFFERENT rules firing
+# back-to-back each deserve their forensic snapshot
+_anomaly_last: Dict[str, float] = {}
+ANOMALY_DUMP_MIN_INTERVAL_S = 30.0
+
+
+def anomaly_dump(rule: str,
+                 min_interval_s: float = ANOMALY_DUMP_MIN_INTERVAL_S,
+                 ) -> Optional[str]:
+    """Anomaly-triggered forensic dump (the health monitor calls this
+    when an SLO rule fires): a normal ``dump`` with reason
+    ``anomaly:<rule>``, rate-limited per rule."""
+    if not _enabled:
+        return None
+    now = time.monotonic()
+    last = _anomaly_last.get(rule, 0.0)
+    if now - last < min_interval_s:
+        return None
+    _anomaly_last[rule] = now
+    return dump(f"anomaly:{rule}")
+
+
 # ---------------------------------------------------------------------------
 # cross-rank straggler attribution
 # ---------------------------------------------------------------------------
@@ -598,6 +621,7 @@ def reset() -> None:
     global _configured, _dump_count, _rank, _sink, _dir, _seq
     global _push_policy, _push_outage, _last_autotune
     _push_policy = _push_outage = None
+    _anomaly_last.clear()
     disable()
     _configured = False
     _events.clear()

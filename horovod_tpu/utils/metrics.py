@@ -780,6 +780,13 @@ class StepStats:
                 "hvd_steps_total", "Completed training steps").inc()
             registry.histogram(
                 "hvd_step_seconds", "Step wall time").observe(dt)
+        obs = _step_observer
+        if obs is not None:
+            try:
+                obs(record)
+            except Exception:
+                # a broken detector must never take down the step loop
+                pass
         return record
 
 
@@ -796,6 +803,13 @@ step_stats = StepStats()
 
 _step_wrapper = None
 
+# health/ rides the same slots: the step observer receives each
+# completed step's record dict (AFTER the JSONL write), the serving
+# observer each serving latency sample. None (default) costs one load
+# + is-None check — the monitor's entire disabled-path budget.
+_step_observer = None
+_serving_observer = None
+
 
 def set_step_wrapper(wrapper) -> None:
     """Install/remove (None) the step wrapper. ``wrapper.begin_step()``
@@ -805,6 +819,22 @@ def set_step_wrapper(wrapper) -> None:
     the current step's JSONL record."""
     global _step_wrapper
     _step_wrapper = wrapper
+
+
+def set_step_observer(fn) -> None:
+    """Install/remove (None) the step-record observer: ``fn(record)``
+    runs after each StepStats record closes, outside the stats lock.
+    The health monitor's detector feed (horovod_tpu/health)."""
+    global _step_observer
+    _step_observer = fn
+
+
+def set_serving_observer(fn) -> None:
+    """Install/remove (None) the serving-latency observer:
+    ``fn(kind, slo, seconds)`` with kind in ttft | tpot | queue_wait |
+    request. The health monitor's SLO burn-rate feed."""
+    global _serving_observer
+    _serving_observer = fn
 
 
 @contextlib.contextmanager
@@ -1251,15 +1281,51 @@ def record_serving_request(seconds: float, code: int) -> None:
     ).labels(str(code)).observe(seconds)
 
 
-def record_serving_queue_wait(seconds: float) -> None:
+def record_serving_queue_wait(seconds: float,
+                              slo: str = "standard") -> None:
     """Admission-to-dispatch wait of one request in the dynamic
-    batcher's queue."""
+    batcher's queue, by SLO class (serving/scheduler.py names the
+    class; the one-shot predict batcher is all ``standard``)."""
     if not _enabled:
         return
     registry.histogram(
         "hvd_serving_queue_wait_seconds",
-        "Request wait in the dynamic-batching queue",
-    ).observe(seconds)
+        "Request wait in the dynamic-batching queue, by SLO class",
+        ("slo",),
+    ).labels(slo).observe(seconds)
+    obs = _serving_observer
+    if obs is not None:
+        obs("queue_wait", slo, seconds)
+
+
+def record_serving_ttft(seconds: float, slo: str = "standard") -> None:
+    """Time-to-first-token: request admission to first emitted token
+    (prefill complete), by SLO class — ROADMAP item 3's scoreboard
+    series; the health burn-rate rules consume it."""
+    if not _enabled:
+        return
+    registry.histogram(
+        "hvd_serving_ttft_seconds",
+        "Time to first token per request, by SLO class", ("slo",),
+    ).labels(slo).observe(seconds)
+    obs = _serving_observer
+    if obs is not None:
+        obs("ttft", slo, seconds)
+
+
+def record_serving_tpot(seconds: float, slo: str = "standard") -> None:
+    """Time-per-output-token: one decode iteration's wall time billed
+    to each live sequence it advanced, by SLO class."""
+    if not _enabled:
+        return
+    registry.histogram(
+        "hvd_serving_tpot_seconds",
+        "Time per output token for live sequences, by SLO class",
+        ("slo",),
+    ).labels(slo).observe(seconds)
+    obs = _serving_observer
+    if obs is not None:
+        obs("tpot", slo, seconds)
 
 
 def record_serving_batch(bucket: int, n_real: int) -> None:
@@ -1412,6 +1478,41 @@ def set_serving_replicas(n: int) -> None:
     registry.gauge(
         "hvd_serving_replicas",
         "Replicas in the dispatch rotation").set(n)
+
+
+# -- fleet-health monitor (horovod_tpu/health, docs/health.md) ---------------
+
+def set_alert_active(rule: str, active: bool) -> None:
+    """1 while the named health SLO rule fires, 0 once it clears."""
+    if not _enabled:
+        return
+    registry.gauge(
+        "hvd_alert_active",
+        "1 while the named health rule fires, by rule", ("rule",),
+    ).labels(rule).set(1.0 if active else 0.0)
+
+
+def record_health_anomaly(cls: str) -> None:
+    """One classified detector anomaly (straggler-host / slow-link /
+    input-bound / compute-regression / queue-saturation)."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_health_anomalies_total",
+        "Detector anomalies, by classified cause", ("cause",),
+    ).labels(cls).inc()
+
+
+def record_health_incident(rule: str, state: str) -> None:
+    """One alert transition (fire or clear) written to the incident
+    log, by rule and transition."""
+    if not _enabled:
+        return
+    registry.counter(
+        "hvd_health_incidents_total",
+        "Health alert transitions, by rule and state",
+        ("rule", "state"),
+    ).labels(rule, state).inc()
 
 
 # ---------------------------------------------------------------------------
@@ -1737,6 +1838,8 @@ def reset() -> None:
     _push_policy = _push_outage = None
     set_pod_label("")
     set_step_wrapper(None)
+    set_step_observer(None)
+    set_serving_observer(None)
     on_shutdown()
     disable()
     _configured = False

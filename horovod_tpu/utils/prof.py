@@ -69,6 +69,7 @@ _counter = 0             # steps seen
 _samples = 0             # captures taken
 _next_ok_t = 0.0         # monotonic floor for the next sample
 _inflight = False        # a capture/parse is outstanding
+_force_next = False      # capture the NEXT step regardless of cadence
 _parse_thread: Optional[threading.Thread] = None
 _last_attribution: Optional[dict] = None
 _last_mfu: Optional[float] = None
@@ -185,19 +186,23 @@ _wrapper = _StepWrapper()
 
 
 def _begin_step() -> _Token:
-    global _counter, _inflight, _samples
+    global _counter, _inflight, _samples, _force_next
     with _lock:
         _counter += 1
         step = _counter
-        sample = (
-            _every > 0
-            and not _inflight
-            and step % _every == 0
-            and _clock() >= _next_ok_t
+        sample = not _inflight and (
+            (_every > 0
+             and step % _every == 0
+             and _clock() >= _next_ok_t)
+            # anomaly-triggered forensics (health/): a requested
+            # capture bypasses the cadence and the duty-budget floor —
+            # the one step that explains an alert is worth its cost
+            or _force_next
         )
         if sample:
             _inflight = True
             _samples += 1
+            _force_next = False
     logdir = None
     if sample:
         logdir = os.path.join(default_dir(), f"step{step}")
@@ -372,6 +377,10 @@ def _finish_sample(overhead_s: float) -> None:
         if _duty > 0:
             _next_ok_t = _clock() + overhead_s * (1.0 / _duty - 1.0)
         _inflight = False
+    # a forced (anomaly-triggered) capture may have armed the wrapper
+    # with sampling otherwise off: drop back to the knob-driven state
+    if not _force_next:
+        _update_activation()
 
 
 def _note_error() -> None:
@@ -423,6 +432,20 @@ def _update_activation() -> None:
         _active = False
         if _metrics._step_wrapper is _wrapper:
             _metrics.set_step_wrapper(None)
+
+
+def request_sample(reason: str = "") -> None:
+    """Force a device capture on the NEXT step, bypassing the
+    ``prof_every`` cadence and the duty-budget floor (one outstanding
+    capture at a time still applies). The health monitor calls this
+    when an alert fires so the xplane trace of a degraded step exists
+    before anyone goes looking for it. Arms the step wrapper if
+    sampling was otherwise off; after the forced capture the
+    knob-driven activation state is restored."""
+    global _force_next
+    _force_next = True
+    _flight.record("prof_request", reason or "manual")
+    _activate()
 
 
 def configure(knobs=None, *, every: Optional[int] = None,
@@ -492,7 +515,7 @@ def reset() -> None:
     global _active, _configured, _every, _duty, _dir, _step_flops
     global _n_chips, _counter, _samples, _next_ok_t, _inflight
     global _last_attribution, _last_mfu, _overhead_s, _errors, _clock
-    global _parse_thread, _peak_total
+    global _parse_thread, _peak_total, _force_next
     join(timeout_s=5.0)
     _active = False
     _configured = False
@@ -506,6 +529,7 @@ def reset() -> None:
     _samples = 0
     _next_ok_t = 0.0
     _inflight = False
+    _force_next = False
     _parse_thread = None
     _last_attribution = None
     _last_mfu = None

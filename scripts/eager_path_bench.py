@@ -232,6 +232,53 @@ def main(argv=None):
         "events_buffered": _flightmod.event_count(),
     }
 
+    # ---- health-monitor overhead A/B (docs/health.md acceptance
+    # gate): the same steady fast-path step, now wrapped in
+    # metrics.step() with metrics enabled in BOTH arms (the health
+    # monitor rides the metrics step-record stream — its marginal cost
+    # is the observer call + detector/rule-engine update per step), vs
+    # the identical instrumented step with health off (observer slot
+    # None: one load + is-None check). "on" must sit within the flight
+    # recorder's 2% envelope.
+    from horovod_tpu import health as _healthmod
+    from horovod_tpu.utils import metrics as _hm_metrics
+
+    _hm_metrics_was = _hm_metrics.enabled()
+    _health_was = _healthmod.enabled()
+
+    def _steady_eager_instrumented():
+        p, s = params, opt.init(params)
+        for _ in range(max(args.warmup, 6)):
+            with _hm_metrics.step():
+                p, s, l = eager_step(p, s)
+            enqueues["n"] += n_leaves
+        float(l)
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            with _hm_metrics.step():
+                p, s, l = eager_step(p, s)
+            enqueues["n"] += n_leaves
+        float(l)
+        return (time.perf_counter() - t0) / args.steps
+
+    _hm_metrics.enable()
+    health_on_s, health_off_s = float("inf"), float("inf")
+    for _ in range(2):
+        _healthmod.enable()
+        health_on_s = min(health_on_s, _steady_eager_instrumented())
+        _healthmod.disable()
+        health_off_s = min(health_off_s, _steady_eager_instrumented())
+    if _health_was:
+        _healthmod.enable()
+    if not _hm_metrics_was:
+        _hm_metrics.disable()
+    health_block = {
+        "steady_step_ms_on": round(health_on_s * 1e3, 3),
+        "steady_step_ms_off": round(health_off_s * 1e3, 3),
+        "overhead_frac": round(health_on_s / health_off_s - 1.0, 4),
+        "incidents": _healthmod.incident_count(),
+    }
+
     # ---- grouped eager path: the torch-adapter group API — ONE
     # all-or-nothing negotiation round and one fused executor batch for
     # all leaves (grouped_allreduce_async), vs 8 per-tensor rounds above
@@ -551,6 +598,7 @@ def main(argv=None):
         "cache_hits": int(rt.cache_hits()) if rt is not None else None,
         "fast_path": fast_path,
         "flight_recorder": flight_block,
+        "health": health_block,
         "replication": replication_block,
         "compression": compression_block,
         "runtime_roundtrip_ms": round(rtt_s * 1e3, 2),

@@ -1,0 +1,285 @@
+#!/usr/bin/env python
+"""Fleet-health monitor smoke gate: world-2 loopback straggler autopsy.
+
+Sits next to ``flight_check`` / ``chaos_check`` / ``metrics_summary
+--check`` in the repo's check scripts (docs/health.md). Scenario:
+
+* a KV/rendezvous server runs in the parent (the "driver") — it is the
+  health-summary sink (``PUT /health/<rank>``), the flight-dump sink,
+  the aggregated ``/metrics`` endpoint and the fleet ``GET /health``
+  verdict route;
+* two worker processes run an instrumented step loop (``metrics.step``
+  around a small compute + ``train.compute`` fault point) with the
+  health monitor armed (tight step-time envelope, fast publish
+  cadence); rank 1 carries a ``train.compute:delay`` fault that arms
+  after the detector's warmup and heals after a handful of slow steps;
+* while the run is **live**, the parent polls the root's ``GET
+  /health`` until the fleet verdict degrades and names rank 1 as a
+  suspected straggler, captures an aggregated ``/metrics`` scrape with
+  ``hvd_alert_active{...} 1``, then waits for the verdict to recover
+  once the fault heals;
+* afterwards it asserts the incident JSONL carries the rank-1
+  fire/clear pair, the anomaly-triggered flight dump landed on the
+  sink with an ``anomaly:`` reason, and the final aggregated scrape
+  shows the alert gauge back at 0 and lints clean.
+
+Exits 0 with a JSON summary on success, 1 with the first failed
+assertion otherwise.
+
+Usage:
+    python scripts/health_check.py [--check]
+"""
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+STEPS = 36
+BASE_STEP_S = 0.05      # healthy step: sleep standing in for compute
+DELAY_S = 0.4           # injected extra latency on rank 1's slow steps
+FAULT_AFTER = 4         # arm after the envelope's warmup samples
+FAULT_TIMES = 6         # heal after this many slow steps
+RULE = ("step_time_env:envelope:signal=step_time"
+        ":factor=1.4:min=4:breach=2:clear=4")
+
+
+def _worker(rank, kv_port, incident_path, flight_dir, q, hold):
+    # env BEFORE horovod imports: the fault spec arms at import time
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if rank == 1:
+        os.environ["HOROVOD_TPU_FAULT_SPEC"] = (
+            f"train.compute:delay:secs={DELAY_S}"
+            f":after={FAULT_AFTER}:times={FAULT_TIMES}"
+        )
+    from horovod_tpu import health
+    from horovod_tpu.utils import faults, flight, metrics
+
+    metrics.enable()
+    metrics.start_metrics_push("127.0.0.1", kv_port, rank,
+                               interval_s=0.2)
+    flight.configure(enabled_override=True, rank=rank,
+                     sink_addr="127.0.0.1", sink_port=kv_port,
+                     directory=flight_dir, handlers=False)
+    health.configure(enabled_override=True, rank=rank,
+                     endpoint=("127.0.0.1", kv_port),
+                     interval_s=0.2, rules=RULE,
+                     incident_file=incident_path, capture=True)
+    try:
+        for step in range(STEPS):
+            with metrics.step():
+                faults.inject("train.compute", rank=rank, step=step)
+                time.sleep(BASE_STEP_S)
+        q.put((rank, "done", {
+            "incidents": health.incident_count(),
+            "dumps": flight.dump_count(),
+        }))
+        # keep the publisher ticking until the parent has read the
+        # fleet's recovered verdict — an exited worker can't clear
+        # its own stale summary
+        hold.wait(timeout=60.0)
+    except Exception as e:  # pragma: no cover - diagnostic path
+        q.put((rank, "error", repr(e)))
+    finally:
+        metrics.stop_metrics_push()
+        health.on_shutdown()
+
+
+def _get_json(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_text(url, timeout=3.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _alert_values(scrape):
+    """Values of every hvd_alert_active series in an exposition."""
+    vals = []
+    for line in scrape.splitlines():
+        if line.startswith("hvd_alert_active"):
+            try:
+                vals.append(float(line.rsplit(" ", 1)[1]))
+            except ValueError:
+                pass
+    return vals
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="run the smoke gate (default behavior)")
+    ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from horovod_tpu.runner.http.http_server import KVStoreServer
+    from horovod_tpu.utils import metrics as _metrics
+
+    kv = KVStoreServer()
+    kv_port = kv.start_server()
+    tmp = tempfile.mkdtemp(prefix="hvd_health_check_")
+    incident_path = os.path.join(tmp, "incidents.jsonl")
+    flight_dir = os.path.join(tmp, "flight")
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    hold = ctx.Event()
+    procs = [
+        ctx.Process(target=_worker,
+                    args=(r, kv_port, incident_path, flight_dir, q,
+                          hold))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+
+    failures = []
+    results = {}
+    live_verdict = {}
+    degraded_scrape = ""
+    recovered = {}
+    base = f"http://127.0.0.1:{kv_port}"
+    try:
+        # -- phase 1: the fleet must degrade and name rank 1 LIVE ----------
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                v = _get_json(f"{base}/health")
+            except Exception:
+                time.sleep(0.05)
+                continue
+            if (v.get("status") == "degraded"
+                    and 1 in v.get("suspected_straggler_ranks", [])):
+                live_verdict = v
+                try:
+                    degraded_scrape = _get_text(f"{base}/metrics")
+                except Exception:
+                    pass
+                break
+            time.sleep(0.05)
+        if not live_verdict:
+            failures.append(
+                "fleet verdict never degraded naming rank 1 while the "
+                "run was live")
+        if degraded_scrape and 1.0 not in _alert_values(degraded_scrape):
+            failures.append(
+                "aggregated /metrics lacks a firing hvd_alert_active "
+                "series during the degraded window")
+
+        # -- phase 2: the fault heals, the verdict must recover ------------
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            try:
+                v = _get_json(f"{base}/health")
+            except Exception:
+                time.sleep(0.05)
+                continue
+            if (v.get("status") == "ok"
+                    and not v.get("suspected_straggler_ranks")):
+                recovered = v
+                break
+            time.sleep(0.05)
+        if not recovered:
+            failures.append("fleet verdict never recovered to ok after "
+                            "the fault healed")
+
+        # -- workers wind down ---------------------------------------------
+        deadline = time.monotonic() + 60.0
+        while len(results) < 2 and time.monotonic() < deadline:
+            try:
+                rank, kind, payload = q.get(timeout=5.0)
+            except Exception:
+                continue
+            results[rank] = (kind, payload)
+        hold.set()
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+        for r in range(2):
+            kind, payload = results.get(r, ("missing", None))
+            if kind != "done":
+                failures.append(f"rank {r} did not finish cleanly: "
+                                f"{kind} {payload}")
+
+        # -- incident JSONL: the rank-1 fire/clear pair ---------------------
+        incidents = []
+        try:
+            with open(incident_path) as f:
+                incidents = [json.loads(ln) for ln in f
+                             if ln.strip()]
+        except Exception as e:
+            failures.append(f"no incident log: {e}")
+        r1_states = [i.get("state") for i in incidents
+                     if i.get("rank") == 1
+                     and i.get("rule") == "step_time_env"]
+        if "fire" not in r1_states or "clear" not in r1_states:
+            failures.append(
+                "incident log lacks the rank-1 fire/clear pair for "
+                f"step_time_env: {incidents}")
+
+        # -- anomaly-triggered forensic capture on the sink ----------------
+        try:
+            dump = _get_text(f"{base}/flight/1", timeout=5.0)
+            if "anomaly" not in dump:
+                failures.append(
+                    "rank 1's flight dump on the sink lacks an "
+                    "anomaly: reason")
+        except Exception as e:
+            failures.append(f"no anomaly flight dump on sink for "
+                            f"rank 1: {e}")
+
+        # -- final scrape: alert gauge back at 0, lint-clean ---------------
+        try:
+            scrape = _get_text(f"{base}/metrics")
+        except Exception as e:
+            scrape = ""
+            failures.append(f"aggregated /metrics unreachable: {e}")
+        if scrape:
+            vals = _alert_values(scrape)
+            if not vals:
+                failures.append("final scrape lacks hvd_alert_active")
+            elif any(v != 0.0 for v in vals):
+                failures.append(
+                    f"hvd_alert_active did not clear: {vals}")
+            for name in ("hvd_health_anomalies_total",
+                         "hvd_health_incidents_total"):
+                if name not in scrape:
+                    failures.append(f"final scrape lacks {name}")
+            lint = _metrics.lint_exposition(scrape)
+            if lint:
+                failures.append(
+                    f"aggregated /metrics fails lint: {lint[:3]}")
+    finally:
+        hold.set()
+        kv.shutdown_server()
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+
+    summary = {
+        "what": "fleet-health monitor smoke gate (loopback world-2)",
+        "live_verdict": {k: live_verdict.get(k) for k in
+                         ("status", "suspected_straggler_ranks",
+                          "alerts_active")},
+        "recovered": recovered.get("status"),
+        "results": {r: k for r, (k, _) in results.items()},
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=1))
+    for f in failures:
+        print("FAIL:", f)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,10 @@ collective counts/bytes by op/dtype, fusion fill ratio, negotiation
 latency, cache hit rate and elastic events — the offline companion to
 the live ``GET /metrics`` endpoint, sitting alongside
 scripts/xplane_summary.py (device traces) and the timeline viewer.
+Out-of-band event lines ride along: the autotuner's decision trail,
+the decode scheduler's stat events, and the health monitor's incident
+transitions (per-rule fire/clear rollup — docs/health.md); an incident
+JSONL written via ``HOROVOD_HEALTH_INCIDENT_FILE`` parses the same way.
 
 Usage:
     python scripts/metrics_summary.py /tmp/run_metrics.jsonl [--last N]
@@ -52,6 +56,12 @@ def load_records(path):
                 # e.g. the autotuner's decision trail — carries
                 # {"event": kind, kind: payload} instead of step fields
                 records.append(rec)
+                continue
+            if "rule" in rec and "state" in rec and "step" not in rec:
+                # a bare incident record (HOROVOD_HEALTH_INCIDENT_FILE
+                # JSONL) — normalize to the event-line shape so one
+                # loader serves both files
+                records.append({"event": "incident", "incident": rec})
                 continue
             missing = [f for f in REQUIRED_FIELDS if f not in rec]
             if missing:
@@ -139,15 +149,57 @@ def summarize_decode(events):
             f"{k}={int(v)}" for k, v in sorted(ev.items())))
 
 
+def summarize_incidents(events):
+    """Render the health monitor's incident trail (health/__init__.py
+    emits one event line per alert fire/clear transition): a per-rule
+    rollup plus the chronological record — which rank, which signal,
+    and how long each alert stayed active when the pair is present."""
+    if not events:
+        return
+    by_rule = {}
+    for e in events:
+        ent = by_rule.setdefault(e.get("rule", "?"),
+                                 {"fire": 0, "clear": 0})
+        st = e.get("state")
+        if st in ent:
+            ent[st] += 1
+    print(f"\nhealth incidents ({len(events)} transitions):")
+    width = max(max(len(r) for r in by_rule), len("rule"))
+    print(f"  {'rule':<{width}}  {'fires':>5}  {'clears':>6}  "
+          f"{'open':>4}")
+    for rule in sorted(by_rule):
+        ent = by_rule[rule]
+        still = ent["fire"] - ent["clear"]
+        print(f"  {rule:<{width}}  {ent['fire']:>5}  "
+              f"{ent['clear']:>6}  {max(still, 0):>4}")
+    last_fire = {}
+    for e in events:
+        key = (e.get("rank"), e.get("rule"))
+        if e.get("state") == "fire":
+            last_fire[key] = e
+        elif e.get("state") == "clear" and key in last_fire:
+            f = last_fire.pop(key)
+            t0, t1 = f.get("time_unix"), e.get("time_unix")
+            dur = (f"  active {t1 - t0:.1f}s"
+                   if isinstance(t0, float) and isinstance(t1, float)
+                   else "")
+            print(f"  rank {e.get('rank', '?')}: {e.get('rule')} "
+                  f"({e.get('signal', '?')}){dur}")
+
+
 def summarize(records):
     autotune_events = [r["autotune"] for r in records
                        if r.get("event") == "autotune" and "autotune" in r]
     decode_events = [r["decode"] for r in records
                      if r.get("event") == "decode" and "decode" in r]
+    incident_events = [r["incident"] for r in records
+                       if r.get("event") == "incident"
+                       and "incident" in r]
     records = [r for r in records if "event" not in r]
     if not records:
         summarize_decode(decode_events)
         summarize_autotune(autotune_events)
+        summarize_incidents(incident_events)
         return
     times = sorted(r["step_time_s"] for r in records)
     print(f"steps: {len(records)}  "
@@ -274,6 +326,7 @@ def summarize(records):
     summarize_pods(records)
     summarize_decode(decode_events)
     summarize_autotune(autotune_events)
+    summarize_incidents(incident_events)
 
 
 def summarize_pods(records):

@@ -53,6 +53,12 @@ locally before the full pytest tier:
   documented envelope of the sync baseline over the int8 DCN leg,
   K=1 is bitwise-identical to the plain SPMD path, and a root
   failover with relays attached loses nothing);
+* ``health`` — ``scripts/health_check.py`` (fleet-health monitor:
+  world-2 loopback run where an injected rank-1 delay degrades the
+  root's live ``GET /health`` verdict naming rank 1, the
+  ``hvd_alert_active`` gauge fires then clears on the aggregated
+  scrape, the incident JSONL carries the fire/clear pair, and the
+  anomaly-triggered flight dump lands on the sink);
 * ``perf`` — ``scripts/perf_baseline.py --check`` (the perf-regression
   gate: structural invariants — fast-path engaged, zero steady
   negotiated bytes, profiler sampled + attributed inside its duty
@@ -277,6 +283,15 @@ def check_multipod():
     ], env=env)
 
 
+def check_health():
+    """The fleet-health monitor gate (14th): live straggler naming,
+    alert fire/clear, incident records, anomaly-triggered capture."""
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "health_check.py"),
+        "--check",
+    ])
+
+
 def check_perf():
     """The perf-regression gate + the merged-trace smoke (one gate:
     both run the unified-observability stack end-to-end)."""
@@ -306,6 +321,7 @@ GATES = [
     ("autotune", check_autotune),
     ("decode", check_decode),
     ("multipod", check_multipod),
+    ("health", check_health),
     ("perf", check_perf),
 ]
 

@@ -18,9 +18,11 @@ fuses them:
 
 Output is standard Chrome trace JSON (``traceEvents``): open it in
 Perfetto / chrome://tracing. One *process* per rank, with ``host:*``,
-``device:*`` and ``flight`` threads; host spans stay B/E pairs, device
-ops become X complete events, flight events become thread-scoped
-instants.
+``device:*``, ``flight`` and ``incidents`` threads; host spans stay
+B/E pairs, device ops become X complete events, flight events become
+thread-scoped instants, and health incident records (``--incidents``,
+docs/health.md) become process-scoped ``rule:state`` annotations on
+the same aligned axis.
 
 Usage:
     python scripts/trace_merge.py --out merged.json \\
@@ -116,6 +118,40 @@ def load_flight(path: str) -> Optional[dict]:
             "source": path}
 
 
+def load_incidents(path: str) -> List[dict]:
+    """One incident JSONL (HOROVOD_HEALTH_INCIDENT_FILE, or a step log
+    whose out-of-band ``incident`` event lines ride among step records)
+    → per-rank {rank, events:[(t_unix, rec)]} sources. Incidents are
+    wall-stamped at emission, so they align like flight events."""
+    recs = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(r, dict):
+                    continue
+                if r.get("event") == "incident" and "incident" in r:
+                    r = r["incident"]
+                if "rule" in r and "state" in r and "time_unix" in r:
+                    recs.append(r)
+    except OSError as e:
+        print(f"trace_merge: cannot read incidents {path}: {e}",
+              file=sys.stderr)
+        return []
+    by_rank: Dict[int, List] = {}
+    for r in recs:
+        by_rank.setdefault(int(r.get("rank", 0)), []).append(
+            (float(r["time_unix"]), r))
+    return [{"rank": rank, "events": evs, "source": path}
+            for rank, evs in sorted(by_rank.items())]
+
+
 def find_prof_samples(root: str) -> List[str]:
     """Profiler sample dirs under a root: any directory holding the
     ``hvd_prof_meta.json`` sidecar utils/prof.py writes per capture."""
@@ -177,7 +213,8 @@ def load_xplane_sample(sample_dir: str) -> Optional[dict]:
 # ---------------------------------------------------------------------------
 
 def merge(timelines: List[dict], flights: List[dict],
-          samples: List[dict]) -> Tuple[dict, dict]:
+          samples: List[dict],
+          incidents: Optional[List[dict]] = None) -> Tuple[dict, dict]:
     """(chrome_trace, report). Every source's wall stamps shift by its
     rank's /clock offset (flight header / prof sidecar; a rank with no
     probed offset uses 0 — same-host loopback worlds share a clock
@@ -201,6 +238,10 @@ def merge(timelines: List[dict], flights: List[dict],
         off = offsets.get(sm["rank"], 0.0)
         for t, e in sm["events"]:
             aligned.append((t + off, sm["rank"], "device", e))
+    for inc in incidents or []:
+        off = offsets.get(inc["rank"], 0.0)
+        for t, e in inc["events"]:
+            aligned.append((t + off, inc["rank"], "incident", e))
 
     report = {
         "what": "cross-rank merged trace",
@@ -260,6 +301,21 @@ def merge(timelines: List[dict], flights: List[dict],
                 "pid": rank,
                 "tid": _tid(rank, f"device:{e.get('line', '')}"),
             })
+        elif kind == "incident":
+            # annotation track: one process-scoped instant per alert
+            # transition, named rule:state so a firing alert reads
+            # straight off the merged axis next to the step/device
+            # spans it implicates (docs/health.md)
+            trace.append({
+                "ph": "i",
+                "s": "p",
+                "name": f"{e.get('rule', '?')}:{e.get('state', '?')}",
+                "ts": round(ts, 3),
+                "pid": rank,
+                "tid": _tid(rank, "incidents"),
+                "args": {k: v for k, v in e.items()
+                         if k != "time_unix"},
+            })
         else:  # flight
             name = e.get("kind", "event")
             if e.get("name"):
@@ -299,6 +355,11 @@ def main(argv=None) -> int:
                     metavar="DIR",
                     help="profiler capture dir — a single sample or a "
                          "rank root of samples (repeatable)")
+    ap.add_argument("--incidents", action="append", default=[],
+                    metavar="FILE",
+                    help="health incident JSONL (or a step log with "
+                         "incident event lines) rendered as an "
+                         "annotation track (repeatable; globs ok)")
     ap.add_argument("--out", required=True,
                     help="merged Chrome trace JSON path")
     ap.add_argument("--json", dest="json_out", default="",
@@ -327,7 +388,12 @@ def main(argv=None) -> int:
             if sm is not None:
                 samples.append(sm)
 
-    chrome, report = merge(timelines, flights, samples)
+    incidents: List[dict] = []
+    for pat in args.incidents:
+        for path in (sorted(glob.glob(pat)) or [pat]):
+            incidents.extend(load_incidents(path))
+
+    chrome, report = merge(timelines, flights, samples, incidents)
     if not chrome["traceEvents"]:
         print("trace_merge: no events from any source", file=sys.stderr)
         return 1

@@ -1,0 +1,546 @@
+"""Online fleet-health monitor (horovod_tpu/health/, docs/health.md).
+
+Covers: the disabled no-op fast path (< 1 us/call, the flight/metrics
+discipline), burn-rate window math with an injectable clock, envelope
+hysteresis, rule-spec parsing (including loud failures), detector
+classification on synthetic step records, the fleet evaluator's
+straggler/silent-rank verdict, alert transitions -> incident records +
+anomaly-triggered flight/prof capture, the serving-latency observer
+path, the SLO-labeled serving histograms, the serving /healthz + /health
+surfaces, knob wiring through hvd.init, and (slow) the world-2
+health_check.py gate."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from horovod_tpu import health
+from horovod_tpu.health import detectors, fleet, rules
+from horovod_tpu.utils import flight, metrics, prof
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_health():
+    health.reset()
+    metrics.reset()
+    flight.reset()
+    prof.reset()
+    yield
+    health.reset()
+    metrics.reset()
+    flight.reset()
+    prof.reset()
+
+
+# ------------------------------------------------------------ no-op path
+
+def test_disabled_observes_nothing():
+    assert not health.enabled()
+    health.observe_step({"step": 1, "step_time_s": 9.0})
+    health.observe_serving("ttft", "interactive", 9.0)
+    assert health.verdict() == {"health": "off", "alerts_active": 0}
+    assert health.incident_count() == 0
+
+
+def test_disabled_overhead_under_1us_per_call():
+    """HOROVOD_HEALTH=0 acceptance: the disabled observer (module flag
+    check + return) must cost < 1 us per call — and the metrics-side
+    slot stays None so an instrumented step never even reaches it."""
+    assert not health.enabled()
+    assert metrics._step_observer is None
+    n = 200_000
+    obs = health.observe_step
+    rec = {"step": 1, "step_time_s": 0.01}
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs(rec)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 1e-6, f"no-op observe costs {per_call * 1e9:.0f} ns"
+
+
+# ------------------------------------------------------------ burn rate
+
+def test_burn_rate_window_math():
+    t = [1000.0]
+    br = rules.BurnRate(target_s=0.5, objective=0.99, fast_s=30.0,
+                        slow_s=300.0, clock=lambda: t[0])
+    # 50 good samples over 50s: zero burn
+    for _ in range(50):
+        t[0] += 1.0
+        br.observe(0.1)
+    assert br.burn(30.0) == 0.0
+    assert not br.firing()
+    # all-bad stream: burn = bad_frac / budget = 1 / 0.01 = 100
+    for _ in range(400):
+        t[0] += 1.0
+        br.observe(2.0)
+    assert br.burn(30.0) == pytest.approx(100.0)
+    assert br.burn(300.0) > 6.0
+    assert br.firing()
+    assert not br.cleared()
+    # recovery: the fast window refills with good samples
+    for _ in range(35):
+        t[0] += 1.0
+        br.observe(0.1)
+    assert br.burn(30.0) < 1.0
+    assert br.cleared()
+    # hysteresis through state(): firing holds until cleared
+    assert br.state(currently_firing=True) is False
+
+
+def test_burn_rate_fires_only_on_both_windows():
+    """A short error burst trips the fast window but not the slow one:
+    no page (the multiwindow discipline's whole point)."""
+    t = [0.0]
+    br = rules.BurnRate(target_s=0.5, objective=0.99, fast_s=30.0,
+                        slow_s=300.0, clock=lambda: t[0])
+    for _ in range(288):
+        t[0] += 1.0
+        br.observe(0.1)
+    for _ in range(12):
+        t[0] += 1.0
+        br.observe(2.0)
+    assert br.burn(30.0) >= 14.4       # fast window is all-bad enough
+    assert br.burn(300.0) < 6.0        # but the slow window is not
+    assert not br.firing()
+
+
+def test_burn_rate_rejects_bad_objective():
+    with pytest.raises(rules.RuleSpecError):
+        rules.BurnRate(target_s=0.5, objective=1.0)
+
+
+# ------------------------------------------------------------ envelope
+
+def test_envelope_hysteresis():
+    env = rules.Envelope(factor=1.5, window=16, min_samples=4,
+                         breach_n=2, clear_n=3)
+    for _ in range(6):
+        env.observe(0.1)
+    # one breaching sample is not enough (breach_n=2)
+    env.observe(1.0)
+    assert not env.state(currently_firing=False)
+    env.observe(1.0)
+    assert env.state(currently_firing=False)
+    # clearing needs clear_n consecutive in-envelope samples
+    env.observe(0.1)
+    assert env.state(currently_firing=True)
+    env.observe(0.1)
+    env.observe(0.1)
+    assert not env.state(currently_firing=True)
+
+
+def test_envelope_drop_side():
+    env = rules.Envelope(drop=0.3, window=16, min_samples=4,
+                         breach_n=1, clear_n=1)
+    for _ in range(5):
+        env.observe(1.0)
+    env.observe(0.5)  # 50% under the median: breach
+    assert env.state(currently_firing=False)
+
+
+# ------------------------------------------------------------ rule parsing
+
+def test_default_rules_parse():
+    rs = rules.parse_rules(rules.DEFAULT_RULES)
+    assert [r.kind for r in rs].count("envelope") == 2
+    assert [r.kind for r in rs].count("burn") == 3
+    by_name = {r.name: r for r in rs}
+    assert by_name["ttft_interactive"].slo == "interactive"
+    assert by_name["step_time_envelope"].classes() == ("straggler-host",)
+
+
+@pytest.mark.parametrize("spec", [
+    "noname",                                    # no kind
+    "x:watch:signal=ttft",                       # unknown kind
+    "x:burn:signal=ttft",                        # burn without target
+    "x:burn:target=0.5",                         # no signal
+    "x:envelope:signal=mfu",                     # envelope without bound
+    "x:burn:signal=ttft:target=abc",             # non-numeric
+    "x:burn:signal=ttft:garbage",                # not key=value
+])
+def test_malformed_rules_fail_loudly(spec):
+    with pytest.raises(rules.RuleSpecError):
+        rules.parse_rules(spec)
+
+
+def test_rule_engine_transitions():
+    t = [0.0]
+    eng = rules.RuleEngine(rules.parse_rules(
+        "env:envelope:signal=step_time:factor=1.5:min=3:breach=1:clear=2"
+    ), clock=lambda: t[0])
+    for _ in range(4):
+        eng.observe("step_time", 0.1)
+    assert eng.evaluate() == []
+    eng.observe("step_time", 1.0)
+    (tr,) = eng.evaluate()
+    assert tr["rule"] == "env" and tr["state"] == "fire"
+    assert tr["classes"] == ["straggler-host"]
+    assert eng.active_count() == 1
+    eng.observe("step_time", 0.1)
+    eng.observe("step_time", 0.1)
+    (tr,) = eng.evaluate()
+    assert tr["state"] == "clear"
+    assert eng.active_count() == 0
+
+
+# ------------------------------------------------------------ detectors
+
+def _warm(det, n=10, dt=0.01, **extra):
+    for i in range(n):
+        det.update({"step": i, "step_time_s": dt, **extra})
+
+
+def test_detector_straggler_host():
+    det = detectors.StepDetectors(window=16, min_steps=4)
+    _warm(det)
+    (a,) = det.update({"step": 99, "step_time_s": 0.1})
+    assert a["class"] == "straggler-host"
+    assert a["signal"] == "step_time"
+    assert a["reference"] == pytest.approx(0.01)
+
+
+def test_detector_slow_link_from_wire_drift():
+    det = detectors.StepDetectors(window=16, min_steps=4)
+    _warm(det, attribution={"exposed_wire_frac": 0.1})
+    anomalies = det.update({
+        "step": 99, "step_time_s": 0.1,
+        "attribution": {"exposed_wire_frac": 0.5},
+    })
+    assert {a["class"] for a in anomalies} == {"slow-link"}
+
+
+def test_detector_input_bound_from_idle_rise():
+    det = detectors.StepDetectors(window=16, min_steps=4)
+    _warm(det, attribution={"idle_frac": 0.05})
+    anomalies = det.update({
+        "step": 99, "step_time_s": 0.1,
+        "attribution": {"idle_frac": 0.6},
+    })
+    assert anomalies[0]["class"] == "input-bound"
+
+
+def test_detector_compute_regression_from_mfu():
+    det = detectors.StepDetectors(window=16, min_steps=4)
+    _warm(det, mfu=0.5)
+    anomalies = det.update({"step": 99, "step_time_s": 0.1, "mfu": 0.1})
+    assert {a["class"] for a in anomalies} == {"compute-regression"}
+
+
+def test_detector_retry_burst_and_queue_saturation():
+    det = detectors.StepDetectors(window=16, min_steps=4,
+                                  retry_burst=3, queue_factor=2.0)
+    _warm(det, queue_depth=1)
+    anomalies = det.update({
+        "step": 99, "step_time_s": 0.01, "queue_depth": 8,
+        "retries": {"http.put": 2}, "retry_giveups": {"http.put": 1},
+    })
+    classes = {a["class"] for a in anomalies}
+    assert classes == {"slow-link", "queue-saturation"}
+
+
+def test_detector_autotune_baseline_breach():
+    """The persisted per-(model, topology) baseline guards steps even
+    when THIS run's rolling median has drifted up with them."""
+    det = detectors.StepDetectors(window=16, min_steps=4,
+                                  baseline_step_s=0.01)
+    _warm(det, n=10, dt=0.03)  # slow all run: rolling median 0.03
+    (a,) = det.update({"step": 99, "step_time_s": 0.03})
+    assert a["signal"] == "step_time_baseline"
+    assert a["reference"] == pytest.approx(0.01)
+
+
+def test_detector_spike_does_not_drag_its_reference():
+    det = detectors.StepDetectors(window=16, min_steps=4)
+    _warm(det)
+    det.update({"step": 98, "step_time_s": 0.1})
+    # the spike is IN the window now, but the median held
+    (a,) = det.update({"step": 99, "step_time_s": 0.1})
+    assert a["reference"] == pytest.approx(0.01)
+
+
+def test_serving_detector_queue_wait_buildup():
+    det = detectors.ServingDetectors(window=32, factor=2.0,
+                                     floor_s=0.05, min_samples=8)
+    for _ in range(10):
+        assert det.update_queue_wait(0.01) == []
+    out = []
+    for _ in range(10):
+        out.extend(det.update_queue_wait(0.5))
+    assert out and out[0]["class"] == "queue-saturation"
+
+
+# ------------------------------------------------------------ fleet view
+
+def _summary(rank, now, recent=0.1, alerts=None):
+    return {"rank": rank, "time_unix": now,
+            "step_time_recent_s": recent, "steps": 20,
+            "alerts": alerts or {}}
+
+
+def test_fleet_ok_when_uniform():
+    now = time.time()
+    v = fleet.evaluate({r: _summary(r, now) for r in range(4)},
+                       now_unix=now)
+    assert v["status"] == "ok"
+    assert v["suspected_straggler_ranks"] == []
+    assert v["ranks"] == 4
+
+
+def test_fleet_names_self_reported_straggler():
+    now = time.time()
+    s = {r: _summary(r, now) for r in range(4)}
+    s[2]["alerts"] = {"step_time_envelope": {
+        "active": True, "classes": ["straggler-host"]}}
+    v = fleet.evaluate(s, now_unix=now)
+    assert v["status"] == "degraded"
+    assert v["suspected_straggler_ranks"] == [2]
+    assert v["alerts_active"] == 1
+
+
+def test_fleet_names_median_outlier():
+    now = time.time()
+    s = {r: _summary(r, now) for r in range(4)}
+    s[3]["step_time_recent_s"] = 0.5  # 5x the fleet median
+    v = fleet.evaluate(s, now_unix=now)
+    assert v["suspected_straggler_ranks"] == [3]
+    assert "straggler-host" in v["by_rank"]["3"]["classes"]
+
+
+def test_fleet_silent_rank_is_suspect():
+    now = time.time()
+    s = {r: _summary(r, now) for r in range(3)}
+    s[1]["time_unix"] = now - 60.0
+    v = fleet.evaluate(s, now_unix=now)
+    assert v["silent_ranks"] == [1]
+    assert 1 in v["suspected_straggler_ranks"]
+
+
+def test_fleet_empty_is_unknown_and_garbage_is_dropped():
+    assert fleet.evaluate({})["status"] == "unknown"
+    parsed = fleet.parse_summaries({
+        "0": json.dumps({"rank": 0, "time_unix": 1.0}).encode(),
+        "1": b"\x80\x04not json",         # never unpickled, just dropped
+        "2@podA": json.dumps({"time_unix": 1.0}).encode(),
+    })
+    assert set(parsed) == {"0", "2@podA"}
+    assert parsed["2@podA"]["rank"] == 2
+    assert parsed["2@podA"]["pod"] == "podA"
+
+
+# ------------------------------------------------ transitions + capture
+
+def test_alert_fire_writes_incident_and_captures(tmp_path):
+    flight.enable()
+    incident = str(tmp_path / "incidents.jsonl")
+    health.configure(
+        enabled_override=True, rank=3, endpoint=None, interval_s=60.0,
+        rules="env:envelope:signal=step_time:factor=1.5:min=3"
+              ":breach=1:clear=2",
+        incident_file=incident, capture=True)
+    for i in range(4):
+        health.observe_step({"step": i, "step_time_s": 0.01})
+    assert health.verdict()["health"] == "ok"
+    dumps_before = flight.dump_count()
+    health.observe_step({"step": 5, "step_time_s": 1.0})
+    v = health.verdict()
+    assert v["health"] == "degraded" and v["alerts"] == ["env"]
+    # forensics: a flight dump fired and the profiler owes one sample
+    assert flight.dump_count() == dumps_before + 1
+    assert prof._force_next
+    # recovery clears the verdict and appends the clear record
+    health.observe_step({"step": 6, "step_time_s": 0.01})
+    health.observe_step({"step": 7, "step_time_s": 0.01})
+    assert health.verdict()["health"] == "ok"
+    with open(incident) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    assert [r["state"] for r in recs] == ["fire", "clear"]
+    assert all(r["rank"] == 3 and r["rule"] == "env" for r in recs)
+    assert health.incident_count() == 2
+
+
+def test_alert_gauge_rides_the_exposition():
+    health.configure(
+        enabled_override=True, endpoint=None, interval_s=60.0,
+        rules="env:envelope:signal=step_time:factor=1.5:min=3"
+              ":breach=1:clear=2", capture=False)
+    # past BOTH warmups: the envelope's (min=3) and the default
+    # detector's (min_steps=8), so the anomaly counter moves too
+    for i in range(9):
+        health.observe_step({"step": i, "step_time_s": 0.01})
+    health.observe_step({"step": 9, "step_time_s": 1.0})
+    _, body = metrics.exposition()
+    text = body.decode()
+    assert 'hvd_alert_active{rule="env"} 1' in text
+    assert 'hvd_health_incidents_total{rule="env",state="fire"}' in text
+    assert "hvd_health_anomalies_total" in text
+    assert metrics.lint_exposition(text) == []
+
+
+def test_flight_anomaly_dump_rate_limited():
+    flight.enable()
+    assert flight.anomaly_dump("rule_a") is not None
+    assert flight.anomaly_dump("rule_a") is None        # limited
+    assert flight.anomaly_dump("rule_b") is not None    # per-rule
+    assert flight.anomaly_dump("rule_a",
+                               min_interval_s=0.0) is not None
+
+
+def test_prof_request_sample_forces_next_step():
+    prof.configure(every=0)  # sampling off by knobs
+    prof.request_sample("anomaly:test")
+    metrics.enable()
+    with metrics.step():
+        pass
+    assert prof.sample_count() >= 1
+    assert not prof._force_next
+
+
+# ------------------------------------------------ metrics-stream wiring
+
+def test_step_observer_feeds_detectors():
+    metrics.enable()
+    health.configure(enabled_override=True, endpoint=None,
+                     interval_s=60.0, capture=False)
+    for _ in range(3):
+        with metrics.step():
+            pass
+    assert health.summary()["steps"] == 3
+    # disable unhooks: further steps are not observed
+    health.disable()
+    with metrics.step():
+        pass
+    assert health.summary()["steps"] == 3
+
+
+def test_serving_observer_feeds_burn_rules():
+    health.configure(
+        enabled_override=True, endpoint=None, interval_s=60.0,
+        rules="qw:burn:signal=queue_wait:target=0.01:objective=0.5"
+              ":fast=30:slow=30:fast_factor=1:slow_factor=1",
+        capture=False)
+    for _ in range(10):
+        metrics.record_serving_queue_wait(0.5, slo="interactive")
+    health._tick()  # serving rules advance on the publisher tick
+    assert health.verdict()["health"] == "degraded"
+
+
+def test_serving_histograms_carry_slo_label():
+    metrics.enable()
+    metrics.record_serving_ttft(0.12, slo="interactive")
+    metrics.record_serving_tpot(0.03, slo="interactive")
+    metrics.record_serving_queue_wait(0.01, slo="batch")
+    _, body = metrics.exposition()
+    text = body.decode()
+    assert 'hvd_serving_ttft_seconds_count{slo="interactive"} 1' in text
+    assert 'hvd_serving_tpot_seconds_count{slo="interactive"} 1' in text
+    assert 'hvd_serving_queue_wait_seconds_count{slo="batch"} 1' in text
+    assert metrics.lint_exposition(text) == []
+
+
+def test_summary_publish_roundtrip():
+    from horovod_tpu.runner.http.http_server import KVStoreServer
+
+    kv = KVStoreServer()
+    port = kv.start_server()
+    try:
+        health.configure(enabled_override=True, rank=1,
+                         endpoint=("127.0.0.1", port),
+                         interval_s=60.0, capture=False)
+        health._tick()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=5) as r:
+            v = json.loads(r.read())
+        assert v["status"] == "ok"
+        assert v["ranks"] == 1
+        assert "1" in v["by_rank"]
+    finally:
+        kv.shutdown_server()
+
+
+def test_serving_server_health_routes():
+    from horovod_tpu.serving.server import ServingServer
+
+    health.configure(enabled_override=True, endpoint=None,
+                     interval_s=60.0, capture=False)
+    srv = ServingServer(predict_fn=lambda x, t: x)
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "ok"
+        assert h["health"] == "ok"           # the folded-in verdict
+        assert h["alerts_active"] == 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=5) as r:
+            v = json.loads(r.read())
+        assert v["health"] == "ok"
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------------ knob wiring
+
+def test_default_off(monkeypatch):
+    import horovod_tpu as hvd
+
+    hvd.init()
+    try:
+        assert not health.enabled()
+    finally:
+        hvd.shutdown()
+
+
+def test_knob_enables_and_shutdown_disables(monkeypatch, tmp_path):
+    import horovod_tpu as hvd
+
+    incident = str(tmp_path / "inc.jsonl")
+    monkeypatch.setenv("HVD_TPU_HEALTH", "1")
+    monkeypatch.setenv("HVD_TPU_HEALTH_STEP_TIME_FACTOR", "2.5")
+    monkeypatch.setenv("HVD_TPU_HEALTH_INCIDENT_FILE", incident)
+    hvd.init()
+    try:
+        assert health.enabled()
+        assert metrics.enabled()  # health implies metrics
+        assert health._step_det.step_time_factor == 2.5
+        assert health._incident_path == incident
+    finally:
+        hvd.shutdown()
+    assert not health.enabled()
+
+
+def test_bad_rules_knob_fails_loudly():
+    class _Knobs:
+        health_enabled = True
+        health_rules = "broken-rule"
+
+    with pytest.raises(rules.RuleSpecError):
+        health.configure(_Knobs())
+    assert not health.enabled()
+
+
+# ------------------------------------------------------------ e2e gate
+
+@pytest.mark.slow
+def test_health_check_gate():
+    """The world-2 smoke gate end to end: injected rank-1 delay named
+    live, alert fires and clears, forensics on the sink."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "health_check.py")],
+        cwd=_REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout
+    assert '"ok": true' in proc.stdout
