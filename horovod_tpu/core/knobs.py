@@ -243,6 +243,31 @@ class Knobs:
     # worst-case DCN hops between pods (scaling-projection input)
     multipod_dcn_hops: int = 1
 
+    # --- sharded root control plane (docs/control_plane.md) ---
+    # replica count for the root KV tier; 0/1 = today's single root,
+    # bit-for-bit (no ring, no leases, no extra processes)
+    root_replicas: int = 1
+    # the configured root set, "addr:port,addr:port,..." in replica-id
+    # order (HOROVOD_ROOT_ADDRS — the launcher exports it fleet-wide;
+    # setting it by hand points workers at an externally-run tier)
+    root_addrs: str = ""
+    # lease TTL: how long a replica's silence lasts before its ring
+    # successor fences it and takes over. Availability/false-positive
+    # dial: shorter = faster takeover, more sensitive to GC pauses
+    root_lease_ttl_seconds: float = 3.0
+    # lease heartbeat cadence; keep several beats inside one TTL so a
+    # single dropped beat never looks like a death
+    root_heartbeat_seconds: float = 0.5
+    # virtual nodes per replica on the hash ring (load-spread quality
+    # vs membership-record size)
+    root_vnodes: int = 64
+    # supervised child restart ladder (runner/supervisor.py):
+    # base × multiplier^n capped at max; an exit within the flap
+    # window counts a flap and grows the ladder, a longer run resets it
+    supervisor_base_delay_seconds: float = 0.5
+    supervisor_max_delay_seconds: float = 10.0
+    supervisor_flap_window_seconds: float = 5.0
+
     # --- process sets ---
     dynamic_process_sets: bool = False
 
@@ -452,6 +477,17 @@ class Knobs:
                 "MULTIPOD_OUTER_MOMENTUM", 0.0
             ),
             multipod_dcn_hops=_env_int("MULTIPOD_DCN_HOPS", 1),
+            root_replicas=_env_int("ROOT_REPLICAS", 1),
+            root_addrs=_env("ROOT_ADDRS", "") or "",
+            root_lease_ttl_seconds=_env_float("ROOT_LEASE_TTL", 3.0),
+            root_heartbeat_seconds=_env_float("ROOT_HEARTBEAT", 0.5),
+            root_vnodes=_env_int("ROOT_VNODES", 64),
+            supervisor_base_delay_seconds=_env_float(
+                "SUPERVISOR_BASE_DELAY", 0.5),
+            supervisor_max_delay_seconds=_env_float(
+                "SUPERVISOR_MAX_DELAY", 10.0),
+            supervisor_flap_window_seconds=_env_float(
+                "SUPERVISOR_FLAP_WINDOW", 5.0),
             dynamic_process_sets=_env_bool("DYNAMIC_PROCESS_SETS", False),
             native_eager=_env_bool("NATIVE", False),
             eager_fast_path=_env_bool("EAGER_FAST_PATH", True),

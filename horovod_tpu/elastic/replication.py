@@ -105,8 +105,22 @@ def replicator() -> Optional["Replicator"]:
 # ---------------------------------------------------------------------------
 
 
+def _route(addr: str, port: int, scope: str, key: str):
+    """Shard-aware target resolution: when (addr, port) names a
+    configured sharded root (HOROVOD_ROOT_ADDRS), the request must land
+    on (scope, key)'s ring owner, or it bounces 421 NotOwner. Peer
+    replica stores and unsharded roots pass through unchanged."""
+    try:
+        from ..runner.http.http_client import resolve_owner
+
+        return resolve_owner(addr, port, scope, key)
+    except Exception:
+        return addr, port
+
+
 def _http_put(addr: str, port: int, scope: str, key: str,
               value: bytes) -> None:
+    addr, port = _route(addr, port, scope, key)
     req = urllib.request.Request(
         f"http://{addr}:{port}/{scope}/{key}", data=value, method="PUT"
     )
@@ -116,6 +130,7 @@ def _http_put(addr: str, port: int, scope: str, key: str,
 
 def _http_get(addr: str, port: int, scope: str,
               key: str) -> Optional[bytes]:
+    addr, port = _route(addr, port, scope, key)
     try:
         with urllib.request.urlopen(
                 f"http://{addr}:{port}/{scope}/{key}",

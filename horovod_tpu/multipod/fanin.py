@@ -14,7 +14,7 @@ import time
 import urllib.request
 from typing import Dict, List, Tuple
 
-from ..runner.http.http_server import KVStoreServer
+from ..runner.http.http_server import KVStoreServer, ShardReplica
 from ..utils.metrics import METRICS_PUSH_SCOPE
 from .relay import PodRelayServer
 
@@ -129,4 +129,92 @@ def measure_fanin(n_pods: int, hosts_per_pod: int,
             direct_requests / max(relayed_requests, 1), 2),
         "pod_fanin_factor": hosts_per_pod,
         "pushed": pushed,
+    }
+
+
+def _free_ports(n: int) -> List[int]:
+    """n distinct free TCP ports, all reserved before any is used, so
+    a replica tier's roots list can be fixed up front (replica id =
+    index, the HOROVOD_ROOT_ADDRS contract)."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def measure_shard_balance(n_replicas: int, n_hosts: int,
+                          pushes_per_host: int = 1) -> Dict:
+    """Sharded-root load-spread measurement: an in-process tier of
+    ``n_replicas`` ShardReplicas, ``n_hosts`` simulated hosts each
+    pushing ``pushes_per_host`` expositions through a shard-routing
+    client. The scoreboard is each replica's request count — with a
+    healthy ring every replica serves ≈ total/N (consistent hashing's
+    whole point; `scripts/control_plane_scaling.py --root-replicas`
+    renders the rows)."""
+    from ..runner.http.http_client import ShardClient
+
+    roots = [("127.0.0.1", p) for p in _free_ports(n_replicas)]
+    reps = [
+        ShardReplica(i, roots, auto_heartbeat=False)
+        for i in range(n_replicas)
+    ]
+    for r in reps:
+        r.start_server()
+    client = ShardClient(roots)
+    client.shard_map()  # fetch once, outside the timed region
+    errors: List[str] = []
+
+    def host(rank: int) -> None:
+        for i in range(pushes_per_host):
+            try:
+                client.put(METRICS_PUSH_SCOPE, str(rank),
+                           _exposition_body(i))
+            except Exception as e:  # surface, don't crash the thread
+                errors.append(f"rank {rank}: {e}")
+                return
+
+    threads = [threading.Thread(target=host, args=(rank,))
+               for rank in range(n_hosts)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    per_replica = [r.request_count for r in reps]
+    seen = set()
+    copies = 0
+    for r in reps:
+        with r.lock:
+            keys = list(r.store.get(METRICS_PUSH_SCOPE, {}))
+        seen.update(keys)
+        copies += len(keys)
+    for r in reps:
+        r.shutdown_server()
+    total = sum(per_replica)
+    mean = total / max(n_replicas, 1)
+    return {
+        "root_replicas": n_replicas,
+        "hosts": n_hosts,
+        "pushes_per_host": pushes_per_host,
+        "per_replica_requests": per_replica,
+        "total_requests": total,
+        "balance_max_over_mean": round(
+            max(per_replica) / mean, 3) if mean else 0.0,
+        "stored_keys": len(seen),
+        # owner + ring-backup copies: ≈ 2× keys with N ≥ 2 replicas
+        # (the write-through replication the takeover guarantee rides)
+        "stored_copies": copies,
+        "push_wall_s": round(wall_s, 3),
+        "client_redirects": client.redirects,
+        "errors": errors[:5],
+        "n_errors": len(errors),
     }

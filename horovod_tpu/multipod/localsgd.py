@@ -88,11 +88,13 @@ def local_sgd_active(topology: Optional[PodTopology],
 class OuterState:
     """Per-leaf outer-loop state, a pytree of the params' structure:
     ``anchor`` is the last synchronized point, ``velocity`` the outer
-    momentum buffer. Registered as a JAX pytree so it carries through
-    jit/lax.cond like optimizer state."""
+    momentum buffer, ``residual`` the int8 error-feedback carry (f32,
+    per leaf; None on uncompressed/non-EF wires). Registered as a JAX
+    pytree so it carries through jit/lax.cond like optimizer state."""
 
     anchor: Any
     velocity: Any
+    residual: Any = None
 
 
 def _register_outer_state() -> None:
@@ -100,7 +102,7 @@ def _register_outer_state() -> None:
 
     jax.tree_util.register_pytree_node(
         OuterState,
-        lambda s: ((s.anchor, s.velocity), None),
+        lambda s: ((s.anchor, s.velocity, s.residual), None),
         lambda _aux, children: OuterState(*children),
     )
 
@@ -157,9 +159,12 @@ class LocalSGD:
 
     # -- outer (cross-pod, DCN) leg ----------------------------------------
 
-    def cross_pod_mean(self, x):
+    def cross_pod_mean(self, x, residual=None):
         """Mean of ``x`` across pods at equal pod-local offset, over
-        the (optionally compressed) DCN leg."""
+        the (optionally compressed) DCN leg. With ``residual`` (int8
+        error feedback, f32 of x's shape) the quantization error of
+        THIS sync is folded into the payload of the NEXT one, and the
+        call returns ``(mean, new_residual)``."""
         from jax import lax
 
         n = self.topology.n_pods
@@ -168,8 +173,34 @@ class LocalSGD:
                 x, self.axis, axis_index_groups=self._outer) / n
         from ..ops.hierarchical import _outer_wire_sum
 
-        return _outer_wire_sum(
-            x, self.axis, self._outer, n, self.wire, None) / n
+        if residual is None:
+            return _outer_wire_sum(
+                x, self.axis, self._outer, n, self.wire, None) / n
+        y, new_res = _outer_wire_sum(
+            x, self.axis, self._outer, n, self.wire, residual)
+        return y / n, new_res
+
+    def _plain_cross_pod_mean(self, x):
+        """Uncompressed cross-pod mean — for payloads the int8 wire
+        would bias (optimizer second moments are strictly positive,
+        not zero-centered; block scales there inject a systematic
+        error the delta payload does not see)."""
+        from jax import lax
+
+        return lax.psum(
+            x, self.axis, axis_index_groups=self._outer,
+        ) / self.topology.n_pods
+
+    @property
+    def carries_residual(self) -> bool:
+        """Whether outer syncs thread int8 error feedback: requires an
+        int8 wire with ``error_feedback`` set. Before PR 17 the
+        per-sync residual was computed and dropped; now it rides in
+        :class:`OuterState` so quantization error cancels across
+        syncs instead of compounding."""
+        return (self.wire is not None
+                and getattr(self.wire, "kind", None) == "int8"
+                and bool(getattr(self.wire, "error_feedback", False)))
 
     def should_sync(self, step: int) -> bool:
         """Host-side cadence check: sync after steps K-1, 2K-1, ...
@@ -180,9 +211,14 @@ class LocalSGD:
         import jax
         import jax.numpy as jnp
 
+        residual = None
+        if self.carries_residual:
+            residual = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params)
         return OuterState(
             anchor=jax.tree_util.tree_map(jnp.asarray, params),
             velocity=jax.tree_util.tree_map(jnp.zeros_like, params),
+            residual=residual,
         )
 
     def outer_sync(self, params, state: OuterState,
@@ -190,15 +226,31 @@ class LocalSGD:
         """One cross-pod synchronization (traceable): average the
         K-step anchor deltas over DCN (the well-conditioned payload
         for the quantized wire — module docstring), apply outer
-        momentum, re-anchor. Three plain per-leaf maps — no
-        tuple-valued leaves, so tuple/namedtuple-structured params
-        pytrees are safe."""
+        momentum, re-anchor. With an error-feedback wire the carried
+        residual joins the delta payload and the fresh quantization
+        error replaces it in the returned state. Plain per-leaf maps
+        — no tuple-valued leaves in any single map, so
+        tuple/namedtuple-structured params pytrees are safe (the
+        residual pass works on flattened leaf lists for the same
+        reason)."""
         import jax
 
         tree_map = jax.tree_util.tree_map
-        mean_delta = tree_map(
-            lambda p, a: self.cross_pod_mean(p - a),
-            params, state.anchor)
+        new_res = state.residual
+        if state.residual is not None:
+            leaves_p, treedef = jax.tree_util.tree_flatten(params)
+            leaves_a = treedef.flatten_up_to(state.anchor)
+            leaves_r = treedef.flatten_up_to(state.residual)
+            pairs = [
+                self.cross_pod_mean(p - a, r)
+                for p, a, r in zip(leaves_p, leaves_a, leaves_r)
+            ]
+            mean_delta = treedef.unflatten([y for y, _ in pairs])
+            new_res = treedef.unflatten([r for _, r in pairs])
+        else:
+            mean_delta = tree_map(
+                lambda p, a: self.cross_pod_mean(p - a),
+                params, state.anchor)
         new_vel = tree_map(
             lambda v, d: self.outer_momentum * v + d,
             state.velocity, mean_delta)
@@ -206,7 +258,41 @@ class LocalSGD:
             lambda a, v: a + self.outer_lr * v,
             state.anchor, new_vel)
         return new_params, OuterState(anchor=new_params,
-                                      velocity=new_vel)
+                                      velocity=new_vel,
+                                      residual=new_res)
+
+    def merge_optimizer_state(self, opt_state):
+        """Cross-pod MERGE of pod-local optimizer moments at a sync
+        point — the alternative to resetting them (which discards the
+        curvature estimate K steps built) or leaving them divergent
+        (which fights the freshly-averaged params).
+
+        Any state node exposing ``mu``/``nu`` (optax's ScaleByAdamState
+        shape, duck-typed) gets both moments replaced by their
+        uncompressed cross-pod means: averaged ``nu`` is each pod's
+        second-moment estimate of the SAME post-sync iterate, and
+        averaged ``mu`` is consistent with the averaged anchor delta
+        the params just took. ``count`` (and every other field/leaf)
+        is untouched — pods step in lockstep so counts already agree.
+        The int8 wire is deliberately NOT used here (see
+        ``_plain_cross_pod_mean``). K=1 never constructs LocalSGD, so
+        the synchronous path cannot reach this."""
+        import jax
+
+        def _is_moments(node) -> bool:
+            return (hasattr(node, "mu") and hasattr(node, "nu")
+                    and hasattr(node, "_replace"))
+
+        def _merge(node):
+            if not _is_moments(node):
+                return node
+            mean_tree = lambda t: jax.tree_util.tree_map(
+                self._plain_cross_pod_mean, t)
+            return node._replace(mu=mean_tree(node.mu),
+                                 nu=mean_tree(node.nu))
+
+        return jax.tree_util.tree_map(
+            _merge, opt_state, is_leaf=_is_moments)
 
     def maybe_outer_sync(self, params, state: OuterState, step,
                          ) -> Tuple[Any, OuterState]:

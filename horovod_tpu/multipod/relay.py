@@ -45,10 +45,11 @@ import logging
 import os
 import threading
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from ..health.fleet import HEALTH_SCOPE as _HEALTH_SCOPE
 from ..runner.http.http_server import RELAY_BATCH_PATH, KVStoreServer
+from ..utils import faults as _faults
 from ..utils import retry as _retry
 from ..utils.metrics import METRICS_PUSH_SCOPE
 
@@ -114,14 +115,30 @@ class PodRelayServer(KVStoreServer):
     restricts forwarding to the named scopes (None = forward every
     scope — flight dumps, manifests, registrations and all)."""
 
-    def __init__(self, pod_label: str, root: Tuple[str, int],
+    def __init__(self, pod_label: str,
+                 root: Union[Tuple[str, int],
+                             Sequence[Tuple[str, int]]],
                  port: int = 0, flush_interval_s: float = 1.0,
                  forward_scopes: Optional[List[str]] = None,
                  state_path: Optional[str] = None,
                  policy: Optional[_retry.RetryPolicy] = None):
         super().__init__(port=port, state_path=state_path)
         self.pod_label = pod_label
-        self.root = root
+        # ``root`` accepts one (addr, port) — today's single root,
+        # unchanged — or the full sharded root set in replica-id order
+        # (docs/control_plane.md). With >1 root the relay fetches the
+        # shard map and splits each flush by owner; roots[0] stays the
+        # fallback target while no map is available.
+        if root and isinstance(root[0], (tuple, list)):
+            self.roots = [(str(a), int(p)) for a, p in root]
+        else:
+            self.roots = [(str(root[0]), int(root[1]))]
+        self.root = self.roots[0]
+        self._shard_client = None
+        if len(self.roots) > 1:
+            from ..runner.http.http_client import ShardClient
+            self._shard_client = ShardClient(self.roots)
+        self.reroutes = 0
         self.flush_interval_s = float(flush_interval_s)
         self.forward_scopes = (
             set(forward_scopes) if forward_scopes is not None else None)
@@ -170,41 +187,105 @@ class PodRelayServer(KVStoreServer):
             for scope, key, value in batch:
                 self._pending.setdefault((scope, key), value)
 
+    def _owner_targets(
+            self, batch: List[Tuple[str, str, bytes]],
+    ) -> Dict[Tuple[str, int], List[Tuple[str, str, bytes]]]:
+        """Group a flush by the root that owns each entry. One group at
+        ``roots[0]`` when unsharded or while no shard map is reachable
+        (the single-root path, bit-for-bit)."""
+        if self._shard_client is None:
+            return {self.root: list(batch)}
+        try:
+            m = self._shard_client.shard_map()
+        except Exception:
+            m = None
+        if m is None or m is False:
+            return {self.root: list(batch)}
+        groups: Dict[Tuple[str, int],
+                     List[Tuple[str, str, bytes]]] = {}
+        for s, k, v in batch:
+            target = m.addr_of(m.owner_of(s, k))
+            groups.setdefault(target, []).append((s, k, v))
+        return groups
+
     def flush_once(self) -> int:
-        """Forward everything pending as ONE batched PUT. Returns the
-        entry count forwarded (0 = nothing pending). Raises nothing:
-        failures re-merge the batch and count on the outage tracker."""
+        """Forward everything pending, batched per shard owner (ONE
+        PUT total in the single-root world). Returns the entry count
+        forwarded. Raises nothing: failed groups re-merge and count on
+        the outage tracker; entries a replica rejects as misrouted
+        (stale map during a takeover) re-merge too and the next flush
+        lands them on the new owner."""
         batch = self._take_pending()
         if not batch:
             return 0
-        # JSON + base64, matching http_server.decode_relay_batch (the
-        # root refuses to unpickle network input)
-        body = json.dumps([
-            {"scope": s, "key": k,
-             "value_b64": base64.b64encode(v).decode()}
-            for s, k, v in batch
-        ]).encode()
-        addr, port = self.root
+        groups = self._owner_targets(batch)
+        sent = 0
+        rejected_any = False
+        failed: Optional[Exception] = None
+        for (addr, port), ents in groups.items():
+            # JSON + base64, matching http_server.decode_relay_batch
+            # (the root refuses to unpickle network input)
+            body = json.dumps([
+                {"scope": s, "key": k,
+                 "value_b64": base64.b64encode(v).decode()}
+                for s, k, v in ents
+            ]).encode()
 
-        def _do() -> None:
-            req = urllib.request.Request(
-                f"http://{addr}:{port}/{RELAY_BATCH_PATH}/"
-                f"{self.pod_label}",
-                data=body, method="PUT",
-            )
-            with urllib.request.urlopen(req, timeout=_TIMEOUT_S):
+            def _do() -> bytes:
+                req = urllib.request.Request(
+                    f"http://{addr}:{port}/{RELAY_BATCH_PATH}/"
+                    f"{self.pod_label}",
+                    data=body, method="PUT",
+                )
+                with urllib.request.urlopen(
+                        req, timeout=_TIMEOUT_S) as resp:
+                    return resp.read()
+
+            try:
+                raw = self._policy.call(_do, point="relay.forward")
+            except Exception as e:
+                self._restore_pending(ents)
+                failed = e
+                continue
+            sent += len(ents)
+            # a sharded replica answers JSON with per-entry rejects
+            # (owner moved under us); an unsharded root answers b"ok"
+            try:
+                resp_obj = json.loads(raw)
+            except Exception:
+                resp_obj = None
+            if isinstance(resp_obj, dict) and resp_obj.get("rejected"):
+                rej = resp_obj["rejected"]
+                by_key = {(s, k): v for s, k, v in ents}
+                requeue = [
+                    (r["scope"], r["key"],
+                     by_key[(r["scope"], r["key"])])
+                    for r in rej
+                    if (r["scope"], r["key"]) in by_key
+                ]
+                self._restore_pending(requeue)
+                self.reroutes += len(requeue)
+                sent -= len(requeue)
+                rejected_any = True
+        if rejected_any and self._shard_client is not None:
+            try:
+                self._shard_client.refresh_map()
+            except Exception:
                 pass
-
-        try:
-            self._policy.call(_do, point="relay.forward")
-        except Exception as e:
-            self._restore_pending(batch)
-            self._outage.failure(e)
-            return 0
-        self._outage.success()
-        self.forwarded_batches += 1
-        self.forwarded_entries += len(batch)
-        return len(batch)
+        if failed is not None:
+            self._outage.failure(failed)
+            if self._shard_client is not None:
+                # a dead owner also means the map likely moved
+                try:
+                    self._shard_client.refresh_map()
+                except Exception:
+                    pass
+        else:
+            self._outage.success()
+        if sent:
+            self.forwarded_batches += 1
+            self.forwarded_entries += sent
+        return sent
 
     def _forward_loop(self) -> None:
         # fixed cadence: ONE upward PUT per interval regardless of the
@@ -214,6 +295,16 @@ class PodRelayServer(KVStoreServer):
         # staleness = one interval; an empty interval costs nothing
         # (flush_once returns before any network on empty pending).
         while not self._stop.wait(self.flush_interval_s):
+            # launcher-supervised kill point: a ``relay.proc:kill``
+            # fault spec (utils/faults.py) takes the whole relay
+            # process down here — the deterministic crash the
+            # supervisor's backoff-restart is tested against
+            # (scripts/multipod_check.py)
+            try:
+                _faults.inject("relay.proc", pod=self.pod_label)
+            except _faults.InjectedFault:
+                LOG.warning("relay %s: injected fault in forwarder",
+                            self.pod_label)
             self.flush_once()
         self.flush_once()  # final drain: clean shutdowns lose nothing
 
@@ -244,4 +335,53 @@ class PodRelayServer(KVStoreServer):
             "forwarded_entries": self.forwarded_entries,
             "pending": pending,
             "received_requests": self.request_count,
+            "reroutes": self.reroutes,
         }
+
+
+def relay_main(argv: Optional[List[str]] = None) -> int:
+    """Process entry point for one launcher-supervised pod relay
+    (``python -m horovod_tpu.multipod.relay``). runner/launch.py spawns
+    one per pod, exports its address to the pod's workers, and restarts
+    it under backoff on crash; after a restart the relay re-fetches the
+    shard map, so its next batched PUT lands on the post-takeover
+    owners. Fault specs arm from the environment (utils/faults.py), so
+    ``relay.proc:kill`` rounds kill the real process from inside its
+    own forward loop."""
+    import argparse
+
+    from ..runner.http.ring import parse_root_addrs
+
+    p = argparse.ArgumentParser(prog="pod-relay")
+    p.add_argument("--pod-label", required=True)
+    p.add_argument("--roots", required=True,
+                   help="comma-separated addr:port (the root set; one "
+                        "entry = plain single root)")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--flush-interval", type=float, default=1.0)
+    p.add_argument("--state-path", default=None)
+    args = p.parse_args(argv)
+    roots = parse_root_addrs(args.roots)
+    srv = PodRelayServer(
+        args.pod_label,
+        roots if len(roots) > 1 else roots[0],
+        port=args.port,
+        flush_interval_s=args.flush_interval,
+        state_path=args.state_path)
+    port = srv.start_server()
+    LOG.info("pod relay %s serving on port %d (roots: %s)",
+             args.pod_label, port, args.roots)
+    try:
+        while True:
+            import time as _time
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.shutdown_server()
+    return 0
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    raise SystemExit(relay_main())

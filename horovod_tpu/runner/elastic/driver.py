@@ -53,6 +53,7 @@ class ElasticDriver:
         exec_fn: Optional[Callable] = None,
         nics: Optional[List[str]] = None,
         rendezvous_state_dir: Optional[str] = None,
+        control_supervisor=None,
     ):
         self._host_manager = host_manager
         self._settings = settings
@@ -89,6 +90,13 @@ class ElasticDriver:
                     self._rank_assignments,
                 )
 
+        # launcher-spawned control-plane tier (sharded root replicas +
+        # pod relays, runner/supervisor.py): the driver owns its
+        # lifetime — elastic rounds come and go, the tier persists
+        # across them and is reaped exactly once at driver stop
+        # (docs/control_plane.md)
+        self._control_supervisor = control_supervisor
+
         self._shutdown = threading.Event()
         self._notify_addr: Optional[str] = None
         self._notify_retry = retry.RetryPolicy(
@@ -115,6 +123,8 @@ class ElasticDriver:
         if self._discovery_thread is not None:
             self._discovery_thread.join(timeout=5)
         self._rendezvous.shutdown_server()
+        if self._control_supervisor is not None:
+            self._control_supervisor.shutdown()
 
     def wait_for_available_slots(
         self, min_np: int, timeout_s: Optional[float] = None

@@ -15,14 +15,24 @@ path.
 
 from __future__ import annotations
 
+import json as _json_mod
+import os
+import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Optional
+from typing import List, Optional, Tuple
 
 from ...utils import faults, retry
 
 _TIMEOUT_S = 10.0
+
+#: env pair publishing the sharded root set (runner/launch.py
+#: --root-replicas exports it): comma-separated ``addr:port`` in
+#: replica-id order. When set, the module-level verbs transparently
+#: shard-route any call addressed at a configured root; when unset,
+#: behavior is byte-identical to the single-root client.
+ROOT_ADDRS_ENVS = ("HVD_TPU_ROOT_ADDRS", "HOROVOD_ROOT_ADDRS")
 
 
 def _retryable(exc: BaseException) -> bool:
@@ -33,7 +43,8 @@ def _retryable(exc: BaseException) -> bool:
     return isinstance(exc, (OSError, EOFError))
 
 
-def put(addr: str, port: int, scope: str, key: str, value: bytes) -> None:
+def _put_direct(addr: str, port: int, scope: str, key: str,
+                value: bytes) -> None:
     def _do() -> None:
         faults.inject("http.put", scope=scope, key=key)
         req = urllib.request.Request(
@@ -45,7 +56,8 @@ def put(addr: str, port: int, scope: str, key: str, value: bytes) -> None:
     retry.default_policy().call(_do, point="http.put", retryable=_retryable)
 
 
-def get(addr: str, port: int, scope: str, key: str) -> Optional[bytes]:
+def _get_direct(addr: str, port: int, scope: str,
+                key: str) -> Optional[bytes]:
     def _do() -> Optional[bytes]:
         faults.inject("http.get", scope=scope, key=key)
         try:
@@ -61,6 +73,20 @@ def get(addr: str, port: int, scope: str, key: str) -> Optional[bytes]:
     return retry.default_policy().call(
         _do, point="http.get", retryable=_retryable
     )
+
+
+def put(addr: str, port: int, scope: str, key: str, value: bytes) -> None:
+    c = _env_client_for(addr, port)
+    if c is not None:
+        return c.put(scope, key, value)
+    return _put_direct(addr, port, scope, key, value)
+
+
+def get(addr: str, port: int, scope: str, key: str) -> Optional[bytes]:
+    c = _env_client_for(addr, port)
+    if c is not None:
+        return c.get(scope, key)
+    return _get_direct(addr, port, scope, key)
 
 
 def wait_for_key(
@@ -104,7 +130,7 @@ def server_clock(addr: str, port: int,
     return float(body["time_unix"]), time.monotonic() - t0
 
 
-def delete(addr: str, port: int, scope: str, key: str) -> None:
+def _delete_direct(addr: str, port: int, scope: str, key: str) -> None:
     def _do() -> None:
         faults.inject("http.delete", scope=scope, key=key)
         req = urllib.request.Request(
@@ -116,3 +142,258 @@ def delete(addr: str, port: int, scope: str, key: str) -> None:
     retry.default_policy().call(
         _do, point="http.delete", retryable=_retryable
     )
+
+
+def delete(addr: str, port: int, scope: str, key: str) -> None:
+    c = _env_client_for(addr, port)
+    if c is not None:
+        return c.delete(scope, key)
+    return _delete_direct(addr, port, scope, key)
+
+
+# ---------------------------------------------------------------- sharding
+
+class ShardClient:
+    """Multi-root client for the sharded control plane
+    (docs/control_plane.md).
+
+    Holds the configured root set plus a cached, epoch-stamped shard
+    map fetched from ``GET /shard_map``; routes each (scope, key) verb
+    to its ring owner locally (no per-request map traffic). Two
+    recovery legs, both invisible to callers:
+
+    * **421 NotOwner** (our map is stale — a takeover moved the key):
+      refresh the map from the owner named in the reply and retry the
+      verb. Bounded hops — a healthy ring resolves in one.
+    * **dead owner** (transport errors exhausted the per-call
+      RetryPolicy): poll the surviving roots for a newer map until the
+      fencing epoch bumps, then retry at the new owner. Bounded by
+      ``takeover_timeout_s`` — covers the lease TTL plus takeover
+      broadcast, so workers ride a replica SIGKILL with zero giveups
+      (scripts/multipod_check.py).
+
+    Against roots that answer 404 on ``/shard_map`` (a plain
+    single-root server) the client degrades to direct calls at
+    ``roots[0]`` — today's path, byte-identical.
+
+    ``clock``/``sleep`` are injectable for deterministic tests.
+    """
+
+    MAX_REDIRECTS = 8
+
+    def __init__(self, roots: List[Tuple[str, int]],
+                 takeover_timeout_s: float = 30.0,
+                 clock=time.monotonic, sleep=time.sleep):
+        if not roots:
+            raise ValueError("ShardClient needs at least one root")
+        self.roots = [(str(a), int(p)) for a, p in roots]
+        self.takeover_timeout_s = float(takeover_timeout_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._map = None  # Membership | False (unsharded) | None
+        self._mlock = threading.Lock()
+        self.redirects = 0
+        self.map_refreshes = 0
+
+    # -- shard map ----------------------------------------------------------
+
+    def _fetch_map_from(self, addr: str, port: int):
+        """One root's view: a Membership, False for an unsharded
+        server, or raises on transport failure."""
+        from .ring import Membership
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}:{port}/shard_map",
+                    timeout=_TIMEOUT_S) as resp:
+                return Membership.from_json(resp.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def refresh_map(self, prefer: Optional[Tuple[str, int]] = None):
+        """Re-fetch the shard map, newest epoch wins. ``prefer`` (the
+        owner a 421 hinted at) is asked first — it is the one node
+        guaranteed to hold the post-takeover record."""
+        self.map_refreshes += 1
+        newest = None
+        unsharded = False
+        targets = ([prefer] if prefer else []) + self.roots
+        for addr, port in targets:
+            try:
+                m = self._fetch_map_from(addr, port)
+            except Exception:
+                continue
+            if m is False:
+                unsharded = True
+                continue
+            if newest is None or m.epoch > newest.epoch:
+                newest = m
+        with self._mlock:
+            if newest is not None:
+                if self._map in (None, False) \
+                        or newest.epoch > self._map.epoch:
+                    self._map = newest
+            elif unsharded:
+                self._map = False
+        if newest is None and not unsharded:
+            raise OSError("no root replica answered /shard_map")
+        return self._map
+
+    def shard_map(self):
+        with self._mlock:
+            m = self._map
+        if m is None:
+            m = self.refresh_map()
+        return m
+
+    def owner_addr(self, scope: str, key: str) -> Tuple[str, int]:
+        m = self.shard_map()
+        if m is False:
+            return self.roots[0]
+        return m.addr_of(m.owner_of(scope, key))
+
+    # -- verbs --------------------------------------------------------------
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        self._routed(_put_direct, scope, key, value)
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        return self._routed(_get_direct, scope, key)
+
+    def delete(self, scope: str, key: str) -> None:
+        self._routed(_delete_direct, scope, key)
+
+    def wait_for_key(self, scope: str, key: str,
+                     timeout_s: float = 60.0) -> bytes:
+        deadline = retry.Deadline(timeout_s, clock=self._clock)
+        last_err: Optional[Exception] = None
+        while not deadline.expired():
+            try:
+                v = self.get(scope, key)
+            except Exception as e:
+                if not _retryable(e):
+                    raise
+                last_err = e
+                v = None
+            if v is not None:
+                return v
+            self._sleep(0.2)
+        raise TimeoutError(
+            f"key {scope}/{key} not published within {timeout_s}s"
+            + (f" (last error: {last_err})" if last_err else "")
+        )
+
+    def _routed(self, fn, scope: str, key: str, *args):
+        """Run one direct verb at the key's owner, riding 421
+        redirects and dead-owner takeover waits."""
+        deadline = retry.Deadline(self.takeover_timeout_s,
+                                  clock=self._clock)
+        hops = 0
+        last_err: Optional[BaseException] = None
+        while True:
+            addr, port = self.owner_addr(scope, key)
+            try:
+                return fn(addr, port, scope, key, *args)
+            except urllib.error.HTTPError as e:
+                if e.code != 421:
+                    raise
+                # stale map: adopt the hinted owner's view and re-route
+                self.redirects += 1
+                hops += 1
+                if hops > self.MAX_REDIRECTS:
+                    raise OSError(
+                        f"shard routing for {scope}/{key} did not "
+                        f"converge after {hops} redirects"
+                    ) from e
+                prefer = None
+                try:
+                    hint = _json_mod.loads(e.read())["owner"]
+                    prefer = (str(hint["addr"]), int(hint["port"]))
+                except Exception:
+                    pass
+                self.refresh_map(prefer=prefer)
+                last_err = e
+            except (OSError, EOFError) as e:
+                # owner down and per-call retries exhausted: wait out
+                # the takeover (survivors fence after the lease TTL and
+                # publish a new-epoch map), bounded by our deadline
+                if deadline.expired():
+                    raise
+                last_err = e
+                self._sleep(0.2)
+                try:
+                    self.refresh_map()
+                except OSError:
+                    if deadline.expired():
+                        raise
+            if deadline.expired():
+                raise OSError(
+                    f"no owner for {scope}/{key} within "
+                    f"{self.takeover_timeout_s}s (last: {last_err})")
+
+
+def parse_root_addrs_env() -> Optional[List[Tuple[str, int]]]:
+    """The configured multi-root set, or None when unsharded."""
+    from .ring import parse_root_addrs
+
+    spec = next((os.environ[n] for n in ROOT_ADDRS_ENVS
+                 if os.environ.get(n)), None)
+    if not spec:
+        return None
+    try:
+        roots = parse_root_addrs(spec)
+    except ValueError:
+        return None
+    return roots or None
+
+
+_ENV_CLIENT: Optional[ShardClient] = None
+_ENV_CLIENT_SPEC: Optional[str] = None
+_ENV_CLIENT_LOCK = threading.Lock()
+
+
+def _env_client_for(addr: str, port: int) -> Optional[ShardClient]:
+    """The process-wide ShardClient when ``HOROVOD_ROOT_ADDRS`` is set
+    AND (addr, port) addresses a configured root — legacy callers that
+    target a specific non-root server (relays, test fixtures) keep
+    their direct path untouched."""
+    global _ENV_CLIENT, _ENV_CLIENT_SPEC
+    spec = next((os.environ[n] for n in ROOT_ADDRS_ENVS
+                 if os.environ.get(n)), None)
+    if not spec:
+        return None
+    roots = parse_root_addrs_env()
+    if not roots:
+        return None
+    if not any(int(port) == p and str(addr) == a for a, p in roots):
+        return None
+    with _ENV_CLIENT_LOCK:
+        if _ENV_CLIENT is None or _ENV_CLIENT_SPEC != spec:
+            _ENV_CLIENT = ShardClient(roots)
+            _ENV_CLIENT_SPEC = spec
+        return _ENV_CLIENT
+
+
+def reset_shard_client() -> None:
+    """Drop the cached env-built ShardClient (tests re-point roots)."""
+    global _ENV_CLIENT, _ENV_CLIENT_SPEC
+    with _ENV_CLIENT_LOCK:
+        _ENV_CLIENT = None
+        _ENV_CLIENT_SPEC = None
+
+
+def resolve_owner(addr: str, port: int, scope: str,
+                  key: str) -> Tuple[str, int]:
+    """Where a write for (scope, key) should land: the shard owner
+    when (addr, port) names a configured sharded root, else (addr,
+    port) unchanged. For callers that manage their own HTTP (e.g.
+    elastic/replication.py's raw manifest path)."""
+    c = _env_client_for(addr, port)
+    if c is None:
+        return str(addr), int(port)
+    try:
+        return c.owner_addr(scope, key)
+    except Exception:
+        return str(addr), int(port)

@@ -62,6 +62,20 @@ def decode_relay_batch(body: bytes):
         out.append((scope, key, value))
     return out
 
+# sharded-root control namespace (runner/http/ring.py): replica-to-
+# replica traffic (leases, fenced backup sync, fence broadcasts, rejoin
+# dumps) lives under this reserved scope so it can never collide with —
+# or be shard-routed like — user data. Ownership checks skip it: every
+# replica answers its own `_cp` routes.
+CP_SCOPE = "_cp"
+
+#: HTTP status for a scope/key request that reached a replica which
+#: does not own it under the current shard map: 421 Misdirected
+#: Request, body JSON {"error": "NotOwner", "epoch": E, "owner":
+#: {"id", "addr", "port"}}. Clients (http_client.ShardClient) refresh
+#: their map from the hint and retry — never treated as a failure.
+NOT_OWNER_CODE = 421
+
 # driver-side receipt stamps for worker flight dumps (PUT /flight/<r>):
 # scripts/flight_analyze.py reads them as a second clock-alignment
 # signal next to each dump's own /clock-probe offset
@@ -76,6 +90,27 @@ class _KVHandler(BaseHTTPRequestHandler):
         if len(parts) != 2 or not parts[0] or not parts[1]:
             return None
         return parts[0], parts[1]
+
+    def _shard(self):
+        """The owning :class:`ShardReplica`, or None on an unsharded
+        server. EVERY shard behavior hangs off this being non-None, so
+        a plain KVStoreServer/RendezvousServer executes byte-identical
+        pre-shard code paths (--root-replicas 1 contract)."""
+        return getattr(self.server, "shard", None)
+
+    def _misrouted(self, scope: str, key: str) -> bool:
+        """Ownership gate for one scope/key verb: replies 421 with the
+        owner hint and returns True when a sharded replica does not own
+        the entry. False (serve it) when unsharded, owned, or an
+        internal scope."""
+        shard = self._shard()
+        if shard is None or scope == CP_SCOPE:
+            return False
+        rej = shard.not_owner_response(scope, key)
+        if rej is None:
+            return False
+        self._reply(*rej)
+        return True
 
     def _count(self) -> None:
         """Request-count instrumentation: the control-plane fan-in
@@ -109,11 +144,20 @@ class _KVHandler(BaseHTTPRequestHandler):
             # with the scope/key namespace (always two segments).
             from ...utils import metrics
 
-            with self.server.lock:  # type: ignore[attr-defined]
-                pushed = dict(
-                    self.server.store.get(  # type: ignore[attr-defined]
-                        metrics.METRICS_PUSH_SCOPE, {})
-                )
+            shard = self._shard()
+            if shard is not None:
+                # sharded root: pushed summaries hash across replicas,
+                # so one replica's local scope is a fraction of the
+                # fleet — fold the shard owners' slices back together
+                # before rendering (the /health//metrics satellite fix;
+                # tests/test_control_plane.py regression-gates it)
+                pushed = shard.collect_scope(metrics.METRICS_PUSH_SCOPE)
+            else:
+                with self.server.lock:  # type: ignore[attr-defined]
+                    pushed = dict(
+                        self.server.store.get(  # type: ignore[attr-defined]
+                            metrics.METRICS_PUSH_SCOPE, {})
+                    )
             ctype, body = metrics.exposition(pushed or None)
             self.send_response(200)
             self.send_header("Content-Type", ctype)
@@ -130,11 +174,18 @@ class _KVHandler(BaseHTTPRequestHandler):
             # still reads one raw summary through the scope namespace.
             from ...health import fleet
 
-            with self.server.lock:  # type: ignore[attr-defined]
-                pushed = dict(
-                    self.server.store.get(  # type: ignore[attr-defined]
-                        fleet.HEALTH_SCOPE, {})
-                )
+            shard = self._shard()
+            if shard is not None:
+                # same shard fan-in as /metrics: the fleet verdict must
+                # see EVERY rank's summary, not this replica's hash
+                # slice of them
+                pushed = shard.collect_scope(fleet.HEALTH_SCOPE)
+            else:
+                with self.server.lock:  # type: ignore[attr-defined]
+                    pushed = dict(
+                        self.server.store.get(  # type: ignore[attr-defined]
+                            fleet.HEALTH_SCOPE, {})
+                    )
             self._reply(200, json.dumps(
                 fleet.evaluate_store(pushed)).encode())
             return
@@ -146,12 +197,32 @@ class _KVHandler(BaseHTTPRequestHandler):
             self._reply(200, json.dumps(
                 {"time_unix": time.time()}).encode())
             return
+        if path == "/shard_map":
+            # the epoch-stamped membership record: clients/relays route
+            # from it and refresh it on 421. 404 on an unsharded server
+            # is the client's "plain single root" signal.
+            shard = self._shard()
+            if shard is None:
+                self._reply(404, b"not sharded")
+            else:
+                self._reply(200, shard.membership_json())
+            return
         if self._injected_503():
             return
         sk = self._split()
         store = self.server.store  # type: ignore[attr-defined]
         if sk is None:
             self._reply(400, b"bad path")
+            return
+        if sk[0] == CP_SCOPE:
+            shard = self._shard()
+            if shard is None:
+                self._reply(404, b"not sharded")
+                return
+            code, resp = shard.handle_cp_get(sk[1])
+            self._reply(code, resp)
+            return
+        if self._misrouted(sk[0], sk[1]):
             return
         with self.server.lock:  # type: ignore[attr-defined]
             value = store.get(sk[0], {}).get(sk[1])
@@ -186,6 +257,17 @@ class _KVHandler(BaseHTTPRequestHandler):
             return
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if sk[0] == CP_SCOPE:
+            # replica-to-replica control traffic: leases, fenced backup
+            # sync, fence broadcasts. Epoch discipline (stale → 409)
+            # lives in ShardReplica.handle_cp_put.
+            shard = self._shard()
+            if shard is None:
+                self._reply(404, b"not sharded")
+                return
+            code, resp = shard.handle_cp_put(sk[1], body)
+            self._reply(code, resp)
+            return
         if sk[0] == RELAY_BATCH_PATH:
             # one pod relay's coalesced forward: unpack into the store
             # under the original scopes, exactly as if each entry had
@@ -197,11 +279,36 @@ class _KVHandler(BaseHTTPRequestHandler):
             except Exception:
                 self._reply(400, b"bad relay batch")
                 return
+            shard = self._shard()
+            if shard is not None:
+                # sharded root: apply the entries this replica owns,
+                # hand the misrouted rest back with owner hints —
+                # all-or-nothing per entry, never per batch, so one
+                # takeover mid-batch costs the relay one re-route
+                # instead of the whole batch (multipod/relay.py splits
+                # by owner up front; rejects only happen on a stale
+                # map)
+                owned, rejected = shard.partition_owned(entries)
+                with self.server.lock:  # type: ignore[attr-defined]
+                    for scope, key, value in owned:
+                        self._store_one(str(scope), str(key), value)
+                self.server.dirty.set()  # type: ignore[attr-defined]
+                shard.enqueue_backups(
+                    [(s, k, v) for s, k, v in owned])
+                shard.drain_backups()
+                self._reply(200, json.dumps({
+                    "applied": len(owned),
+                    "rejected": rejected,
+                    "epoch": shard.epoch,
+                }).encode())
+                return
             with self.server.lock:  # type: ignore[attr-defined]
                 for scope, key, value in entries:
                     self._store_one(str(scope), str(key), value)
             self.server.dirty.set()  # type: ignore[attr-defined]
             self._reply(200, b"ok")
+            return
+        if self._misrouted(sk[0], sk[1]):
             return
         on_mutation = getattr(self.server, "on_mutation", None)
         with self.server.lock:  # type: ignore[attr-defined]
@@ -215,6 +322,17 @@ class _KVHandler(BaseHTTPRequestHandler):
                 # touches its own pending dict — no lock cycle.
                 on_mutation(sk[0], sk[1], body)
         self.server.dirty.set()  # type: ignore[attr-defined]
+        shard = self._shard()
+        if shard is not None:
+            # write-through to the per-key backup BEFORE acking: once
+            # the client sees 200, the entry survives this replica's
+            # SIGKILL (the zero-lost-scopes contract,
+            # scripts/multipod_check.py root-replica-kill). Outside the
+            # store lock — the forward is a network call; last-write-
+            # wins through the pending dict keeps racing same-key PUTs
+            # ordered.
+            shard.enqueue_backups([(sk[0], sk[1], body)])
+            shard.drain_backups()
         self._reply(200, b"ok")
 
     def do_DELETE(self):
@@ -225,9 +343,17 @@ class _KVHandler(BaseHTTPRequestHandler):
         if sk is None:
             self._reply(400, b"bad path")
             return
+        if self._misrouted(sk[0], sk[1]):
+            return
         with self.server.lock:  # type: ignore[attr-defined]
             self.server.store.get(sk[0], {}).pop(sk[1], None)  # type: ignore[attr-defined]
         self.server.dirty.set()  # type: ignore[attr-defined]
+        shard = self._shard()
+        if shard is not None:
+            # a tombstone (value None) propagates the delete to the
+            # backup so takeover can't resurrect the entry
+            shard.enqueue_backups([(sk[0], sk[1], None)])
+            shard.drain_backups()
         self._reply(200, b"ok")
 
     def _reply(self, code: int, body: bytes):
@@ -413,6 +539,607 @@ class KVStoreServer:
                 self._flush_stop.wait(self._flush_interval_s)
 
 
+class ShardReplica(KVStoreServer):
+    """One replica of the sharded root KV tier (docs/control_plane.md).
+
+    N of these, all built from the same ``roots`` list (index = replica
+    id, the ``HOROVOD_ROOT_ADDRS`` order), partition every (scope, key)
+    by consistent hashing (runner/http/ring.py). Each replica:
+
+    * serves the scope/key verbs for the entries it OWNS and answers
+      421 + owner hint for the rest (clients re-route — never an
+      error);
+    * write-through-replicates each owned mutation to the entry's ring
+      backup via ``PUT /_cp/sync/<id>`` before acking, so a SIGKILL of
+      the owner loses nothing: the backup IS the next owner on the
+      post-fence ring by construction;
+    * heartbeats a lease (its membership record) to its peers; when a
+      peer's lease lapses past ``lease_ttl_s``, the dead replica's ring
+      successor — deterministically, exactly one survivor — fences it
+      at epoch+1 and broadcasts the new record;
+    * rejects any replica-to-replica write stamped with a pre-fence
+      epoch (409) — a paused-then-resumed stale owner cannot corrupt
+      the new owner's data;
+    * on restart, adopts the newest peer map, rejoins at a fresh epoch,
+      and re-pulls its ranges from peers (``GET /_cp/dump``) before the
+      supervisor's next spawn-cycle traffic lands on it.
+
+    Generalizes the PR 6 persisted-state machinery: the on-disk
+    snapshot (store + membership epoch) still covers same-process
+    restart; the ``/_cp/sync`` stream covers the cross-replica case.
+
+    ``clock`` and ``auto_heartbeat=False`` make every timing decision
+    injectable — tests/test_control_plane.py drives takeover with a
+    fake clock and manual :meth:`heartbeat_once` calls.
+    """
+
+    HVD_CP_LEASE_TTL_S = 3.0
+    HVD_CP_HEARTBEAT_S = 0.5
+    _PEER_TIMEOUT_S = 5.0
+
+    def __init__(self, replica_id: int,
+                 roots: "List[Tuple[str, int]]",
+                 port: int = 0,
+                 state_path: Optional[str] = None,
+                 lease_ttl_s: float = HVD_CP_LEASE_TTL_S,
+                 heartbeat_interval_s: float = HVD_CP_HEARTBEAT_S,
+                 vnodes: Optional[int] = None,
+                 clock=time.monotonic,
+                 auto_heartbeat: bool = True,
+                 flush_interval_s: float = 0.3):
+        from .ring import (DEFAULT_VNODES, Membership,
+                           membership_for_roots)
+
+        self._restored_extra: Dict = {}
+        self.replica_id = int(replica_id)
+        bind_port = port or roots[self.replica_id][1]
+        super().__init__(port=bind_port, state_path=state_path,
+                         flush_interval_s=flush_interval_s)
+        self._clock = clock
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self._mlock = threading.RLock()
+        restored_m = self._restored_extra.get("membership")
+        if restored_m:
+            self._membership = Membership.from_json(restored_m)
+        else:
+            self._membership = membership_for_roots(
+                roots, vnodes=vnodes or DEFAULT_VNODES)
+        now = self._clock()
+        self._last_heard: Dict[int, float] = {
+            rid: now for rid in self._membership.alive}
+        # owner→backup replication queue: (scope, key) → value bytes,
+        # None = tombstone. Last-write-wins through the dict keeps
+        # racing same-key mutations ordered without holding the store
+        # lock across network calls.
+        self._backup_pending: Dict[Tuple[str, str],
+                                   Optional[bytes]] = {}
+        self._backup_plock = threading.Lock()
+        self._backup_flock = threading.Lock()  # serializes forwards
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._auto_heartbeat = bool(auto_heartbeat)
+        self.takeovers = 0
+        self.fenced_writes_rejected = 0
+        from ...utils import metrics as _metrics
+        lbl = str(self.replica_id)
+        self._m_takeovers = _metrics.registry.counter(
+            "hvd_cp_takeovers_total",
+            "shard takeovers claimed, by surviving replica",
+            ("replica",)).labels(lbl)
+        self._m_fenced = _metrics.registry.counter(
+            "hvd_cp_fenced_writes_total",
+            "stale-epoch replica-to-replica writes rejected (409)",
+            ("replica",)).labels(lbl)
+        self._m_epoch = _metrics.registry.gauge(
+            "hvd_cp_epoch",
+            "current fencing epoch of the shard membership record",
+            ("replica",)).labels(lbl)
+        self._m_epoch.set(self._membership.epoch)
+        self._httpd.shard = self  # type: ignore[attr-defined]
+
+    # -- membership views ---------------------------------------------------
+
+    @property
+    def membership(self):
+        with self._mlock:
+            return self._membership
+
+    @property
+    def epoch(self) -> int:
+        return self.membership.epoch
+
+    def membership_json(self) -> bytes:
+        return self.membership.to_json()
+
+    def adopt(self, m) -> bool:
+        """Merge a peer's record; True if it was strictly newer and we
+        switched to it (epochs totally order membership views)."""
+        with self._mlock:
+            if m.epoch <= self._membership.epoch:
+                return False
+            self._membership = m
+            for rid in m.alive:
+                self._last_heard.setdefault(rid, self._clock())
+        self._m_epoch.set(m.epoch)
+        self._httpd.dirty.set()  # type: ignore[attr-defined]
+        return True
+
+    def not_owner_response(
+            self, scope: str, key: str,
+    ) -> Optional[Tuple[int, bytes]]:
+        """None when this replica owns (scope, key) under the current
+        map; else the 421 reply carrying the owner hint."""
+        m = self.membership
+        owner = m.owner_of(scope, key)
+        if owner == self.replica_id:
+            return None
+        addr, port = m.addr_of(owner)
+        return NOT_OWNER_CODE, json.dumps({
+            "error": "NotOwner",
+            "epoch": m.epoch,
+            "owner": {"id": owner, "addr": addr, "port": port},
+        }).encode()
+
+    def partition_owned(self, entries):
+        """Split relay-batch entries into (owned, rejected-with-hints)
+        under ONE membership snapshot, so a concurrent takeover can't
+        split a batch against two different maps."""
+        m = self.membership
+        owned, rejected = [], []
+        for scope, key, value in entries:
+            owner = m.owner_of(scope, key)
+            if owner == self.replica_id:
+                owned.append((scope, key, value))
+            else:
+                addr, port = m.addr_of(owner)
+                rejected.append({
+                    "scope": scope, "key": key,
+                    "owner": {"id": owner, "addr": addr, "port": port},
+                })
+        return owned, rejected
+
+    # -- owner → backup replication -----------------------------------------
+
+    def enqueue_backups(self, entries) -> None:
+        """Queue owned mutations for backup write-through; entries are
+        (scope, key, value-bytes-or-None-tombstone)."""
+        with self._backup_plock:
+            for scope, key, value in entries:
+                self._backup_pending[(scope, key)] = value
+
+    def drain_backups(self) -> int:
+        """Forward everything queued to each entry's ring backup, one
+        batched ``/_cp/sync`` per target. Unreachable backups re-merge
+        (the heartbeat loop re-drains); a 409 means WE are fenced —
+        drop the batch, the new owner already took over. Returns
+        entries delivered."""
+        import base64
+        import urllib.error
+
+        with self._backup_flock:
+            with self._backup_plock:
+                pending = dict(self._backup_pending)
+                self._backup_pending.clear()
+            if not pending:
+                return 0
+            m = self.membership
+            by_target: Dict[int, List] = {}
+            for (scope, key), value in pending.items():
+                rid = m.backup_of(scope, key)
+                if rid is None or rid == self.replica_id:
+                    continue  # single-replica world: no backup leg
+                by_target.setdefault(rid, []).append(
+                    (scope, key, value))
+            delivered = 0
+            for rid, ents in by_target.items():
+                addr, port = m.addr_of(rid)
+                body = json.dumps({
+                    "epoch": m.epoch,
+                    "entries": [
+                        {"scope": s, "key": k,
+                         "value_b64": (None if v is None else
+                                       base64.b64encode(v).decode())}
+                        for s, k, v in ents
+                    ],
+                }).encode()
+                try:
+                    self._peer_put(
+                        addr, port,
+                        f"{CP_SCOPE}/sync/{self.replica_id}", body)
+                    delivered += len(ents)
+                except urllib.error.HTTPError as e:
+                    if e.code == 409:
+                        LOG.warning(
+                            "replica %d fenced by backup %d "
+                            "(stale epoch %d); dropping sync batch",
+                            self.replica_id, rid, m.epoch)
+                        continue
+                    self._requeue(ents)
+                except OSError:
+                    self._requeue(ents)
+            return delivered
+
+    def _requeue(self, ents) -> None:
+        with self._backup_plock:
+            for scope, key, value in ents:
+                # setdefault: a newer mutation queued meanwhile wins
+                self._backup_pending.setdefault((scope, key), value)
+
+    def backup_backlog(self) -> int:
+        with self._backup_plock:
+            return len(self._backup_pending)
+
+    def _peer_put(self, addr: str, port: int, path: str,
+                  body: bytes) -> bytes:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{addr}:{port}/{path}", data=body, method="PUT")
+        with urllib.request.urlopen(
+                req, timeout=self._PEER_TIMEOUT_S) as resp:
+            return resp.read()
+
+    def _peer_get(self, addr: str, port: int, path: str) -> bytes:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://{addr}:{port}/{path}",
+                timeout=self._PEER_TIMEOUT_S) as resp:
+            return resp.read()
+
+    # -- replica-to-replica routes (handler delegates) ----------------------
+
+    def handle_cp_put(self, sub: str,
+                      body: bytes) -> Tuple[int, bytes]:
+        from .ring import Membership
+
+        if sub.startswith("lease/"):
+            try:
+                sender = int(sub.split("/", 1)[1])
+                peer_m = Membership.from_json(body)
+            except Exception:
+                return 400, b"bad lease"
+            cur = self.membership
+            if peer_m.epoch < cur.epoch:
+                # stale lessor: it missed a fence — tell it so it
+                # refreshes instead of believing its old map
+                self.fenced_writes_rejected += 1
+                self._m_fenced.inc()
+                return 409, cur.to_json()
+            self.adopt(peer_m)
+            with self._mlock:
+                self._last_heard[sender] = self._clock()
+            return 200, self.membership_json()
+        if sub.startswith("sync/"):
+            import base64
+
+            try:
+                sender = int(sub.split("/", 1)[1])
+                payload = json.loads(body)
+                sender_epoch = int(payload["epoch"])
+                entries = payload["entries"]
+            except Exception:
+                return 400, b"bad sync"
+            cur = self.membership
+            if sender_epoch < cur.epoch:
+                # THE fencing moment: a deposed owner streaming pre-
+                # fence state is rejected wholesale (acceptance
+                # criterion; tests/test_control_plane.py)
+                self.fenced_writes_rejected += 1
+                self._m_fenced.inc()
+                return 409, cur.to_json()
+            with self.lock:
+                for e in entries:
+                    scope, key = str(e["scope"]), str(e["key"])
+                    v64 = e.get("value_b64")
+                    if v64 is None:
+                        self.store.get(scope, {}).pop(key, None)
+                    else:
+                        self.store.setdefault(scope, {})[key] = (
+                            base64.b64decode(v64))
+                self._last_heard[sender] = self._clock()
+            self._httpd.dirty.set()  # type: ignore[attr-defined]
+            return 200, b"ok"
+        if sub == "fence":
+            try:
+                peer_m = Membership.from_json(body)
+            except Exception:
+                return 400, b"bad fence"
+            self.adopt(peer_m)
+            return 200, self.membership_json()
+        return 400, b"bad _cp route"
+
+    def handle_cp_get(self, sub: str) -> Tuple[int, bytes]:
+        import base64
+
+        if sub == "dump":
+            # rejoin pull: everything this replica holds (primary +
+            # backup copies), minus the control scope
+            with self.lock:
+                snap = {
+                    scope: {k: base64.b64encode(v).decode()
+                            for k, v in kv.items()}
+                    for scope, kv in self.store.items()
+                    if scope != CP_SCOPE
+                }
+            return 200, json.dumps({"scopes": snap}).encode()
+        if sub.startswith("scope/"):
+            # /metrics + /health shard fan-in: one replica's local
+            # slice of a scope, merged by the serving replica
+            scope = sub[len("scope/"):]
+            with self.lock:
+                kv = {k: base64.b64encode(v).decode()
+                      for k, v in self.store.get(scope, {}).items()}
+            return 200, json.dumps({"keys": kv}).encode()
+        return 400, b"bad _cp route"
+
+    # -- scope fan-in (aggregated /metrics, /health) ------------------------
+
+    def collect_scope(self, scope: str) -> Dict[str, bytes]:
+        """This scope's entries across ALL live replicas: local slice
+        plus each peer's ``GET /_cp/scope/<scope>``. Best-effort on
+        peer outages — a dying replica must not take the fleet scrape
+        down with it; its slice reappears post-takeover from the
+        backup copies."""
+        import base64
+
+        with self.lock:
+            merged = dict(self.store.get(scope, {}))
+        m = self.membership
+        for rid in m.alive:
+            if rid == self.replica_id:
+                continue
+            addr, port = m.addr_of(rid)
+            try:
+                raw = self._peer_get(
+                    addr, port, f"{CP_SCOPE}/scope/{scope}")
+                for k, v64 in json.loads(raw).get("keys", {}).items():
+                    # local copy wins ties (we may hold the backup of a
+                    # peer's fresher write, but never the reverse)
+                    merged.setdefault(k, base64.b64decode(v64))
+            except Exception:
+                continue
+        return merged
+
+    # -- lease heartbeat + failure detection --------------------------------
+
+    def heartbeat_once(self) -> None:
+        """One lease round: push our record to each live peer, adopt
+        anything newer that comes back, then fence any peer whose lease
+        lapsed — IF we are its ring successor (exactly one survivor
+        claims, no dueling epochs)."""
+        import urllib.error
+
+        from .ring import Membership
+
+        faults.inject("root.replica", id=self.replica_id)
+        m = self.membership
+        now = self._clock()
+        for rid in m.alive:
+            if rid == self.replica_id:
+                continue
+            addr, port = m.addr_of(rid)
+            try:
+                raw = self._peer_put(
+                    addr, port, f"{CP_SCOPE}/lease/{self.replica_id}",
+                    m.to_json())
+                self.adopt(Membership.from_json(raw))
+                with self._mlock:
+                    self._last_heard[rid] = now
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    # we are the stale one: adopt the newer record the
+                    # rejecting peer returned
+                    try:
+                        self.adopt(Membership.from_json(e.read()))
+                    except Exception:
+                        pass
+                with self._mlock:
+                    self._last_heard[rid] = now  # alive, just newer
+            except OSError:
+                pass  # unreachable: lease keeps aging toward the TTL
+        # failure detection over the post-gossip record
+        m = self.membership
+        with self._mlock:
+            lapsed = [
+                rid for rid in m.alive
+                if rid != self.replica_id
+                and now - self._last_heard.get(rid, now)
+                > self.lease_ttl_s
+            ]
+        if not lapsed:
+            return
+        survivors = [r for r in m.alive if r not in lapsed]
+        claims = [rid for rid in lapsed
+                  if m.ring.successor(rid, survivors)
+                  == self.replica_id]
+        if claims:
+            self.fence_and_takeover(claims)
+
+    def fence_and_takeover(self, dead_ids) -> None:
+        """Fence ``dead_ids`` at epoch+1, broadcast the record, and
+        re-seed backups for every range this replica just inherited
+        (its copies' OLD backup was the dead owner itself — the new
+        ring assigns them a live one)."""
+        with self._mlock:
+            new_m = self._membership.fence(dead_ids)
+            self._membership = new_m
+        self.takeovers += 1
+        self._m_takeovers.inc()
+        self._m_epoch.set(new_m.epoch)
+        self._httpd.dirty.set()  # type: ignore[attr-defined]
+        LOG.warning(
+            "replica %d fenced %s at epoch %d (lease lapsed); "
+            "taking over their ranges", self.replica_id,
+            sorted(int(d) for d in dead_ids), new_m.epoch)
+        for rid in new_m.alive:
+            if rid == self.replica_id:
+                continue
+            addr, port = new_m.addr_of(rid)
+            try:
+                self._peer_put(addr, port, f"{CP_SCOPE}/fence",
+                               new_m.to_json())
+            except Exception:
+                pass  # they'll learn via lease gossip / 409s
+        self._reseed_backups()
+
+    def _reseed_backups(self) -> None:
+        """Queue every entry this replica now owns for backup sync —
+        run after any ring change so the replication invariant (each
+        owned entry has one live backup copy) is restored."""
+        m = self.membership
+        with self.lock:
+            owned = [
+                (scope, key, value)
+                for scope, kv in self.store.items()
+                if scope != CP_SCOPE
+                for key, value in kv.items()
+                if m.owner_of(scope, key) == self.replica_id
+            ]
+        if owned:
+            self.enqueue_backups(owned)
+            self.drain_backups()
+
+    def rejoin(self) -> bool:
+        """Restarted-replica re-entry: adopt the newest peer map; if we
+        were fenced, rejoin at a fresh epoch, broadcast it, and re-pull
+        our ranges from peers' dumps. True if a fenced rejoin
+        happened."""
+        import base64
+
+        from .ring import Membership
+
+        m = self.membership
+        for rid, addr, port in m.replicas:
+            if rid == self.replica_id:
+                continue
+            try:
+                raw = self._peer_get(addr, port, "shard_map")
+                self.adopt(Membership.from_json(raw))
+            except Exception:
+                continue
+        m = self.membership
+        if self.replica_id in m.alive:
+            return False  # never fenced (fast restart / fresh cluster)
+        with self._mlock:
+            new_m = self._membership.rejoin(self.replica_id)
+            self._membership = new_m
+            self._last_heard = {
+                rid: self._clock() for rid in new_m.alive}
+        self._m_epoch.set(new_m.epoch)
+        self._httpd.dirty.set()  # type: ignore[attr-defined]
+        for rid in new_m.alive:
+            if rid == self.replica_id:
+                continue
+            addr, port = new_m.addr_of(rid)
+            try:
+                self._peer_put(addr, port, f"{CP_SCOPE}/fence",
+                               new_m.to_json())
+                raw = self._peer_get(addr, port, f"{CP_SCOPE}/dump")
+                scopes = json.loads(raw).get("scopes", {})
+                with self.lock:
+                    for scope, kv in scopes.items():
+                        dst = self.store.setdefault(scope, {})
+                        for k, v64 in kv.items():
+                            # don't clobber anything we restored from
+                            # our own snapshot — it can only be newer
+                            # than what peers backed up for us
+                            dst.setdefault(k, base64.b64decode(v64))
+            except Exception:
+                continue
+        self._reseed_backups()
+        LOG.warning("replica %d rejoined at epoch %d",
+                    self.replica_id, new_m.epoch)
+        return True
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval_s):
+            try:
+                self.heartbeat_once()
+                self.drain_backups()  # retry any re-merged sync
+            except faults.InjectedFault:
+                raise
+            except Exception as e:  # never let the loop die silently
+                LOG.warning("replica %d heartbeat error: %s",
+                            self.replica_id, e)
+
+    def start_server(self) -> int:
+        port = super().start_server()
+        if self._auto_heartbeat and self._hb_thread is None:
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name=f"shard-hb-{self.replica_id}")
+            self._hb_thread.start()
+        return port
+
+    def shutdown_server(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=10)
+            self._hb_thread = None
+        self.drain_backups()  # final drain, same as the relay's
+        super().shutdown_server()
+
+    # -- persistence hooks --------------------------------------------------
+
+    def _state_extra(self) -> Dict:
+        return {"membership": self.membership.to_json()}
+
+    def _apply_state_extra(self, extra: Dict) -> None:
+        # runs inside KVStoreServer.__init__, before our own ctor body:
+        # stash for processing once ring/clock attrs exist
+        self._restored_extra = dict(extra or {})
+
+
+def replica_main(argv: Optional[List[str]] = None) -> int:
+    """Process entry point for one supervised shard replica
+    (``python -m horovod_tpu.runner.http.http_server ...``). The
+    launcher (runner/launch.py) spawns N of these and restarts them
+    under backoff; a restart lands here with the same ``--replica-id``
+    and rejoins the ring. Fault specs arrive via the environment
+    (utils/faults import-time arming), so ``root.replica:kill`` rounds
+    in scripts/multipod_check.py kill the real process from inside its
+    own heartbeat."""
+    import argparse
+
+    from .ring import parse_root_addrs
+
+    p = argparse.ArgumentParser(prog="shard-replica")
+    p.add_argument("--replica-id", type=int, required=True)
+    p.add_argument("--roots", required=True,
+                   help="comma-separated addr:port, index = replica id")
+    p.add_argument("--state-path", default=None)
+    p.add_argument("--lease-ttl", type=float,
+                   default=ShardReplica.HVD_CP_LEASE_TTL_S)
+    p.add_argument("--heartbeat-interval", type=float,
+                   default=ShardReplica.HVD_CP_HEARTBEAT_S)
+    p.add_argument("--vnodes", type=int, default=None)
+    args = p.parse_args(argv)
+    roots = parse_root_addrs(args.roots)
+    srv = ShardReplica(
+        args.replica_id, roots,
+        state_path=args.state_path,
+        lease_ttl_s=args.lease_ttl,
+        heartbeat_interval_s=args.heartbeat_interval,
+        vnodes=args.vnodes)
+    srv.start_server()
+    srv.rejoin()
+    LOG.info("shard replica %d serving on port %d",
+             args.replica_id, srv.port)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.shutdown_server()
+    return 0
+
+
 class RendezvousServer(KVStoreServer):
     """KV store that additionally publishes slot assignments
     (reference http_server.py:192; elastic variant swaps assignments on
@@ -499,3 +1226,10 @@ class RendezvousServer(KVStoreServer):
     @property
     def round(self) -> int:
         return self._round
+
+
+if __name__ == "__main__":
+    import sys
+
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(replica_main())

@@ -1,0 +1,276 @@
+"""Consistent-hash ring + replicated membership for the sharded root.
+
+The root KV tier (docs/control_plane.md) is N :class:`ShardReplica`
+servers (runner/http/http_server.py). This module is the pure,
+deterministic core they and every client share:
+
+* :class:`HashRing` — virtual-node consistent hashing of routing keys
+  onto replica ids. Ownership is computed over the LIVE replica set, so
+  removing a dead replica moves exactly its own ranges (to the next
+  live replica clockwise — which, by construction, is also the replica
+  its owner was streaming backups to) and adding one back moves only
+  the ranges it re-claims. That bounded-movement property is what makes
+  takeover a local event instead of a cluster-wide reshuffle, and it is
+  gated by tests/test_control_plane.py.
+* :class:`Membership` — the small replicated record every replica
+  stores: the configured replica set, which ids are fenced (dead), and
+  the **fencing epoch**. The epoch only ever increases; any
+  server-to-server write stamped with a stale epoch is rejected with
+  409 by the receiver, which is what makes a paused-then-resurrected
+  owner harmless (its writes bounce until it rejoins at the current
+  epoch).
+
+Routing key: ``(scope, key)`` hash by default, so one scope's keys
+spread over the replicas. Scopes in :data:`PINNED_SCOPES` route by
+scope name alone — the rendezvous scope must stay whole (a round is
+read as a unit), so it lands on exactly one replica.
+
+Everything here is process-local arithmetic on plain data — no
+sockets, no threads — so the ring logic is testable exhaustively and
+clients/relays can route locally from a fetched shard map.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: scopes routed by scope name alone (one replica owns the whole
+#: scope). The rendezvous round is published and read as a unit;
+#: splitting its keys across replicas would turn one atomic publish
+#: into N partial ones.
+PINNED_SCOPES = frozenset({"rendezvous"})
+
+#: virtual nodes per replica: enough to spread load evenly at small N
+#: (per-replica request share ≈ 1/N, scripts/control_plane_scaling.py
+#: --root-replicas) while keeping the ring tiny to serialize.
+DEFAULT_VNODES = 64
+
+
+def _hash64(s: str) -> int:
+    """Stable 64-bit hash (sha1 prefix) — identical across processes
+    and Python runs, unlike ``hash()`` with PYTHONHASHSEED."""
+    return int.from_bytes(
+        hashlib.sha1(s.encode("utf-8", "surrogatepass")).digest()[:8],
+        "big")
+
+
+def routing_key(scope: str, key: str) -> str:
+    """The string the ring hashes for one (scope, key): pinned scopes
+    collapse to the scope name so the whole scope shares one owner."""
+    if scope in PINNED_SCOPES:
+        return scope
+    return f"{scope}/{key}"
+
+
+class HashRing:
+    """Consistent hashing of routing keys onto replica ids.
+
+    The ring is built once over the CONFIGURED replica set; liveness is
+    a per-lookup filter (``alive``), so every participant with the same
+    configuration + the same live set computes the same owner without
+    any coordination.
+    """
+
+    def __init__(self, replica_ids: Sequence[int],
+                 vnodes: int = DEFAULT_VNODES):
+        if not replica_ids:
+            raise ValueError("HashRing needs at least one replica id")
+        self.replica_ids = sorted(int(i) for i in replica_ids)
+        self.vnodes = int(vnodes)
+        points: List[Tuple[int, int]] = []
+        for rid in self.replica_ids:
+            for v in range(self.vnodes):
+                points.append((_hash64(f"replica:{rid}#{v}"), rid))
+        points.sort()
+        self._points = points
+        self._hashes = [h for h, _ in points]
+
+    def _walk(self, h: int) -> Iterable[int]:
+        """Replica ids clockwise from hash point ``h`` (wrapping),
+        deduplicated in encounter order."""
+        n = len(self._points)
+        start = bisect.bisect_right(self._hashes, h) % n
+        seen = set()
+        for off in range(n):
+            rid = self._points[(start + off) % n][1]
+            if rid not in seen:
+                seen.add(rid)
+                yield rid
+
+    def owner(self, rkey: str,
+              alive: Optional[Iterable[int]] = None) -> int:
+        """The live owner of ``rkey`` (a :func:`routing_key` string)."""
+        live = set(self.replica_ids if alive is None else alive)
+        if not live:
+            raise ValueError("no live replicas")
+        for rid in self._walk(_hash64(rkey)):
+            if rid in live:
+                return rid
+        raise ValueError("no live replicas on ring")  # pragma: no cover
+
+    def backup(self, rkey: str,
+               alive: Optional[Iterable[int]] = None) -> Optional[int]:
+        """The NEXT live replica clockwise after the owner — where the
+        owner streams its copy of this entry, and (by the same walk)
+        exactly who inherits ownership when the owner is fenced.
+        None in a single-replica world."""
+        live = set(self.replica_ids if alive is None else alive)
+        first: Optional[int] = None
+        for rid in self._walk(_hash64(rkey)):
+            if rid not in live:
+                continue
+            if first is None:
+                first = rid
+                continue
+            return rid
+        return None
+
+    def successor(self, rid: int,
+                  alive: Optional[Iterable[int]] = None) -> Optional[int]:
+        """The first OTHER live replica clockwise from ``rid``'s primary
+        ring point — the deterministic takeover claimant for ``rid``:
+        every survivor computes the same successor from the same live
+        set, so exactly one of them bumps the epoch and fences (no
+        dueling claims)."""
+        live = set(self.replica_ids if alive is None else alive)
+        live.discard(int(rid))
+        if not live:
+            return None
+        for cand in self._walk(_hash64(f"replica:{int(rid)}#0")):
+            if cand in live:
+                return cand
+        return None  # pragma: no cover
+
+    def owner_of_key(self, scope: str, key: str,
+                     alive: Optional[Iterable[int]] = None) -> int:
+        return self.owner(routing_key(scope, key), alive)
+
+    def assignment(self, rkeys: Iterable[str],
+                   alive: Optional[Iterable[int]] = None,
+                   ) -> Dict[str, int]:
+        """Bulk owner map — the test harness's bounded-movement probe."""
+        live = list(self.replica_ids if alive is None else alive)
+        return {rk: self.owner(rk, live) for rk in rkeys}
+
+
+class Membership:
+    """The replicated membership/epoch record.
+
+    Plain data + pure transitions: replicas persist it in their KV
+    store (scope ``_cp``), serve it on ``GET /shard_map``, and advance
+    it only through :meth:`fence` / :meth:`rejoin`, both of which bump
+    the epoch. ``merge`` applies a peer's strictly-newer record —
+    epochs totally order membership views, so survivors converge on
+    the highest epoch they have seen (the takeover broadcast).
+    """
+
+    def __init__(self, replicas: Sequence[Tuple[int, str, int]],
+                 epoch: int = 0, dead: Optional[Iterable[int]] = None,
+                 vnodes: int = DEFAULT_VNODES):
+        # replicas: (id, addr, port), the CONFIGURED root set
+        self.replicas = sorted(
+            (int(i), str(a), int(p)) for i, a, p in replicas)
+        self.epoch = int(epoch)
+        self.dead = set(int(d) for d in (dead or ()))
+        self.vnodes = int(vnodes)
+        self.ring = HashRing([i for i, _, _ in self.replicas],
+                             vnodes=self.vnodes)
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def alive(self) -> List[int]:
+        return [i for i, _, _ in self.replicas if i not in self.dead]
+
+    def addr_of(self, rid: int) -> Tuple[str, int]:
+        for i, a, p in self.replicas:
+            if i == int(rid):
+                return a, p
+        raise KeyError(f"unknown replica id {rid}")
+
+    def owner_of(self, scope: str, key: str) -> int:
+        return self.ring.owner(routing_key(scope, key), self.alive)
+
+    def backup_of(self, scope: str, key: str) -> Optional[int]:
+        return self.ring.backup(routing_key(scope, key), self.alive)
+
+    # -- transitions (all epoch-bumping) ------------------------------------
+
+    def fence(self, dead_ids: Iterable[int]) -> "Membership":
+        """A survivor fencing dead replicas: new record at epoch+1 with
+        the ids marked dead. The stale owners' writes are rejected by
+        everyone who adopts this record."""
+        return Membership(self.replicas, epoch=self.epoch + 1,
+                          dead=self.dead | set(int(d) for d in dead_ids),
+                          vnodes=self.vnodes)
+
+    def rejoin(self, rid: int) -> "Membership":
+        """A restarted replica re-entering the ring at a fresh epoch
+        (it must rebuild its ranges from peers before serving —
+        ShardReplica.rejoin drives that)."""
+        return Membership(self.replicas, epoch=self.epoch + 1,
+                          dead=self.dead - {int(rid)},
+                          vnodes=self.vnodes)
+
+    def merge(self, other: "Membership") -> "Membership":
+        """Adopt the strictly-newer record; ties keep self (records at
+        equal epoch are identical by construction — only one claimant
+        per fenced id, tests/test_control_plane.py)."""
+        return other if other.epoch > self.epoch else self
+
+    # -- wire format --------------------------------------------------------
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "epoch": self.epoch,
+            "vnodes": self.vnodes,
+            "replicas": [
+                {"id": i, "addr": a, "port": p,
+                 "alive": i not in self.dead}
+                for i, a, p in self.replicas
+            ],
+            "pinned_scopes": sorted(PINNED_SCOPES),
+        }).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "Membership":
+        obj = json.loads(raw)
+        reps = [(r["id"], r["addr"], r["port"])
+                for r in obj.get("replicas", [])]
+        dead = [r["id"] for r in obj.get("replicas", [])
+                if not r.get("alive", True)]
+        return cls(reps, epoch=int(obj.get("epoch", 0)), dead=dead,
+                   vnodes=int(obj.get("vnodes", DEFAULT_VNODES)))
+
+    def __repr__(self) -> str:  # diagnostics only
+        return (f"Membership(epoch={self.epoch}, "
+                f"alive={self.alive}, dead={sorted(self.dead)})")
+
+
+def parse_root_addrs(spec: str) -> List[Tuple[str, int]]:
+    """``HOROVOD_ROOT_ADDRS`` grammar: comma-separated ``addr:port``
+    in replica-id order (index in the list IS the replica id — every
+    participant must agree on it, so the launcher exports one string
+    to the whole fleet)."""
+    out: List[Tuple[str, int]] = []
+    for chunk in (spec or "").replace(";", ",").split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        addr, _, port = chunk.rpartition(":")
+        if not addr or not port:
+            raise ValueError(
+                f"bad HOROVOD_ROOT_ADDRS entry {chunk!r} "
+                f"(want addr:port)")
+        out.append((addr, int(port)))
+    return out
+
+
+def membership_for_roots(roots: Sequence[Tuple[str, int]],
+                         vnodes: int = DEFAULT_VNODES) -> Membership:
+    """Fresh epoch-0 membership over a configured root set."""
+    return Membership(
+        [(i, a, p) for i, (a, p) in enumerate(roots)], vnodes=vnodes)

@@ -210,6 +210,37 @@ def parse_args(argv: Optional[List[str]] = None):
                         "on-disk state snapshot; a restarted driver "
                         "pointed at the same directory resumes the "
                         "same job on the same port (docs/recovery.md).")
+
+    # sharded root control plane (docs/control_plane.md)
+    p.add_argument("--root-replicas", dest="root_replicas", type=int,
+                   help="Shard the root KV tier across N supervised "
+                        "replica processes with consistent-hash "
+                        "routing, lease/fencing takeover, and "
+                        "write-through ring backups; hvdrun spawns, "
+                        "backoff-restarts and reaps them. Default 1 = "
+                        "today's single root, bit-for-bit "
+                        "(docs/control_plane.md).")
+    p.add_argument("--root-state-dir", dest="root_state_dir",
+                   help="Directory for the root replicas' persisted "
+                        "state snapshots (default: a fresh temp dir); "
+                        "a supervisor-restarted replica reloads its "
+                        "store from here before re-pulling deltas "
+                        "from peers.")
+    p.add_argument("--root-lease-ttl", dest="root_lease_ttl",
+                   type=float,
+                   help="Replica lease TTL in seconds (default 3.0): "
+                        "a silent replica is fenced and taken over "
+                        "after this long.")
+    p.add_argument("--root-heartbeat", dest="root_heartbeat",
+                   type=float,
+                   help="Replica lease heartbeat cadence in seconds "
+                        "(default 0.5).")
+    p.add_argument("--pod-relays", dest="pod_relays", type=int,
+                   help="Spawn N launcher-supervised per-pod relay "
+                        "processes (multipod/relay.py) targeting the "
+                        "root tier, replacing the operator-run relays "
+                        "of docs/multipod.md; crashed relays restart "
+                        "under backoff with flap counting.")
     p.add_argument("--prof-every", dest="prof_every", type=int,
                    help="Continuous step profiler: sample every N-th "
                         "step with device tracing and export compute/"
@@ -275,6 +306,155 @@ def _explicit_dests(argv, parser) -> set:
     return explicit
 
 
+def _reserve_ports(n: int) -> List[int]:
+    """n distinct free ports, all reserved before any is handed out —
+    the replica-id ↔ port mapping must be fixed before the first child
+    spawns (HOROVOD_ROOT_ADDRS is positional)."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("0.0.0.0", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _advertise_addr(hosts: List[HostInfo]) -> str:
+    """The address workers use to reach launcher-spawned control-plane
+    processes: loopback for an all-local job, this host's name
+    otherwise."""
+    import socket
+
+    names = {h.hostname for h in hosts}
+    if names <= {"localhost", "127.0.0.1"}:
+        return "127.0.0.1"
+    return socket.gethostname()
+
+
+def _wait_for_roots(roots: str, timeout_s: float = 20.0) -> None:
+    """Block until every spawned replica answers /shard_map — workers
+    must never race the tier's bind."""
+    import urllib.request
+
+    from .http.ring import parse_root_addrs
+    from ..utils import retry as _retry
+
+    deadline = _retry.Deadline(timeout_s)
+    pending = list(parse_root_addrs(roots))
+    while pending and not deadline.expired():
+        addr, port = pending[0]
+        try:
+            with urllib.request.urlopen(
+                    f"http://{addr}:{port}/shard_map", timeout=2.0):
+                pass
+            pending.pop(0)
+        except Exception:
+            import time as _time
+            _time.sleep(0.1)
+    if pending:
+        raise TimeoutError(
+            f"root replicas {pending} not serving within {timeout_s}s")
+
+
+def _spawn_control_plane(args, env, hosts):
+    """Spawn + supervise the control-plane tier hvdrun now owns
+    (docs/control_plane.md): N sharded root replicas and per-pod
+    relays, restarted under exponential backoff with flap counting
+    (runner/supervisor.py), reaped on exit. Returns (supervisor|None,
+    env) — env gains HOROVOD_ROOT_ADDRS / relay pointers for workers.
+    With --root-replicas 1 and no relays, returns (None, env)
+    untouched: today's single-root path, bit-for-bit."""
+    n_roots = int(getattr(args, "root_replicas", 0) or 0)
+    n_relays = int(getattr(args, "pod_relays", 0) or 0)
+    if n_roots <= 1 and n_relays <= 0:
+        return None, env
+    import tempfile
+
+    from ..core.knobs import Knobs
+    from .supervisor import ProcessSupervisor, python_child_argv
+
+    kb = Knobs.from_env()
+    sup = ProcessSupervisor(
+        base_delay_s=kb.supervisor_base_delay_seconds,
+        max_delay_s=kb.supervisor_max_delay_seconds,
+        flap_window_s=kb.supervisor_flap_window_seconds,
+    )
+    env = dict(env)
+    addr = _advertise_addr(hosts)
+    lease_ttl = (args.root_lease_ttl
+                 if getattr(args, "root_lease_ttl", None)
+                 else kb.root_lease_ttl_seconds)
+    heartbeat = (args.root_heartbeat
+                 if getattr(args, "root_heartbeat", None)
+                 else kb.root_heartbeat_seconds)
+    roots = None
+    try:
+        if n_roots > 1:
+            ports = _reserve_ports(n_roots)
+            roots = ",".join(f"{addr}:{p}" for p in ports)
+            state_dir = (args.root_state_dir
+                         or tempfile.mkdtemp(prefix="hvd_root_"))
+            for i in range(n_roots):
+                sup.add(
+                    f"root.replica.{i}",
+                    python_child_argv(
+                        "horovod_tpu.runner.http.http_server",
+                        "--replica-id", str(i),
+                        "--roots", roots,
+                        "--state-path",
+                        os.path.join(state_dir, f"replica_{i}.pkl"),
+                        "--lease-ttl", str(lease_ttl),
+                        "--heartbeat-interval", str(heartbeat),
+                        "--vnodes", str(kb.root_vnodes),
+                    ))
+            _wait_for_roots(roots)
+            # the fleet-wide root-set contract: index = replica id;
+            # http_client shard-routes any call aimed at these
+            env["HOROVOD_ROOT_ADDRS"] = roots
+        if n_relays > 0:
+            relay_roots = roots
+            if relay_roots is None:
+                # single-root world: relays forward to the published
+                # rendezvous address, exactly as operators did by hand
+                raddr = env.get("HVD_TPU_RENDEZVOUS_ADDR") or env.get(
+                    "HOROVOD_GLOO_RENDEZVOUS_ADDR")
+                rport = env.get("HVD_TPU_RENDEZVOUS_PORT") or env.get(
+                    "HOROVOD_GLOO_RENDEZVOUS_PORT")
+                if not raddr or not rport:
+                    raise ValueError(
+                        "--pod-relays without --root-replicas needs a "
+                        "published rendezvous address in the "
+                        "environment")
+                relay_roots = f"{raddr}:{rport}"
+            rports = _reserve_ports(n_relays)
+            for i in range(n_relays):
+                sup.add(
+                    f"relay.proc.pod{i}",
+                    python_child_argv(
+                        "horovod_tpu.multipod.relay",
+                        "--pod-label", f"pod{i}",
+                        "--roots", relay_roots,
+                        "--port", str(rports[i]),
+                    ))
+            env["HOROVOD_RELAY_ADDRS"] = ",".join(
+                f"pod{i}={addr}:{rports[i]}" for i in range(n_relays))
+            if n_relays == 1:
+                # single-pod: point every worker straight at it via the
+                # existing relay discovery envs (multipod/relay.py)
+                env["HOROVOD_RELAY_ADDR"] = addr
+                env["HOROVOD_RELAY_PORT"] = str(rports[0])
+    except Exception:
+        sup.shutdown()
+        raise
+    sup.start()
+    return sup, env
+
+
 def _resolve_hosts(args) -> List[HostInfo]:
     if args.hostfile:
         return parse_hosts(parse_host_files(args.hostfile))
@@ -295,10 +475,15 @@ def _run_static(args) -> int:
     if args.np is None:
         args.np = sum(h.slots for h in hosts)
     env = config_parser.env_from_args(args, dict(os.environ))
-    codes = run_static(
-        args.command, hosts, args.np, env=env,
-        nics=args.nics.split(",") if args.nics else None,
-    )
+    supervisor, env = _spawn_control_plane(args, env, hosts)
+    try:
+        codes = run_static(
+            args.command, hosts, args.np, env=env,
+            nics=args.nics.split(",") if args.nics else None,
+        )
+    finally:
+        if supervisor is not None:
+            supervisor.shutdown()
     # signal-killed workers report negative codes; any nonzero is failure
     failed = [c for c in codes if c != 0]
     return abs(failed[0]) if failed else (0 if codes else 1)
@@ -329,6 +514,8 @@ def _run_elastic(args) -> int:
         args.host_discovery_script, args.slots
     )
     env = config_parser.env_from_args(args, dict(os.environ))
+    supervisor, env = _spawn_control_plane(
+        args, env, _resolve_hosts(args))
     driver = ElasticDriver(
         HostManager(discovery, settings.cooldown_range),
         settings,
@@ -336,8 +523,13 @@ def _run_elastic(args) -> int:
         env=env,
         nics=args.nics.split(",") if args.nics else None,
         rendezvous_state_dir=args.rendezvous_state_dir or None,
+        control_supervisor=supervisor,
     )
-    return driver.run()
+    try:
+        return driver.run()
+    finally:
+        if supervisor is not None:
+            supervisor.shutdown()  # idempotent with driver.stop()
 
 
 def _check_build() -> int:

@@ -1,0 +1,260 @@
+"""Launcher-owned process supervision for the control-plane tier.
+
+``horovodrun`` used to make operators start root replicas and per-pod
+relays by hand (docs/multipod.md pre-PR-17); now it spawns and OWNS
+them: :class:`ProcessSupervisor` restarts a crashed child under
+exponential backoff, counts *flaps* (exits within ``flap_window_s`` of
+the start — the crash-loop signature), and reaps everything on
+shutdown. A child that stays up past the flap window earns its backoff
+back (the next crash restarts fast again).
+
+The restart ladder deliberately mirrors utils/retry.RetryPolicy's
+shape (base × multiplier^n, capped) but without jitter: supervision
+backoff is asserted exactly in tests (tests/test_control_plane.py),
+and unlike request retries there is no thundering-herd peer to
+de-synchronize from — each launcher supervises only its own children.
+
+Telemetry: ``hvd_supervisor_restarts_total{proc=...}`` and
+``hvd_supervisor_flaps{proc=...}`` in the process registry
+(utils/metrics.py), so a crash-looping relay surfaces on the root's
+aggregated ``/metrics`` scrape without anyone tailing launcher logs.
+
+Deterministic testing: ``clock``/``sleep``/``spawn`` are injectable
+and :meth:`poll_once` is the entire supervision step — tests drive a
+fake clock through crash/backoff/flap schedules with no real
+subprocesses and no real time. The spawned children carry the
+launcher's fault-spec environment, so ``root.replica:kill`` /
+``relay.proc:kill`` rules (utils/faults.py) kill real children in the
+CI gate (scripts/multipod_check.py) and this module restarts them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+LOG = logging.getLogger("horovod_tpu.runner")
+
+from ..utils import metrics as _metrics
+
+
+def _default_spawn(argv: List[str], env: Dict[str, str]):
+    return subprocess.Popen(argv, env=env)
+
+
+class _Child:
+    __slots__ = ("name", "argv", "env", "proc", "started_at",
+                 "restarts", "flaps", "attempt", "restart_due",
+                 "stopped")
+
+    def __init__(self, name: str, argv: List[str],
+                 env: Dict[str, str]):
+        self.name = name
+        self.argv = list(argv)
+        self.env = dict(env)
+        self.proc = None
+        self.started_at: Optional[float] = None
+        self.restarts = 0
+        self.flaps = 0
+        self.attempt = 0  # consecutive flappy exits → backoff exponent
+        self.restart_due: Optional[float] = None
+        self.stopped = False
+
+
+class ProcessSupervisor:
+    """Spawn, monitor, backoff-restart, and reap a set of child
+    processes (the root replicas + pod relays tier).
+
+    ``poll_interval_s`` is the monitor thread's cadence; everything
+    else is per-child: a child that exits gets a restart scheduled
+    ``base_delay_s × multiplier^attempt`` (capped at ``max_delay_s``)
+    in the future, where ``attempt`` counts *consecutive flappy* exits
+    — an exit after a run longer than ``flap_window_s`` resets the
+    ladder. ``max_flaps`` (None = unlimited) abandons a child that
+    crash-loops past the limit instead of burning CPU forever; the
+    abandonment is visible in :meth:`stats` and the flap gauge.
+    """
+
+    def __init__(self, base_delay_s: float = 0.5,
+                 max_delay_s: float = 10.0,
+                 multiplier: float = 2.0,
+                 flap_window_s: float = 5.0,
+                 max_flaps: Optional[int] = None,
+                 poll_interval_s: float = 0.2,
+                 clock: Callable[[], float] = time.monotonic,
+                 spawn: Callable = _default_spawn):
+        self.base_delay_s = float(base_delay_s)
+        self.max_delay_s = float(max_delay_s)
+        self.multiplier = float(multiplier)
+        self.flap_window_s = float(flap_window_s)
+        self.max_flaps = max_flaps
+        self.poll_interval_s = float(poll_interval_s)
+        self._clock = clock
+        self._spawn = spawn
+        self._children: Dict[str, _Child] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._m_restarts = _metrics.registry.counter(
+            "hvd_supervisor_restarts_total",
+            "supervised child restarts, by child name",
+            ("proc",))
+        self._m_flaps = _metrics.registry.gauge(
+            "hvd_supervisor_flaps",
+            "flappy exits (died within the flap window) per child",
+            ("proc",))
+
+    # -- child management ---------------------------------------------------
+
+    def add(self, name: str, argv: List[str],
+            env: Optional[Dict[str, str]] = None) -> None:
+        """Register AND start one child. ``env`` defaults to this
+        process's environment (fault specs and root-set exports ride
+        along)."""
+        child = _Child(name, argv,
+                       dict(os.environ) if env is None else env)
+        with self._lock:
+            if name in self._children:
+                raise ValueError(f"child {name!r} already supervised")
+            self._children[name] = child
+            self._start_child(child)
+
+    def _start_child(self, child: _Child) -> None:
+        child.proc = self._spawn(child.argv, child.env)
+        child.started_at = self._clock()
+        child.restart_due = None
+        self._m_flaps.labels(child.name).set(child.flaps)
+
+    # -- supervision step ---------------------------------------------------
+
+    def poll_once(self) -> None:
+        """One supervision step: detect exits, classify flaps,
+        schedule + execute due restarts. The monitor thread calls this
+        on a cadence; tests call it directly under a fake clock."""
+        now = self._clock()
+        with self._lock:
+            for child in self._children.values():
+                if child.stopped:
+                    continue
+                if child.proc is not None \
+                        and child.proc.poll() is None:
+                    continue  # running
+                if child.proc is not None:
+                    # just noticed the exit: classify + schedule
+                    code = child.proc.returncode
+                    ran_s = now - (child.started_at or now)
+                    if ran_s < self.flap_window_s:
+                        child.flaps += 1
+                        child.attempt += 1
+                    else:
+                        child.attempt = 0  # healthy run: ladder resets
+                    self._m_flaps.labels(child.name).set(child.flaps)
+                    if self.max_flaps is not None \
+                            and child.flaps > self.max_flaps:
+                        LOG.error(
+                            "supervised %s crash-looped past "
+                            "max_flaps=%d (last exit %s); abandoning",
+                            child.name, self.max_flaps, code)
+                        child.proc = None
+                        child.stopped = True
+                        continue
+                    delay = min(
+                        self.max_delay_s,
+                        self.base_delay_s
+                        * self.multiplier ** max(child.attempt - 1, 0))
+                    child.restart_due = now + delay
+                    child.proc = None
+                    LOG.warning(
+                        "supervised %s exited (%s) after %.2fs; "
+                        "restart in %.2fs (restart #%d, flaps %d)",
+                        child.name, code, ran_s, delay,
+                        child.restarts + 1, child.flaps)
+                if child.restart_due is not None \
+                        and now >= child.restart_due:
+                    child.restarts += 1
+                    self._m_restarts.labels(child.name).inc()
+                    self._start_child(child)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # supervision must outlive hiccups
+                LOG.warning("supervisor poll error: %s", e)
+
+    def start(self) -> None:
+        if self._monitor is None:
+            self._stop.clear()
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="proc-supervisor")
+            self._monitor.start()
+
+    # -- teardown -----------------------------------------------------------
+
+    def shutdown(self, term_timeout_s: float = 5.0) -> None:
+        """Stop supervising and reap every child: SIGTERM, grace
+        period, SIGKILL stragglers. Safe to call twice."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10)
+            self._monitor = None
+        with self._lock:
+            children = list(self._children.values())
+            for child in children:
+                child.stopped = True
+        for child in children:
+            if child.proc is None or child.proc.poll() is not None:
+                continue
+            try:
+                child.proc.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + term_timeout_s
+        for child in children:
+            if child.proc is None:
+                continue
+            remain = max(deadline - time.monotonic(), 0.01)
+            try:
+                child.proc.wait(timeout=remain)
+            except subprocess.TimeoutExpired:
+                try:
+                    child.proc.kill()
+                    child.proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {
+                c.name: {
+                    "alive": (c.proc is not None
+                              and c.proc.poll() is None),
+                    "restarts": c.restarts,
+                    "flaps": c.flaps,
+                    "abandoned": c.stopped and c.proc is None,
+                    "pid": (c.proc.pid if c.proc is not None
+                            else None),
+                }
+                for c in self._children.values()
+            }
+
+    def alive(self, name: str) -> bool:
+        with self._lock:
+            c = self._children.get(name)
+            return bool(c and c.proc is not None
+                        and c.proc.poll() is None)
+
+
+def python_child_argv(module: str, *args: str) -> List[str]:
+    """argv for a supervised ``python -m`` child using THIS
+    interpreter — replicas and relays must import the same
+    horovod_tpu."""
+    return [sys.executable, "-m", module, *args]

@@ -60,6 +60,14 @@ ARG_TO_ENV = {
     # --no-flight-recorder stores "0" for the same reason
     "flight_recorder": "HOROVOD_FLIGHT_RECORDER",
     "flight_dir": "HOROVOD_FLIGHT_DIR",
+    # sharded root control plane (docs/control_plane.md): the replica
+    # count + timing knobs ride to workers so in-worker clients and
+    # knobs.from_env agree with the launcher-spawned tier.
+    # HOROVOD_ROOT_ADDRS itself is NOT here — the launcher computes it
+    # after reserving ports and exports it directly.
+    "root_replicas": "HOROVOD_ROOT_REPLICAS",
+    "root_lease_ttl": "HOROVOD_ROOT_LEASE_TTL",
+    "root_heartbeat": "HOROVOD_ROOT_HEARTBEAT",
     "prof_every": "HOROVOD_PROF_EVERY",
     "prof_dir": "HOROVOD_PROF_DIR",
     "prof_duty_cycle": "HOROVOD_PROF_DUTY_CYCLE",

@@ -26,8 +26,16 @@ modes — the measured direct-to-root vs relayed comparison the
 SCALING_r{N}.json artifact line carries (``--fanin-only`` skips the
 eager worlds when only this section is wanted).
 
+With ``--root-replicas 1,3,5`` the report carries a **shard_balance**
+section: ``--shard-hosts`` simulated hosts (default 1024) push through
+the shard-routing client against an in-process tier of N
+ShardReplicas per row, and the row records each replica's request
+count — with a healthy consistent-hash ring every replica serves
+≈ total/N (docs/control_plane.md).
+
 Usage: python scripts/control_plane_scaling.py [--out SCALING_r06.json]
        [--no-fast-path] [--pods N] [--hosts-per-pod M] [--fanin-only]
+       [--root-replicas 1,3,5] [--shard-hosts H] [--shard-only]
 """
 
 import argparse
@@ -174,6 +182,30 @@ def run_fanin(n_pods, hosts_per_pod, pushes_per_host=10,
     return m
 
 
+def run_shard_balance(replica_counts, n_hosts):
+    """Sharded-root load spread at fleet scale: n_hosts simulated
+    hosts (threads) each push one record through the shard-routing
+    client against a tier of N in-process ShardReplicas; with a
+    healthy ring every replica serves ≈ total/N requests
+    (docs/control_plane.md — the consistent-hash spread claim,
+    measured, not assumed)."""
+    from horovod_tpu.multipod.fanin import measure_shard_balance
+
+    rows = []
+    for n in replica_counts:
+        r = measure_shard_balance(n, n_hosts)
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+    return {
+        "what": ("sharded root control plane: per-replica request "
+                 "spread at simulated fleet scale (threads as hosts; "
+                 "runner/http/ring.py consistent hashing, "
+                 "write-through ring backups included in the counts)"),
+        "hosts": n_hosts,
+        "rows": rows,
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="SCALING_r06.json")
@@ -189,9 +221,22 @@ def main(argv=None):
     ap.add_argument("--fanin-only", action="store_true",
                     help="with --pods: skip the eager weak-scaling "
                          "worlds")
+    ap.add_argument("--root-replicas", default="",
+                    help="comma list of sharded-root tier sizes to "
+                         "measure request spread for (e.g. 1,3,5); "
+                         "adds the shard_balance section")
+    ap.add_argument("--shard-hosts", type=int, default=1024,
+                    help="simulated hosts pushing through the "
+                         "shard-routing client per --root-replicas "
+                         "row")
+    ap.add_argument("--shard-only", action="store_true",
+                    help="with --root-replicas: skip the eager "
+                         "weak-scaling worlds")
     args = ap.parse_args(argv)
     report = {}
-    if not (args.pods and args.fanin_only):
+    skip_worlds = ((args.pods and args.fanin_only)
+                   or (args.root_replicas and args.shard_only))
+    if not skip_worlds:
         rows = []
         for size in [int(s) for s in args.worlds.split(",")]:
             row = run_world(size, fast_path=not args.no_fast_path)
@@ -215,6 +260,12 @@ def main(argv=None):
         report["relay_fanin"] = fanin
         if "what" not in report:
             report["what"] = fanin["what"]
+    if args.root_replicas:
+        counts = [int(s) for s in args.root_replicas.split(",") if s]
+        balance = run_shard_balance(counts, args.shard_hosts)
+        report["shard_balance"] = balance
+        if "what" not in report:
+            report["what"] = balance["what"]
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps({"written": args.out}))

@@ -1,0 +1,497 @@
+"""Sharded, self-healing root control plane (PR 17).
+
+Deterministic unit + in-process integration coverage for the pieces
+scripts/multipod_check.py exercises with real subprocesses and
+SIGKILL: ring stability under join/leave, lease/fencing takeover
+ordering, client 421-redirect and dead-owner retry, relay owner
+splitting, and the launcher's ProcessSupervisor backoff/flap ladder.
+Everything here runs on injectable clocks/spawns or loopback HTTP
+threads — fast and tier-1 safe (docs/control_plane.md).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from horovod_tpu.runner.http.ring import (
+    HashRing,
+    Membership,
+    PINNED_SCOPES,
+    membership_for_roots,
+    parse_root_addrs,
+    routing_key,
+)
+
+
+def _keys(n):
+    return [routing_key("elastic", f"key_{i}") for i in range(n)]
+
+
+# ------------------------------------------------------------------ ring
+
+
+class TestHashRing:
+    def test_owner_deterministic_and_balanced(self):
+        ring = HashRing([0, 1, 2])
+        alive = [0, 1, 2]
+        owners = [ring.owner(k, alive) for k in _keys(300)]
+        # stable across independently-built rings
+        assert owners == [HashRing([0, 1, 2]).owner(k, alive)
+                          for k in _keys(300)]
+        counts = {r: owners.count(r) for r in alive}
+        assert all(counts[r] > 0 for r in alive)
+        # vnodes keep the imbalance bounded (not a proof, a tripwire)
+        assert max(counts.values()) < 3 * min(counts.values())
+
+    def test_leave_moves_only_the_dead_replicas_keys(self):
+        ring = HashRing([0, 1, 2])
+        alive = [0, 1, 2]
+        keys = _keys(400)
+        before = {k: ring.owner(k, alive) for k in keys}
+        backups = {k: ring.backup(k, alive) for k in keys}
+        survivors = [0, 2]
+        after = {k: ring.owner(k, survivors) for k in keys}
+        for k in keys:
+            if before[k] != 1:
+                assert after[k] == before[k], k  # untouched range
+            else:
+                # a dead owner's keys land exactly on their ring
+                # backups — the write-through replica already there
+                assert after[k] == backups[k], k
+
+    def test_join_bounded_movement(self):
+        ring3 = HashRing([0, 1, 2])
+        ring4 = HashRing([0, 1, 2, 3])
+        keys = _keys(400)
+        before = {k: ring3.owner(k, [0, 1, 2]) for k in keys}
+        after = {k: ring4.owner(k, [0, 1, 2, 3]) for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        # every moved key moves TO the joiner, nowhere else
+        assert all(after[k] == 3 for k in moved)
+        # and roughly its fair share moves, not a reshuffle
+        assert len(moved) < 0.6 * len(keys)
+
+    def test_backup_is_distinct_live_replica(self):
+        ring = HashRing([0, 1, 2])
+        alive = [0, 1, 2]
+        for k in _keys(100):
+            assert ring.backup(k, alive) != ring.owner(k, alive)
+        # two replicas: backup is always "the other one"
+        for k in _keys(50):
+            o = ring.owner(k, [0, 1])
+            assert ring.backup(k, [0, 1]) == 1 - o
+        # single replica: nowhere to back up to
+        assert ring.backup(_keys(1)[0], [0]) is None
+
+    def test_successor_excludes_dead_and_is_deterministic(self):
+        ring = HashRing([0, 1, 2])
+        for dead in (0, 1, 2):
+            survivors = [r for r in (0, 1, 2) if r != dead]
+            s = ring.successor(dead, survivors)
+            assert s in survivors
+            assert s == HashRing([0, 1, 2]).successor(dead, survivors)
+
+    def test_pinned_scope_routes_by_scope_alone(self):
+        assert "rendezvous" in PINNED_SCOPES
+        assert (routing_key("rendezvous", "a")
+                == routing_key("rendezvous", "b"))
+        assert (routing_key("elastic", "a")
+                != routing_key("elastic", "b"))
+
+
+# ------------------------------------------------------------ membership
+
+
+class TestMembership:
+    ROOTS = [("h0", 7001), ("h1", 7002), ("h2", 7003)]
+
+    def test_fence_bumps_epoch_and_marks_dead(self):
+        m = membership_for_roots(self.ROOTS)
+        assert m.epoch == 0 and m.alive == [0, 1, 2]
+        m2 = m.fence([1])
+        assert m2.epoch == 1
+        assert m2.alive == [0, 2]
+        assert m.alive == [0, 1, 2]  # immutably derived
+
+    def test_rejoin_bumps_epoch_and_revives(self):
+        m = membership_for_roots(self.ROOTS).fence([2])
+        m2 = m.rejoin(2)
+        assert m2.epoch == 2
+        assert m2.alive == [0, 1, 2]
+
+    def test_merge_adopts_strictly_newer_only(self):
+        m = membership_for_roots(self.ROOTS)
+        newer = m.fence([0])
+        assert m.merge(newer).epoch == newer.epoch
+        assert m.merge(newer).alive == [1, 2]
+        # equal/older epochs: keep ours
+        assert newer.merge(m).alive == newer.alive
+        assert newer.merge(newer).alive == newer.alive
+
+    def test_json_round_trip(self):
+        m = membership_for_roots(self.ROOTS).fence([1])
+        back = Membership.from_json(m.to_json())
+        assert back.epoch == m.epoch
+        assert back.alive == m.alive
+        assert back.addr_of(0) == ("h0", 7001)
+        assert (back.owner_of("elastic", "k")
+                == m.owner_of("elastic", "k"))
+
+    def test_parse_root_addrs(self):
+        assert parse_root_addrs("h0:1,h1:2") == [("h0", 1), ("h1", 2)]
+        assert parse_root_addrs(" h0:1 , h1:2 ") == [
+            ("h0", 1), ("h1", 2)]
+        assert parse_root_addrs("") == []
+
+
+# ----------------------------------------------- in-process sharded tier
+
+
+def _start_tier(n=3, lease_ttl_s=60.0, clock=time.monotonic):
+    """n ShardReplicas on loopback with heartbeats OFF — tests drive
+    heartbeat_once explicitly under the injected clock."""
+    from horovod_tpu.multipod.fanin import _free_ports
+    from horovod_tpu.runner.http.http_server import ShardReplica
+
+    ports = _free_ports(n)
+    roots = [("127.0.0.1", p) for p in ports]
+    reps = [
+        ShardReplica(i, roots, lease_ttl_s=lease_ttl_s,
+                     auto_heartbeat=False, clock=clock)
+        for i in range(n)
+    ]
+    for r in reps:
+        r.start_server()
+    return roots, reps
+
+
+@pytest.fixture
+def tier():
+    clock = _FakeClock()
+    roots, reps = _start_tier(3, lease_ttl_s=5.0, clock=clock)
+    try:
+        yield roots, reps, clock
+    finally:
+        for r in reps:
+            try:
+                r.shutdown_server()
+            except Exception:
+                pass
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestShardedTier:
+    N_KEYS = 24
+
+    def _client(self, roots, **kw):
+        from horovod_tpu.runner.http.http_client import ShardClient
+
+        return ShardClient(roots, **kw)
+
+    def test_client_routes_and_redirects(self, tier):
+        roots, reps, _clock = tier
+        c = self._client(roots)
+        for i in range(self.N_KEYS):
+            c.put("elastic", f"k{i}", f"v{i}".encode())
+        for i in range(self.N_KEYS):
+            assert c.get("elastic", f"k{i}") == f"v{i}".encode()
+        # keys actually spread over the tier (not all on roots[0])
+        owners = {c.owner_addr("elastic", f"k{i}")
+                  for i in range(self.N_KEYS)}
+        assert len(owners) > 1
+        # a deliberately-misrouted direct PUT bounces 421 with the
+        # owner hint the client uses to re-route
+        m = reps[0].membership
+        own = m.owner_of("elastic", "k0")
+        wrong = next(r for r in reps if r.replica_id != own)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{wrong.port}/elastic/k0",
+            data=b"x", method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 421
+        hint = json.loads(ei.value.read())
+        assert hint["error"] == "NotOwner"
+        assert hint["owner"]["id"] == own
+
+    def test_takeover_fences_and_keeps_every_key(self, tier):
+        roots, reps, clock = tier
+        c = self._client(roots)
+        values = {f"k{i}": f"v{i}".encode()
+                  for i in range(self.N_KEYS)}
+        for k, v in values.items():
+            c.put("elastic", k, v)
+
+        victim = reps[1]
+        victim.shutdown_server()
+        # lease lapses past the TTL; exactly the ring successor of the
+        # victim fences (one claimant, one epoch bump)
+        clock.advance(6.0)
+        for r in reps:
+            if r is not victim:
+                r.heartbeat_once()
+        survivors = [r for r in reps if r is not victim]
+        assert all(r.epoch == 1 for r in survivors)
+        assert all(1 not in r.membership.alive for r in survivors)
+        assert sum(r.takeovers for r in survivors) >= 1
+        # zero lost scopes: every key readable after the takeover
+        # (write-through backups already held the dead owner's ranges)
+        c2 = self._client(roots, takeover_timeout_s=5.0)
+        for k, v in values.items():
+            assert c2.get("elastic", k) == v, k
+
+    def test_stale_epoch_write_rejected_post_fence(self, tier):
+        roots, reps, clock = tier
+        victim = reps[1]
+        victim.shutdown_server()
+        clock.advance(6.0)
+        for r in reps:
+            if r is not victim:
+                r.heartbeat_once()
+        # a replica still at epoch 0 pushing replica-to-replica state
+        # must be fenced off with 409
+        survivor = next(r for r in reps if r is not victim)
+        stale = membership_for_roots(roots)  # epoch 0
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{survivor.port}/_cp/sync/1",
+            data=json.dumps({
+                "epoch": stale.epoch,
+                "entries": [["elastic", "stale_key", "eA=="]],
+            }).encode(),
+            method="PUT")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 409
+        assert survivor.fenced_writes_rejected >= 1
+        with survivor.lock:
+            assert "stale_key" not in survivor.store.get("elastic", {})
+
+    def test_metrics_and_health_fan_in(self, tier):
+        roots, reps, _clock = tier
+        c = self._client(roots)
+        for i in range(self.N_KEYS):
+            c.put("elastic", f"k{i}", b"x")
+        # any single replica's /metrics and /health must answer for
+        # the WHOLE keyspace, not just its own shard (PR 17 bugfix)
+        for r in reps:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{r.port}/metrics",
+                    timeout=5) as resp:
+                body = resp.read().decode()
+            assert "hvd_cp_epoch" in body
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{r.port}/health",
+                    timeout=5) as resp:
+                health = json.loads(resp.read())
+            # the fleet summary shape, served whole from any replica
+            assert "ranks" in health and "alerts_active" in health
+
+    def test_client_degrades_against_unsharded_root(self):
+        from horovod_tpu.runner.http.http_server import KVStoreServer
+
+        srv = KVStoreServer(port=0)
+        srv.start_server()
+        try:
+            c = self._client([("127.0.0.1", srv.port)])
+            c.put("elastic", "k", b"v")
+            assert c.get("elastic", "k") == b"v"
+            assert not c.shard_map()  # degraded: no map, direct calls
+        finally:
+            srv.shutdown_server()
+
+
+# --------------------------------------------------------- relay re-route
+
+
+class TestRelayOwnerSplitting:
+    def test_flush_lands_every_key_on_its_owner(self):
+        from horovod_tpu.multipod.relay import PodRelayServer
+
+        roots, reps = _start_tier(2)
+        relay = None
+        try:
+            relay = PodRelayServer("pod0", roots,
+                                   flush_interval_s=30.0)
+            relay.start_server()
+            for i in range(16):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{relay.port}/elastic/rk{i}",
+                    data=f"rv{i}".encode(), method="PUT")
+                with urllib.request.urlopen(req, timeout=5):
+                    pass
+            sent = relay.flush_once()
+            assert sent == 16
+            assert relay.stats()["pending"] == 0
+            # every key readable at its ring owner directly (no 421)
+            m = reps[0].membership
+            for i in range(16):
+                own = m.owner_of("elastic", f"rk{i}")
+                addr, port = m.addr_of(own)
+                with urllib.request.urlopen(
+                        f"http://{addr}:{port}/elastic/rk{i}",
+                        timeout=5) as resp:
+                    assert resp.read() == f"rv{i}".encode()
+        finally:
+            if relay is not None:
+                relay.shutdown_server()
+            for r in reps:
+                r.shutdown_server()
+
+    def test_single_root_path_unchanged(self):
+        from horovod_tpu.multipod.relay import PodRelayServer
+        from horovod_tpu.runner.http.http_server import KVStoreServer
+
+        root = KVStoreServer(port=0)
+        root.start_server()
+        relay = None
+        try:
+            relay = PodRelayServer(
+                "pod0", ("127.0.0.1", root.port),
+                flush_interval_s=30.0)
+            relay.start_server()
+            assert relay._shard_client is None
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{relay.port}/elastic/a",
+                data=b"1", method="PUT")
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+            assert relay.flush_once() == 1
+            with root.lock:
+                assert root.store["elastic"]["a"] == b"1"
+        finally:
+            if relay is not None:
+                relay.shutdown_server()
+            root.shutdown_server()
+
+
+# ------------------------------------------------------------- supervisor
+
+
+class _FakeProc:
+    _next_pid = [100]
+
+    def __init__(self):
+        self.pid = _FakeProc._next_pid[0]
+        _FakeProc._next_pid[0] += 1
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def exit(self, code=1):
+        self.returncode = code
+
+
+class TestProcessSupervisor:
+    def _sup(self, **kw):
+        from horovod_tpu.runner.supervisor import ProcessSupervisor
+
+        clock = _FakeClock()
+        spawned = []
+
+        def spawn(argv, env):
+            p = _FakeProc()
+            spawned.append(p)
+            return p
+
+        kw.setdefault("base_delay_s", 0.5)
+        kw.setdefault("max_delay_s", 4.0)
+        kw.setdefault("flap_window_s", 5.0)
+        sup = ProcessSupervisor(clock=clock, spawn=spawn, **kw)
+        return sup, clock, spawned
+
+    def test_backoff_ladder_doubles_and_caps(self):
+        sup, clock, spawned = self._sup()
+        sup.add("replica_0", ["x"])
+        expected = [0.5, 1.0, 2.0, 4.0, 4.0]  # capped at max_delay
+        for i, delay in enumerate(expected):
+            spawned[-1].exit(1)  # dies immediately → flap
+            sup.poll_once()  # notice + schedule
+            child = sup._children["replica_0"]
+            assert child.restart_due == pytest.approx(
+                clock() + delay), i
+            clock.advance(delay - 0.01)
+            sup.poll_once()
+            assert not sup.alive("replica_0")  # not due yet
+            clock.advance(0.02)
+            sup.poll_once()
+            assert sup.alive("replica_0")
+        assert sup.stats()["replica_0"]["restarts"] == len(expected)
+        assert sup.stats()["replica_0"]["flaps"] == len(expected)
+
+    def test_healthy_run_resets_the_ladder(self):
+        sup, clock, spawned = self._sup()
+        sup.add("relay_0", ["x"])
+        # two flaps escalate to a 1.0s delay
+        for _ in range(2):
+            spawned[-1].exit(1)
+            sup.poll_once()
+            clock.advance(10.0)
+            sup.poll_once()
+        # now a long healthy run, then a crash: back to base delay
+        clock.advance(60.0)
+        spawned[-1].exit(1)
+        sup.poll_once()
+        child = sup._children["relay_0"]
+        assert child.restart_due == pytest.approx(clock() + 0.5)
+        assert sup.stats()["relay_0"]["flaps"] == 2  # not a flap
+
+    def test_max_flaps_abandons_crash_loop(self):
+        sup, clock, spawned = self._sup(max_flaps=2)
+        sup.add("relay_0", ["x"])
+        for _ in range(2):
+            spawned[-1].exit(1)
+            sup.poll_once()
+            clock.advance(10.0)
+            sup.poll_once()
+        assert sup.alive("relay_0")
+        spawned[-1].exit(1)  # third flap crosses max_flaps=2
+        sup.poll_once()
+        clock.advance(60.0)
+        sup.poll_once()
+        st = sup.stats()["relay_0"]
+        assert st["abandoned"] is True
+        assert not sup.alive("relay_0")
+        assert len(spawned) == 3  # no further respawns
+
+    def test_flap_metrics_exported(self):
+        from horovod_tpu.utils import metrics as _metrics
+
+        sup, clock, spawned = self._sup()
+        sup.add("replica_1", ["x"])
+        spawned[-1].exit(1)
+        sup.poll_once()
+        clock.advance(1.0)
+        sup.poll_once()
+        text = _metrics.registry.render()
+        assert 'hvd_supervisor_restarts_total{proc="replica_1"}' \
+            in text
+        assert 'hvd_supervisor_flaps{proc="replica_1"}' in text
+
+    def test_shutdown_is_idempotent_with_fakes(self):
+        sup, _clock, spawned = self._sup()
+        sup.add("a", ["x"])
+
+        # fakes lack terminate/kill: give them no-ops via subclassing
+        class _Term(_FakeProc):
+            pass
+
+        p = spawned[-1]
+        p.terminate = lambda: p.exit(0)
+        p.wait = lambda timeout=None: 0
+        sup.shutdown()
+        sup.shutdown()
+        assert p.returncode == 0

@@ -428,6 +428,213 @@ class TestLocalSGD:
         assert localsgd.from_knobs() is None
 
 
+# ------------------------------------- int8 error feedback across syncs
+
+
+class TestErrorFeedbackCarry:
+    """PR 17 satellite: the int8 outer wire's quantization residual
+    must CARRY across outer syncs (in OuterState) instead of being
+    dropped — dropped residuals accumulate as a bias random-walk over
+    syncs; carried residuals cancel, keeping the localK trajectory
+    within one quantization step of fp32 outer averaging."""
+
+    T_ROUNDS = 12
+    DIM = 96
+
+    def _run_rounds(self, ls, mesh, drifts):
+        """T rounds of (drift by drifts[t], outer_sync); returns the
+        final stacked (8, DIM) params."""
+        carries = ls.carries_residual
+
+        if carries:
+            def body(w, a, v, r):
+                p, st = ls.outer_sync(
+                    w[0], OuterState(anchor=a[0], velocity=v[0],
+                                     residual=r[0]))
+                return (p[None], st.anchor[None], st.velocity[None],
+                        st.residual[None])
+
+            sync = jax.jit(shard_map(
+                body, mesh=mesh, in_specs=(P("hvd"),) * 4,
+                out_specs=(P("hvd"),) * 4, check_vma=False))
+        else:
+            def body(w, a, v):
+                p, st = ls.outer_sync(
+                    w[0], OuterState(anchor=a[0], velocity=v[0]))
+                return p[None], st.anchor[None], st.velocity[None]
+
+            sync = jax.jit(shard_map(
+                body, mesh=mesh, in_specs=(P("hvd"),) * 3,
+                out_specs=(P("hvd"),) * 3, check_vma=False))
+
+        w = jnp.zeros((8, self.DIM), jnp.float32)
+        a, v = w, jnp.zeros_like(w)
+        r = jnp.zeros_like(w)
+        for t in range(self.T_ROUNDS):
+            w = w + drifts[t]
+            if carries:
+                w, a, v, r = sync(w, a, v, r)
+            else:
+                w, a, v = sync(w, a, v)
+        return np.asarray(w)
+
+    def _drifts(self):
+        """(T, 8, DIM) per-rank drifts, equal within each pod (ranks
+        2p, 2p+1) so the pods-agree invariant holds round over
+        round."""
+        rng = np.random.RandomState(7)
+        per_pod = rng.uniform(
+            -1, 1, (self.T_ROUNDS, 4, self.DIM)).astype(np.float32)
+        return np.repeat(per_pod, 2, axis=1)
+
+    def test_carried_residual_beats_dropping(self, hvd8):
+        from horovod_tpu.optim.compression import WireSpec
+
+        topo = PodTopology(n_pods=4, pod_id=0, world=8)
+        mesh = hvd.mesh()
+        drifts = self._drifts()
+
+        w_fp = self._run_rounds(LocalSGD(topo, 2), mesh, drifts)
+        w_ef = self._run_rounds(
+            LocalSGD(topo, 2, wire=WireSpec("int8", 32,
+                                            error_feedback=True)),
+            mesh, drifts)
+        w_drop = self._run_rounds(
+            LocalSGD(topo, 2, wire=WireSpec("int8", 32)), mesh, drifts)
+
+        err_ef = float(np.abs(w_ef - w_fp).max())
+        err_drop = float(np.abs(w_drop - w_fp).max())
+        # measurably closer to the fp32 outer average, not just equal
+        assert err_ef < 0.8 * err_drop, (err_ef, err_drop)
+        # and bounded by ~one quantization step, not a T-round walk
+        assert err_ef < 0.05, err_ef
+
+    def test_carry_is_unbiased_vs_fp32(self, hvd8):
+        """Unbiasedness: the MEAN signed deviation from the fp32
+        trajectory stays near zero with the carry (errors cancel),
+        while dropping leaves a drifted estimate."""
+        from horovod_tpu.optim.compression import WireSpec
+
+        topo = PodTopology(n_pods=4, pod_id=0, world=8)
+        mesh = hvd.mesh()
+        drifts = self._drifts()
+
+        w_fp = self._run_rounds(LocalSGD(topo, 2), mesh, drifts)
+        w_ef = self._run_rounds(
+            LocalSGD(topo, 2, wire=WireSpec("int8", 32,
+                                            error_feedback=True)),
+            mesh, drifts)
+        bias_ef = float(np.abs(np.mean(w_ef - w_fp)))
+        assert bias_ef < 5e-3, bias_ef
+        # pods still agree bitwise after the final sync
+        assert np.abs(w_ef.reshape(4, 2, -1)[:, 0]
+                      - w_ef.reshape(4, 2, -1)[:, 1]).max() == 0.0
+
+    def test_state_shapes_and_gating(self, hvd8):
+        """carries_residual requires int8 AND error_feedback;
+        init_outer materializes f32 zero residuals only then."""
+        from horovod_tpu.optim.compression import WireSpec
+
+        topo = PodTopology(n_pods=4, pod_id=0, world=8)
+        params = {"w": jnp.ones((3, 2)), "b": jnp.ones((2,))}
+
+        plain = LocalSGD(topo, 2).init_outer(params)
+        assert plain.residual is None
+        assert LocalSGD(
+            topo, 2, wire=WireSpec("fp16")).carries_residual is False
+        assert LocalSGD(
+            topo, 2,
+            wire=WireSpec("int8", 64)).carries_residual is False
+
+        ls = LocalSGD(topo, 2, wire=WireSpec("int8", 64,
+                                             error_feedback=True))
+        st = ls.init_outer(params)
+        assert st.residual is not None
+        assert st.residual["w"].dtype == jnp.float32
+        assert st.residual["w"].shape == (3, 2)
+        assert float(jnp.abs(st.residual["b"]).max()) == 0.0
+        # pytree round-trip keeps all three fields
+        leaves, treedef = jax.tree_util.tree_flatten(st)
+        back = jax.tree_util.tree_unflatten(treedef, leaves)
+        assert back.residual["w"].shape == (3, 2)
+
+
+# ------------------------------------------- Adam m/v merge at syncs
+
+
+class TestOptimizerMomentMerge:
+    """PR 17 satellite: pod-local Adam moments are MERGED (averaged)
+    at sync points rather than reset or left divergent."""
+
+    def _mesh_and_ls(self):
+        topo = PodTopology(n_pods=4, pod_id=0, world=8)
+        return hvd.mesh(), LocalSGD(topo, 2)
+
+    def test_merge_averages_mu_and_nu(self, hvd8):
+        optax = pytest.importorskip("optax")
+        mesh, ls = self._mesh_and_ls()
+        params = {"w": jnp.ones((4,))}
+        proto = optax.adam(1e-3).init(params)
+
+        def body(mu, nu):
+            node = proto[0]._replace(mu={"w": mu[0]},
+                                     nu={"w": nu[0]})
+            merged = ls.merge_optimizer_state((node, proto[1]))
+            return merged[0].mu["w"][None], merged[0].nu["w"][None]
+
+        f = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P("hvd"),) * 2,
+            out_specs=(P("hvd"),) * 2, check_vma=False))
+        mu = jnp.asarray(
+            np.arange(32, dtype=np.float32).reshape(8, 4))
+        nu = 10.0 * mu + 1.0
+        mo, no = (np.asarray(t) for t in f(mu, nu))
+        mus, nus = np.asarray(mu), np.asarray(nu)
+        for r in range(8):
+            group = [(r % 2) + 2 * p for p in range(4)]
+            np.testing.assert_allclose(
+                mo[r], mus[group].mean(0), atol=1e-6)
+            np.testing.assert_allclose(
+                no[r], nus[group].mean(0), atol=1e-6)
+
+    def test_merge_leaves_count_and_plain_leaves_alone(self, hvd8):
+        optax = pytest.importorskip("optax")
+        mesh, ls = self._mesh_and_ls()
+        params = {"w": jnp.ones((4,))}
+        proto = optax.adam(1e-3).init(params)
+
+        def body(mu):
+            node = proto[0]._replace(
+                mu={"w": mu[0]}, count=jnp.asarray(17, jnp.int32))
+            extra = {"lr": mu[0] * 2.0}  # non-adam leaf: untouched
+            m_node, m_extra = ls.merge_optimizer_state((node, extra))
+            return (m_node.count[None],
+                    m_extra["lr"][None])
+
+        f = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P("hvd"),
+            out_specs=(P("hvd"), P("hvd")), check_vma=False))
+        mu = jnp.asarray(
+            np.arange(32, dtype=np.float32).reshape(8, 4))
+        counts, lrs = f(mu)
+        assert np.all(np.asarray(counts) == 17)
+        np.testing.assert_array_equal(
+            np.asarray(lrs), np.asarray(mu) * 2.0)
+
+    def test_k1_never_reaches_merge(self):
+        """K=1 bitwise-parity gate: local1 normalizes to the plain
+        synchronous path, LocalSGD is never constructed, so neither
+        the residual carry nor the moment merge can perturb it."""
+        from horovod_tpu.multipod.localsgd import (
+            local_sgd_active, parse_sync_mode)
+
+        assert parse_sync_mode("local1") == ("sync", 1)
+        multi = PodTopology(n_pods=4, pod_id=0, world=8)
+        assert not local_sgd_active(multi, "local1")
+        with pytest.raises(HorovodInternalError):
+            LocalSGD(multi, k=1)
+
+
 # ---------------------------------------------------- retry (full jitter)
 
 
