@@ -171,6 +171,14 @@ class Knobs:
     # activation entering it) so it overlaps segment k's compute. 0
     # serializes each gather at its need boundary (debugging).
     fsdp_prefetch: int = 1
+    # Fused computation-collective Pallas backend
+    # (ops/pallas_collectives.py): quantize-in-collective int8 wire,
+    # producer pack/matmul epilogues into the reduce-scatter first hop,
+    # and the fused decode KV-append+attention kernel. Off by default:
+    # the knob-off lowering of every call site is unchanged, and values
+    # are bitwise-identical either way (docs/fused_collectives.md), so
+    # the autotuner can flip it as a pure-performance dimension.
+    fused_collectives: bool = False
 
     # --- hierarchy (operations.cc:551-565) ---
     # On TPU: "hierarchical" = reduce-scatter over ICI within a slice, then
@@ -441,6 +449,7 @@ class Knobs:
             overlap_schedule=_env("OVERLAP_SCHEDULE", "") or "off",
             fsdp=_env_bool("FSDP", True),
             fsdp_prefetch=_env_int("FSDP_PREFETCH", 1),
+            fused_collectives=_env_bool("FUSED_COLLECTIVES", False),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),
             hierarchical_local_size=_env_int("HIERARCHICAL_LOCAL_SIZE", 0),

@@ -234,8 +234,16 @@ class Attention(nn.Module):
                     "kv_cache decoding derives its own validity mask "
                     "from positions; an explicit padding mask is not "
                     "composable with it")
-            k_full, v_full, valid = kv_cache.update(layer, k, v, positions)
-            out = cached_attention(q, k_full, v_full, valid)
+            appender = getattr(kv_cache, "append_attend", None)
+            if appender is not None:
+                # fused append+attend (serving/decode.py): one kernel
+                # per batch row under the fused-collectives knob, the
+                # exact update + cached_attention lowering otherwise
+                out = appender(layer, q, k, v, positions)
+            else:
+                k_full, v_full, valid = kv_cache.update(
+                    layer, k, v, positions)
+                out = cached_attention(q, k_full, v_full, valid)
         elif self.attention_fn is None:
             attn = functools.partial(
                 dot_product_attention, causal=cfg.causal)

@@ -493,7 +493,12 @@ def _run_staged(stages: Sequence[Stage], params, info: dict, mode: str,
                     res_bucket=r_b)
                 new_res_buckets[bi] = new_r
             else:
-                rows = zero_mod._pad_rows(bucket, n)
+                # pack epilogue: fused Pallas layout kernel when the
+                # fused-collectives knob is on (ops/pallas_collectives),
+                # zero._pad_rows (unchanged lowering) when off
+                from . import pallas_collectives as _pc
+
+                rows = _pc.maybe_pack_rows(bucket, n)
                 red = zero_mod._scatter_bucket(rows, ax, n, wire)
                 token = red
             reduced[bi] = red
@@ -745,7 +750,9 @@ def _run_fsdp_staged(stages: Sequence[Stage], layout, rows, info: dict,
                 bool(jnp.issubdtype(bucket.dtype, jnp.floating)))
             if ordered and chain is not None:
                 bucket = _barrier_pair(bucket, chain)
-            rows_b = zero_mod._pad_rows(bucket, n)
+            from . import pallas_collectives as _pc
+
+            rows_b = _pc.maybe_pack_rows(bucket, n)
             if ef:
                 red, nr = zero_mod._scatter_bucket(
                     rows_b, ax, n, wire, residual=res_mats[bi])

@@ -111,22 +111,77 @@ def _pad_flat(flat, multiple: int):
     return flat
 
 
+def _check_block(block, length: int, what: str) -> int:
+    """Trace-time validation of an int8 quantization block: a positive
+    int that divides `length` (the already-padded payload). A block
+    that doesn't divide would silently pad a payload a caller already
+    padded to ITS layout — shifting block boundaries away from the
+    residual/state layout it carries — so reject loudly instead."""
+    block = int(block)
+    if block <= 0:
+        raise ValueError(
+            f"{what}: quantization block must be a positive int, "
+            f"got {block}")
+    if length % block:
+        raise ValueError(
+            f"{what}: block {block} does not divide the padded payload "
+            f"length {length} — the caller's row/residual layout and "
+            f"the wire's block grid would disagree (silently padding "
+            f"again would double-pad; fix the block or the layout)")
+    return block
+
+
+# -- shape-polymorphic block math -------------------------------------------
+#
+# The single source of truth for the int8 wire format: these helpers
+# take an array whose LAST axis is the quantization block and work for
+# any leading shape, so the XLA collectives below and the Pallas kernel
+# bodies (ops/pallas_collectives.py) run literally the same expressions
+# — which is what makes fused-vs-unfused parity bitwise rather than
+# approximate.
+
+def block_scales(blocks):
+    """Per-block symmetric scales for a ``(..., block)`` f32 array:
+    ``amax/127``, with all-zero blocks pinned to 1 so the divide is
+    always defined. Returns shape ``(...,)``.
+
+    Written as a multiply by the reciprocal constant, NOT ``amax /
+    127.0``: XLA rewrites constant-divisor division to a reciprocal
+    multiply inside compiled (Pallas) programs but not in the op-by-op
+    path, so the division form would put the XLA and kernel paths one
+    ulp apart on ~4% of blocks and break fused-vs-unfused bitwise
+    parity. The multiply is correctly rounded and identical everywhere.
+    """
+    amax = jnp.max(jnp.abs(blocks), axis=-1)
+    return jnp.where(amax > 0, amax * (1.0 / 127.0), 1.0)
+
+
+def block_quantize(blocks) -> Tuple:
+    """Quantize a ``(..., block)`` f32 array to ``(q int8 (..., block),
+    scales f32 (...))`` with ``x ≈ q * scale`` per block."""
+    scale = block_scales(blocks)
+    q = jnp.clip(jnp.round(blocks / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def block_dequantize(q, scales):
+    """Inverse of :func:`block_quantize` (f32, same shape as ``q``)."""
+    return q.astype(jnp.float32) * scales.astype(jnp.float32)[..., None]
+
+
 def quantize_blocks(flat, block: int) -> Tuple:
     """Per-block symmetric int8 quantization of a 1-D float array whose
     length is a multiple of `block`. Returns ``(q int8 [m], scales f32 [m/block])``
     with ``x ≈ q * scale`` per block; all-zero blocks get scale 1 so the
     divide is always defined."""
-    b = flat.astype(jnp.float32).reshape(-1, block)
-    amax = jnp.max(jnp.abs(b), axis=1)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(b / scale[:, None]), -127, 127).astype(jnp.int8)
+    q, scale = block_quantize(flat.astype(jnp.float32).reshape(-1, block))
     return q.reshape(-1), scale
 
 
 def dequantize_blocks(q, scales, block: int):
     """Inverse of :func:`quantize_blocks` (float32 output)."""
-    b = q.astype(jnp.float32).reshape(-1, block)
-    return (b * scales.astype(jnp.float32)[:, None]).reshape(-1)
+    return block_dequantize(q.reshape(-1, block), scales).reshape(-1)
 
 
 def quantize_dequantize(x, block: int = DEFAULT_BLOCK):
@@ -326,9 +381,27 @@ def quantized_psum(x, axis: str, n: int, block: int = DEFAULT_BLOCK,
     flat = x.astype(jnp.float32).reshape(-1)
     L = flat.shape[0]
     if residual is not None:
-        flat = flat + residual.astype(jnp.float32).reshape(-1)[:L]
-    padded = _pad_flat(flat, n * block)
+        if int(residual.size) != L:
+            # a residual sized for some OTHER padding (e.g. a padded
+            # row stack) would silently truncate here and the error
+            # feedback would compensate the wrong elements
+            raise ValueError(
+                f"quantized_psum: residual has {int(residual.size)} "
+                f"elements but the payload has {L}; the residual must "
+                "carry exactly the unpadded payload's error")
+        flat = flat + residual.astype(jnp.float32).reshape(-1)
+    padded = _pad_flat(flat, n * int(block))
     m = padded.shape[0]
+    block = _check_block(block, m, "quantized_psum")
+    from ..ops import pallas_collectives as _pc
+
+    if _pc.fused_enabled():
+        # compiled backend: the quantize/EF, dequant-accumulate and
+        # final dequant stages run as Pallas kernels around the same
+        # lax exchanges — same block math (the shared helpers above),
+        # bitwise-identical values (docs/fused_collectives.md)
+        return _pc.fused_quantized_psum(x, axis, n, block,
+                                        residual=residual)
     q, s = quantize_blocks(padded, block)
     # tiled all_to_all on the flat payload: chunk j of ours goes to rank
     # j; we receive every rank's chunk `rank` back-to-back. Scales ride
@@ -366,12 +439,35 @@ def quantized_reduce_scatter_rows(rows, axis: str,
     is rank-private by construction: each rank compensates only the
     contribution it quantizes, never a peer's."""
     n, k = rows.shape
+    block = int(block)
+    if block <= 0:
+        raise ValueError(
+            "quantized_reduce_scatter_rows: quantization block must be "
+            f"a positive int, got {block}")
     k2 = -(-k // block) * block
+    _check_block(block, k2, "quantized_reduce_scatter_rows")
+    if residual is not None and tuple(residual.shape) != (n, k2):
+        # the residual layout is the PADDED row stack; any other shape
+        # means the caller padded for a different block and a silent
+        # reshape would feed the error back onto the wrong blocks
+        raise ValueError(
+            "quantized_reduce_scatter_rows: residual shape "
+            f"{tuple(residual.shape)} does not match the padded row "
+            f"stack ({n}, {k2}) for block {block}")
     if k2 != k:
         rows = jnp.pad(rows, ((0, 0), (0, k2 - k)))
     rows_f = rows.astype(jnp.float32)
     if residual is not None:
-        rows_f = rows_f + residual.astype(jnp.float32).reshape(n, k2)
+        rows_f = rows_f + residual.astype(jnp.float32)
+    from ..ops import pallas_collectives as _pc
+
+    if _pc.fused_enabled():
+        # compiled backend (docs/fused_collectives.md): quantize+EF and
+        # dequant-accumulate run as Pallas kernels around the same
+        # tiled all_to_all — bitwise-identical shard and residual
+        return _pc.fused_quantized_reduce_scatter_rows(
+            rows_f, axis, n, k, k2, block,
+            with_residual=residual is not None)
     q, s = quantize_blocks(rows_f.reshape(-1), block)
     # row-major layout: row r occupies [r*k2, (r+1)*k2) and block
     # divides k2, so blocks never straddle rows and the tiled all_to_all

@@ -35,6 +35,7 @@ ARG_TO_ENV = {
     # False, so a store_false flag could never reach the env)
     "fsdp": "HOROVOD_FSDP",
     "fsdp_prefetch": "HOROVOD_FSDP_PREFETCH",
+    "fused_collectives": "HOROVOD_FUSED_COLLECTIVES",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
     "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
     "hierarchical_local_size": "HOROVOD_HIERARCHICAL_LOCAL_SIZE",

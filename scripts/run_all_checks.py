@@ -59,6 +59,14 @@ locally before the full pytest tier:
   ``hvd_alert_active`` gauge fires then clears on the aggregated
   scrape, the incident JSONL carries the fire/clear pair, and the
   anomaly-triggered flight dump lands on the sink);
+* ``fused`` — ``scripts/fused_check.py --check`` (the fused
+  computation-collective backend, ops/pallas_collectives.py: fp32
+  fused reduce-scatter bitwise vs unfused, int8+EF reduce-scatter and
+  psum carry identical residual trajectories, fused decode
+  append+attend bitwise on fp32 and int8 KV, the
+  HOROVOD_FUSED_COLLECTIVES knob inert-off by lowering hash, and the
+  loopback exposed-wire A/B + autotune never-worse selection written
+  to ``FUSED_AB_r09.json``);
 * ``perf`` — ``scripts/perf_baseline.py --check`` (the perf-regression
   gate: structural invariants — fast-path engaged, zero steady
   negotiated bytes, profiler sampled + attributed inside its duty
@@ -292,6 +300,24 @@ def check_health():
     ])
 
 
+def check_fused():
+    """The fused computation-collective gate (15th): interpret-mode
+    bitwise parity on every fused surface, knob-off lowering inertness,
+    and the loopback exposed-wire A/B artifact FUSED_AB_r09.json."""
+    env = _env()
+    if "xla_force_host_platform_device_count" not in env.get(
+            "XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("HOROVOD_FUSED_COLLECTIVES", None)
+    return _run([
+        sys.executable, os.path.join(_SCRIPTS, "fused_check.py"),
+        "--check",
+    ], env=env)
+
+
 def check_perf():
     """The perf-regression gate + the merged-trace smoke (one gate:
     both run the unified-observability stack end-to-end)."""
@@ -322,6 +348,7 @@ GATES = [
     ("decode", check_decode),
     ("multipod", check_multipod),
     ("health", check_health),
+    ("fused", check_fused),
     ("perf", check_perf),
 ]
 

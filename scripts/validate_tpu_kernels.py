@@ -9,9 +9,15 @@ order) can't hide. Run it on any TPU-attached environment:
 
     python scripts/validate_tpu_kernels.py
 
-Exits non-zero on any mismatch; prints one PASS line per check.
+Exits non-zero on any mismatch; prints one PASS line per check and —
+with ``--json PATH`` (or by default on stdout's last line) — a
+machine-readable verdict ``{"backend", "skipped", "ok", "checks":
+[{"name", "ok", "max_rel_err"}, ...]}`` so CI can gate on it like the
+other check scripts.
 """
 
+import argparse
+import json
 import os
 import sys
 
@@ -21,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+RESULTS = []
+
 
 def _check(name, got, want, atol, rtol=1e-3):
     got, want = np.asarray(got, np.float32), np.asarray(want, np.float32)
@@ -28,13 +36,127 @@ def _check(name, got, want, atol, rtol=1e-3):
     ok = np.allclose(got, want, atol=atol, rtol=rtol)
     print(f"{'PASS' if ok else 'FAIL'} {name}: max rel err {err:.2e}",
           flush=True)
+    RESULTS.append({"name": name, "ok": bool(ok),
+                    "max_rel_err": float(err)})
+    return ok
+
+
+def _emit(json_path, skipped, ok):
+    verdict = {"backend": jax.default_backend(), "skipped": bool(skipped),
+               "ok": bool(ok), "checks": RESULTS}
+    blob = json.dumps(verdict, sort_keys=True)
+    if json_path:
+        with open(json_path, "w") as f:
+            f.write(blob + "\n")
+    print(blob, flush=True)
+
+
+def _fused_collective_checks(rng):
+    """The ops/pallas_collectives kernel family vs its XLA oracles —
+    single-device kernels always, the shard_map end-to-ends when the
+    attached topology has >1 device. The contract is bitwise (atol here
+    is only allclose's denominator guard)."""
+    from horovod_tpu.optim import compression as comp
+    from horovod_tpu.ops import pallas_collectives as pc
+
+    ok = True
+    block, n = 256, 4
+    rows = jnp.asarray(rng.randn(n, 4 * block).astype(np.float32))
+    q1, s1 = jax.jit(lambda r: pc._quantize_rows(r, block))(rows)
+    q0, s0 = jax.jit(
+        lambda r: comp.quantize_blocks(r.reshape(-1), block))(rows)
+    ok &= _check("fused quantize codes", q1.reshape(-1), q0, atol=1e-6,
+                 rtol=0)
+    ok &= _check("fused quantize scales", s1.reshape(-1), s0, atol=1e-6,
+                 rtol=0)
+    _, _, e1 = jax.jit(lambda r: pc._quantize_ef_rows(r, block))(rows)
+    e0 = rows - comp.dequantize_blocks(q0, s0, block).reshape(rows.shape)
+    ok &= _check("fused quantize EF residual", e1, e0, atol=1e-6, rtol=0)
+    acc1 = jax.jit(lambda q, s: pc._accum_rows(q, s, block))(q1, s1)
+    acc0 = comp.dequantize_blocks(q0, s0, block).reshape(
+        n, -1).sum(axis=0)
+    ok &= _check("fused dequant-accumulate", acc1, acc0, atol=1e-6,
+                 rtol=0)
+
+    bucket = jnp.asarray(rng.randn(1000).astype(np.float32))
+    p1 = jax.jit(lambda b: pc.pack_rows_fused(b, n))(bucket)
+    from horovod_tpu.optim import zero as zero_mod
+
+    p0 = zero_mod._pad_rows(bucket, n)
+    ok &= _check("fused pack epilogue", p1, p0, atol=1e-6, rtol=0)
+
+    os.environ["HOROVOD_FUSED_COLLECTIVES"] = "1"
+    try:
+        a = jnp.asarray(rng.randn(64, 48).astype(np.float32))
+        bm = jnp.asarray(rng.randn(48, 32).astype(np.float32))
+        m1 = jax.jit(lambda a, b: pc._matmul_pack(a, b, n))(a, bm)
+        m0 = zero_mod._pad_rows(
+            jnp.dot(a, bm,
+                    preferred_element_type=jnp.float32).reshape(-1), n)
+        ok &= _check("fused matmul epilogue", m1, m0, atol=1e-5)
+        from horovod_tpu.serving.decode import (KVCacheSpec,
+                                                SlottedKVCache)
+
+        for dt in ("fp32", "int8"):
+            spec = KVCacheSpec(slots=2, layers=1, kv_heads=2,
+                               max_len=128, head_dim=128, dtype=dt,
+                               compute_dtype=jnp.float32)
+            cf = SlottedKVCache(spec, spec.allocate())
+            cu = SlottedKVCache(spec, spec.allocate())
+            qd = jnp.asarray(rng.randn(2, 1, 4, 128).astype(np.float32))
+            kn = jnp.asarray(rng.randn(2, 1, 2, 128).astype(np.float32))
+            vn = jnp.asarray(rng.randn(2, 1, 2, 128).astype(np.float32))
+            pos = jnp.zeros((2, 1), jnp.int32)
+            of = cf.append_attend(0, qd, kn, vn, pos)
+            os.environ["HOROVOD_FUSED_COLLECTIVES"] = "0"
+            ou = cu.append_attend(0, qd, kn, vn, pos)
+            os.environ["HOROVOD_FUSED_COLLECTIVES"] = "1"
+            ok &= _check(f"fused decode append+attend ({dt})", of, ou,
+                         atol=1e-6, rtol=0)
+    finally:
+        os.environ.pop("HOROVOD_FUSED_COLLECTIVES", None)
+
+    devs = jax.devices()
+    if len(devs) > 1:
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from horovod_tpu.compat import shard_map
+
+        w = len(devs)
+        mesh = Mesh(np.array(devs), ("d",))
+        x = jnp.asarray(rng.randn(w, 1000).astype(np.float32))
+
+        def psum_body(xs, fused):
+            os.environ["HOROVOD_FUSED_COLLECTIVES"] = (
+                "1" if fused else "0")
+            try:
+                f = shard_map(
+                    lambda v: comp.quantized_psum(
+                        v[0], "d", w, block)[None],
+                    mesh=mesh, in_specs=(P("d"),), out_specs=P("d"),
+                    check_vma=False)
+                return jax.jit(f)(xs)
+            finally:
+                os.environ.pop("HOROVOD_FUSED_COLLECTIVES", None)
+
+        ok &= _check("fused quantized_psum (end-to-end)",
+                     psum_body(x, True), psum_body(x, False),
+                     atol=1e-6, rtol=0)
+    else:
+        print("SKIP fused collective end-to-end: single device",
+              flush=True)
     return ok
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default="",
+                    help="also write the JSON verdict to this path")
+    args = ap.parse_args()
     if jax.default_backend() != "tpu":
         print("no TPU attached; kernels would run in interpret mode "
               "(already covered by the suite) — nothing to validate")
+        _emit(args.json, skipped=True, ok=True)
         return 0
     rng = np.random.RandomState(0)
     ok = True
@@ -152,7 +274,11 @@ def main():
     l0 = jax.jit(ce_ref)(h, w)
     ok &= _check("fused_ce loss", l1, l0, atol=1e-4)
 
+    # fused computation-collective kernels (ops/pallas_collectives.py)
+    ok &= _fused_collective_checks(rng)
+
     print("ALL PASS" if ok else "FAILURES PRESENT", flush=True)
+    _emit(args.json, skipped=False, ok=ok)
     return 0 if ok else 1
 
 
