@@ -171,6 +171,27 @@ class Knobs:
     # activation entering it) so it overlaps segment k's compute. 0
     # serializes each gather at its need boundary (debugging).
     fsdp_prefetch: int = 1
+    # Backward re-gather (recompute-through-the-collective) policy for
+    # the FSDP staged step (docs/fsdp.md): on (default), the forward
+    # runs primal-only and the backward re-issues each bucket's
+    # all-gather at its backward-first-use boundary — no vjp residual
+    # holds gathered weights across the forward→backward span, so
+    # within-step peak param liveness stays ≤ sharded + one bucket
+    # working set. Off takes the saved-gather path verbatim (today's
+    # lowering bit-for-bit; scripts/fsdp_check.py hashes this). Values
+    # are bitwise-identical either way, plain and int8+EF wires alike.
+    fsdp_regather: bool = True
+    # Host-RAM offload of stage-boundary activations for the regather
+    # step's long-stage tail: carries move to pinned host memory at
+    # each stage boundary on forward and prefetch back one stage ahead
+    # on backward. Regather mode only; identity (no-op, still bitwise)
+    # on backends without an addressable host memory space.
+    fsdp_offload: bool = False
+    # Bounded offload duty: the fraction of eligible stage-boundary
+    # carries actually offloaded, earliest stages first (they wait
+    # longest for backward), capping host-link traffic per step the
+    # way the replicator's duty cycle caps host CPU (docs/fsdp.md).
+    fsdp_offload_duty: float = 1.0
     # Fused computation-collective Pallas backend
     # (ops/pallas_collectives.py): quantize-in-collective int8 wire,
     # producer pack/matmul epilogues into the reduce-scatter first hop,
@@ -449,6 +470,9 @@ class Knobs:
             overlap_schedule=_env("OVERLAP_SCHEDULE", "") or "off",
             fsdp=_env_bool("FSDP", True),
             fsdp_prefetch=_env_int("FSDP_PREFETCH", 1),
+            fsdp_regather=_env_bool("FSDP_REGATHER", True),
+            fsdp_offload=_env_bool("FSDP_OFFLOAD", False),
+            fsdp_offload_duty=_env_float("FSDP_OFFLOAD_DUTY", 1.0),
             fused_collectives=_env_bool("FUSED_COLLECTIVES", False),
             hierarchical_allreduce=_env_bool("HIERARCHICAL_ALLREDUCE", False),
             hierarchical_allgather=_env_bool("HIERARCHICAL_ALLGATHER", False),

@@ -341,6 +341,41 @@ def bucket_prefetch_schedule(plans, leaf_first_stage, n_stages: int):
     return list(reversed(rev))
 
 
+def bucket_regather_schedule(plans, leaf_last_stage, n_stages: int):
+    """When must each fusion bucket's parameter all-gather be RE-ISSUED
+    during a segmented backward pass under the regather policy
+    (HOROVOD_FSDP_REGATHER, ops/overlap.py, docs/fsdp.md)? The third
+    direction of :func:`bucket_issue_schedule`: the backward walks the
+    stages in reverse, and a bucket's weights are first needed at the
+    LAST forward stage touching any of its leaves — the earliest point
+    the reversed traversal reaches it. The tied-embedding bucket is
+    again the canonical asymmetry: it is needed FIRST on backward (the
+    head's matmul transpose reads it in the first backward segment)
+    even though its gradient completes LAST.
+
+    ``leaf_last_stage[i]`` is the last forward stage using leaf ``i``
+    (``max`` of its contributing stages). Returns one list per BACKWARD
+    step (index 0 = the last forward stage's backward): the bucket
+    indices whose re-gather must have completed by that step. Each
+    bucket appears exactly once — the exactly-once re-gather per
+    backward the bitwise contract rides on. Implemented by driving
+    :func:`bucket_issue_schedule` in the backward direction after
+    lifting every leaf to its BUCKET's largest last-use stage — the
+    issue scheduler waits for ALL leaves, which in the reversed
+    traversal is the smallest stage, so without the lift a bucket
+    whose leaves end in different stages would be scheduled at its
+    LATEST-reached leaf instead of its first backward use. The result
+    is already in backward-step order."""
+    lifted = list(leaf_last_stage)
+    for bp in plans:
+        m = max(leaf_last_stage[i] for (i, _, _, _) in bp)
+        for (i, _, _, _) in bp:
+            lifted[i] = m
+    return bucket_issue_schedule(
+        plans, [[s] for s in lifted],
+        list(reversed(range(n_stages))))
+
+
 def pack_buckets_by_plan(tree, plans):
     """Bucket payloads of `tree`'s leaves under a pytree_bucket_plan's
     per-bucket leaf layout (the pack half of pack_pytree_by_plan)."""

@@ -47,8 +47,9 @@ import numpy as np
 from . import collectives
 from .collectives import ReduceOp
 from .fusion import (bucket_issue_schedule, bucket_prefetch_schedule,
-                     pack_buckets_by_plan, plan_bucket_lengths,
-                     pytree_bucket_plan, unflatten_buckets_by_plan)
+                     bucket_regather_schedule, pack_buckets_by_plan,
+                     plan_bucket_lengths, pytree_bucket_plan,
+                     unflatten_buckets_by_plan)
 
 _MODES = ("off", "stage", "double")
 
@@ -567,7 +568,8 @@ def _run_staged(stages: Sequence[Stage], params, info: dict, mode: str,
 # ---------------------------------------------------------------------------
 
 def fsdp_staged_value_and_grad(stages_fn: Callable, opt,
-                               layout=None, prefetch=None):
+                               layout=None, prefetch=None,
+                               regather=None, offload=None):
     """Build ``vag(rows, *batch, opt_state=None) -> (loss,
     StagedShards)`` over fully-sharded parameter rows
     (optim/fsdp.py): the forward's per-bucket parameter all-gathers
@@ -589,6 +591,23 @@ def fsdp_staged_value_and_grad(stages_fn: Callable, opt,
     knob) is the gather look-ahead in stages; 0 serializes each gather
     at its need boundary.
 
+    ``regather`` (default the HOROVOD_FSDP_REGATHER knob, on)
+    differentiates *through* the gather: the forward runs primal-only
+    — no vjp residual captures gathered weights — and the backward
+    re-issues each bucket's all-gather at its backward-first-use
+    boundary (fusion.bucket_regather_schedule), pinned behind the
+    incoming cotangent, then runs the IDENTICAL pack → maybe_pack_rows
+    → zero._scatter_bucket chain, so values stay bitwise the
+    saved-gather mode's on plain and int8+error-feedback wires while
+    within-step peak param liveness drops to sharded + the bucket
+    working set (docs/fsdp.md). ``regather=False`` takes the
+    saved-gather code path verbatim — bit-for-bit its lowering.
+    ``offload`` (default the HOROVOD_FSDP_OFFLOAD knob, off; regather
+    mode only) additionally moves stage-boundary activation carries to
+    pinned host memory on forward and prefetches each back one
+    backward stage ahead, duty-bounded by HOROVOD_FSDP_OFFLOAD_DUTY; a
+    no-op on backends without an addressable host memory space.
+
     ``opt`` must be a FullyShardedOptimizer; its
     ``update(staged, state, params=shards)`` consumes the result. Under
     the int8 error-feedback wire pass ``opt_state=`` so the residual
@@ -609,16 +628,26 @@ def fsdp_staged_value_and_grad(stages_fn: Callable, opt,
     def vag(rows, *batch, opt_state=None):
         stages = stages_fn(*batch)
         return _run_fsdp_staged(stages, layout, rows, info, opt_state,
-                                prefetch)
+                                prefetch, regather, offload)
 
     return vag
 
 
 def _run_fsdp_staged(stages: Sequence[Stage], layout, rows, info: dict,
-                     opt_state, prefetch):
+                     opt_state, prefetch, regather=None, offload=None):
     from ..core.state import global_state
     from ..optim import fsdp as fsdp_mod
     from ..optim import zero as zero_mod
+
+    if regather is None:
+        regather = bool(getattr(global_state().knobs, "fsdp_regather",
+                                True))
+    if regather:
+        # recompute-through-the-gather policy; the saved-gather path
+        # below stays byte-for-byte today's trace (the knob-off
+        # lowering-hash contract, scripts/fsdp_check.py)
+        return _run_fsdp_regather(stages, layout, rows, info, opt_state,
+                                  prefetch, offload)
 
     axis_name = info.get("axis_name")
     live = collectives._bound_axes(collectives._resolve_axis(axis_name))
@@ -792,12 +821,366 @@ def _run_fsdp_staged(stages: Sequence[Stage], layout, rows, info: dict,
                               new_residuals=new_res if ef else None)
 
 
-def _record_fsdp_step(param_bytes: int, gather_bytes: int):
+_HOST_OFFLOAD_OK = None
+
+
+def _host_offload_supported() -> bool:
+    """Whether this backend accepts memory-kind-annotated device_put in
+    traced code (TPU/GPU pinned_host; XLA:CPU tolerates the annotation
+    as an identity). Probed once per process by LOWERING a tiny round
+    trip — no execution, safe to call mid-trace — so
+    HOROVOD_FSDP_OFFLOAD degrades to keeping carries resident on
+    backends that reject the annotation, never to an error."""
+    global _HOST_OFFLOAD_OK
+    if _HOST_OFFLOAD_OK is None:
+        try:
+            from jax._src.sharding_impls import TransferToMemoryKind
+
+            jax.jit(lambda v: jax.device_put(
+                jax.device_put(v, TransferToMemoryKind("pinned_host")),
+                TransferToMemoryKind("device"))).lower(
+                jax.ShapeDtypeStruct((1,), jnp.float32))
+            _HOST_OFFLOAD_OK = True
+        except Exception:
+            _HOST_OFFLOAD_OK = False
+    return _HOST_OFFLOAD_OK
+
+
+def _offload_stage_set(n_stages: int, duty: float):
+    """Which stage-boundary carries move to host under
+    HOROVOD_FSDP_OFFLOAD: the eligible set excludes stage 0 (its carry
+    is the dummy scalar seed) and the last stage (its carry is
+    re-consumed immediately by the first backward segment); of the
+    rest, the EARLIEST stages offload first — their carries wait
+    longest for backward, the long-stage tail — up to ``duty`` of the
+    set, the offload analog of the replicator's bounded duty cycle."""
+    eligible = list(range(1, n_stages - 1))
+    if not eligible or duty <= 0.0:
+        return set()
+    k = int(np.ceil(min(duty, 1.0) * len(eligible)))
+    return set(eligible[:k])
+
+
+def _carry_put(c, kind: str):
+    """tree-wide device_put onto a memory kind ('pinned_host' out,
+    'device' back)."""
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, TransferToMemoryKind(kind)), c)
+
+
+def _carry_bytes(c) -> int:
+    leaves = jax.tree_util.tree_leaves(c)
+    return sum(
+        int(getattr(l, "size", 1)) *
+        np.dtype(getattr(l, "dtype", jnp.float32)).itemsize
+        for l in leaves)
+
+
+def _run_fsdp_regather(stages: Sequence[Stage], layout, rows,
+                       info: dict, opt_state, prefetch, offload):
+    """The regather FSDP step (HOROVOD_FSDP_REGATHER, docs/fsdp.md):
+    differentiate *through* the per-bucket all-gather. The forward runs
+    stages 0..S-2 primal-only — the only values surviving toward
+    backward are the stage-boundary activation carries, never gathered
+    weights — and the backward walks the stages in reverse, re-issuing
+    each bucket's all-gather at its backward-first-use boundary
+    (fusion.bucket_regather_schedule; pinned behind the incoming
+    cotangent so no scheduler may hoist it into forward), rebuilding
+    that segment's vjp against the freshly gathered rows, and feeding
+    the resulting bucket through the IDENTICAL pack → maybe_pack_rows
+    → zero._scatter_bucket chain as the saved-gather path. The LAST
+    stage is the forward/backward boundary itself: its vjp is built
+    once at backward step 0 and its primal output is the returned loss
+    — the same subgraph (live residuals, same gather pin) the
+    saved-gather mode traces for it, which is what keeps the loss
+    bitwise (a recomputed loss stage compiles with dead residuals and
+    can drift a final-reduction ulp). Same ops on same values
+    throughout, so params/state/EF residual/loss stay bitwise-equal on
+    plain and int8 wires while no gathered bucket buffer is live
+    across the forward→backward span: within-step peak param liveness
+    ≤ sharded + the prefetch-depth bucket working set. Under
+    ``offload`` the carries additionally move to pinned host memory at
+    each boundary and prefetch back one backward stage ahead."""
+    from ..core.state import global_state
+    from ..optim import fsdp as fsdp_mod
+    from ..optim import zero as zero_mod
+
+    axis_name = info.get("axis_name")
+    live = collectives._bound_axes(collectives._resolve_axis(axis_name))
+    if len(live) != 1:
+        raise RuntimeError(
+            "the FSDP staged step shards parameters over exactly one "
+            f"live data-parallel axis; got live axes {live} — run "
+            "inside shard_map with the fsdp/dp mesh axis bound")
+    ax = live[0]
+    n = collectives._group_size(info.get("process_set"), axis_name)
+    if n != layout.world:
+        raise ValueError(
+            f"parameter rows were sharded for world {layout.world} but "
+            f"the live group size is {n} — reshard with "
+            "fsdp.reshard_rows before re-entering the train loop")
+    wire = info.get("wire")
+    ef = bool(info.get("error_feedback"))
+    knobs = global_state().knobs
+    if prefetch is None:
+        prefetch = int(getattr(knobs, "fsdp_prefetch", 1))
+    depth = max(int(prefetch), 0)
+    if offload is None:
+        offload = bool(getattr(knobs, "fsdp_offload", False))
+    duty = float(getattr(knobs, "fsdp_offload_duty", 1.0))
+
+    shards = fsdp_mod.local_shards(rows, layout)
+    plans = list(layout.plans)
+    lens = list(layout.lens)
+    abs_params = fsdp_mod.abstract_params(layout)
+    path_to_idx, leaf_stages = _leaf_index_maps(abs_params, stages)
+    S = len(stages)
+    need = bucket_prefetch_schedule(plans, [min(s) for s in leaf_stages],
+                                    S)
+    leaf_loc = {}
+    for bi, bp in enumerate(plans):
+        for (i, off, sz, shp) in bp:
+            leaf_loc[i] = (bi, off, sz, shp)
+    # forward drop boundary: the last PRIMAL stage (≤ S-2) touching any
+    # leaf of the bucket — stage S-1 runs at backward step 0, so a
+    # bucket only it uses is never forward-needed (None). Backward drop
+    # boundary: the FIRST forward stage touching the bucket (the last
+    # backward segment that reads it).
+    fwd_last = []
+    for bp in plans:
+        uses = [s for (i, _, _, _) in bp for s in leaf_stages[i]
+                if s < S - 1]
+        fwd_last.append(max(uses) if uses else None)
+    first_use = [
+        min(min(leaf_stages[i]) for (i, _, _, _) in bp) for bp in plans
+    ]
+    bkt_bytes = [
+        n * k * np.dtype(d).itemsize
+        for k, d in zip(layout.ks, layout.dtypes)
+    ]
+
+    gathered = {}
+
+    def _gather(bi, pin):
+        row = shards[bi]
+        if pin is not None and hasattr(pin, "dtype") and \
+                jnp.issubdtype(pin.dtype, jnp.inexact):
+            # forward: the anti-hoist pin behind the activation
+            # entering the current segment; backward: behind the
+            # incoming cotangent (step 0: behind the carry entering the
+            # last stage — the ct seed is a constant, no scheduler
+            # edge), so the re-gather cannot migrate into the forward
+            # and restore the very liveness this mode removes
+            row = _barrier_pair(row, pin)
+        full = jax.lax.all_gather(row, ax, tiled=True)
+        return full[: lens[bi]]
+
+    def _sub_for(si):
+        sub_abs = {k: abs_params[k] for k in stages[si].keys}
+        paths, sub_def = jax.tree_util.tree_flatten_with_path(sub_abs)
+        leaves = []
+        for p, _sds in paths:
+            bi, off, sz, shp = leaf_loc[
+                path_to_idx[jax.tree_util.keystr(p)]]
+            leaves.append(jax.lax.dynamic_slice_in_dim(
+                gathered[bi], off, sz).reshape(shp))
+        return jax.tree_util.tree_unflatten(sub_def, leaves)
+
+    offload_set = (
+        _offload_stage_set(S, duty)
+        if offload and _host_offload_supported() else set())
+    offload_bytes = 0
+
+    # ---- forward: stages 0..S-2 primal-only; nothing but the
+    # inter-stage carries survives toward backward -----------------------
+    carries: List[Any] = [None] * S
+    carry = jnp.zeros((), jnp.float32)
+    for s in range(S - 1):
+        st = stages[s]
+        for bi in need[s]:
+            if bi not in gathered:
+                gathered[bi] = _gather(bi, carry if s else None)
+        for d in range(1, depth + 1):
+            if s + d >= S:
+                break
+            for bi in need[s + d]:
+                if bi not in gathered:
+                    gathered[bi] = _gather(bi, carry if s else None)
+        if s in offload_set:
+            carries[s] = _carry_put(carry, "pinned_host")
+            offload_bytes += _carry_bytes(carry)
+        else:
+            carries[s] = carry
+
+        def f(sub, carry, _st=st):
+            return _st.fwd(sub, carry)
+
+        # primal through jax.vjp with the vjp function DROPPED: the
+        # residuals are dead code (no gathered weights survive to
+        # backward), but the primal follows the exact linearization
+        # trace the saved-gather mode's forward does — custom-jvp
+        # primals (log_softmax et al.) can differ in the last ulp from
+        # plain execution, and the bitwise contract forbids that
+        carry = jax.vjp(f, _sub_for(s), carry)[0]
+        for bi in [b for b in list(gathered) if fwd_last[b] == s]:
+            del gathered[bi]
+    # the carry entering the last stage: the forward/backward boundary
+    # value (never offloaded — backward step 0 consumes it immediately)
+    carries[S - 1] = carry
+
+    # ---- backward: re-gather at backward-first-use, rebuild the
+    # segment vjp against the fresh rows, reduce-scatter as before -------
+    res_mats = None
+    if ef:
+        if opt_state is None:
+            raise ValueError(
+                "this FullyShardedOptimizer carries error-feedback "
+                "state; pass opt_state= to the staged value_and_grad "
+                "so the residual rides the staged quantized "
+                "reduce-scatters (docs/fsdp.md)")
+        res_mats = fsdp_mod._residual_mats(opt_state, layout, wire.block)
+        if res_mats is None:
+            raise ValueError(
+                "opt_state carries no FsdpEFState residual but the "
+                "optimizer was built on the int8 error-feedback wire")
+    ordered = global_state().knobs.ordered_buckets
+    backward_stage_order = list(reversed(range(S)))
+    schedule = bucket_issue_schedule(plans, leaf_stages,
+                                     backward_stage_order)
+    regather_need = bucket_regather_schedule(
+        plans, [max(s) for s in leaf_stages], S)
+    costs = _stage_cost_bytes(abs_params, stages)
+    leaf_grads: List[Any] = [None] * layout.nleaves
+    reduced: List[Any] = [None] * len(plans)
+    new_res: List[Any] = [None] * len(plans)
+    bucket_meta: List[tuple] = [(0, 0, False)] * len(plans)
+    chain = None
+    first_issue_step = None
+    loss = None
+    ct = None
+    regather_bytes = 0
+    fetched = {}
+
+    def _restore(si):
+        c = carries[si]
+        return _carry_put(c, "device") if si in offload_set else c
+
+    for step_i, si in enumerate(backward_stage_order):
+        # step 0's gathers carry the saved-mode last-stage pin (the
+        # carry entering stage S-1; None when S == 1 — the seed is a
+        # constant); later steps pin behind the incoming cotangent
+        pin = ct if step_i else (carries[si] if si else None)
+        for bi in regather_need[step_i]:
+            if bi not in gathered:
+                gathered[bi] = _gather(bi, pin)
+                if step_i or fwd_last[bi] is not None:
+                    regather_bytes += bkt_bytes[bi]
+        for d in range(1, depth + 1):
+            if step_i + d >= S:
+                break
+            for bi in regather_need[step_i + d]:
+                if bi not in gathered:
+                    gathered[bi] = _gather(bi, pin)
+                    regather_bytes += bkt_bytes[bi]
+        carry_in = fetched.pop(si, None)
+        if carry_in is None:
+            carry_in = _restore(si)
+        # host→HBM prefetch one backward stage ahead: the next
+        # segment's carry transfers while this segment computes
+        if step_i + 1 < S:
+            nxt = backward_stage_order[step_i + 1]
+            if nxt not in fetched:
+                fetched[nxt] = _restore(nxt)
+
+        def f(sub, carry, _st=stages[si]):
+            return _st.fwd(sub, carry)
+
+        if step_i == 0:
+            # the last stage runs HERE, once: primal out is the loss,
+            # residuals feed this step's backward — the saved-gather
+            # mode's exact last-stage subgraph (bitwise loss)
+            loss, vjp = jax.vjp(f, _sub_for(si), carry_in)
+            if jnp.ndim(loss) != 0:
+                raise ValueError(
+                    f"the last stage must return a scalar loss; got "
+                    f"shape {jnp.shape(loss)}")
+            ct = jnp.ones((), _loss_seed_dtype(loss))
+        else:
+            _, vjp = jax.vjp(f, _sub_for(si), carry_in)
+        g_sub, ct_in = vjp(ct)
+        for p, g in jax.tree_util.tree_flatten_with_path(g_sub)[0]:
+            i = path_to_idx[jax.tree_util.keystr(p)]
+            leaf_grads[i] = g if leaf_grads[i] is None \
+                else leaf_grads[i] + g
+        for bi in schedule[step_i]:
+            bucket = _pack_bucket(leaf_grads, plans[bi])
+            bucket_meta[bi] = (
+                int(bucket.size), bucket.dtype.itemsize,
+                bool(jnp.issubdtype(bucket.dtype, jnp.floating)))
+            if ordered and chain is not None:
+                bucket = _barrier_pair(bucket, chain)
+            from . import pallas_collectives as _pc
+
+            rows_b = _pc.maybe_pack_rows(bucket, n)
+            if ef:
+                red, nr = zero_mod._scatter_bucket(
+                    rows_b, ax, n, wire, residual=res_mats[bi])
+                new_res[bi] = nr.reshape(1, -1)
+            else:
+                red = zero_mod._scatter_bucket(rows_b, ax, n, wire)
+            reduced[bi] = red
+            chain = red
+            if first_issue_step is None:
+                first_issue_step = step_i
+        if si > 0 and chain is not None and hasattr(ct_in, "dtype") \
+                and jnp.issubdtype(ct_in.dtype, jnp.inexact):
+            ct_in = _barrier_pair(ct_in, chain)
+        ct = ct_in
+        # drop re-gathered buffers once backward passes the bucket's
+        # FIRST forward stage — the bounded backward working set
+        for bi in [b for b in list(gathered) if first_use[b] == si]:
+            del gathered[bi]
+    missing = [bi for bi, r in enumerate(reduced) if r is None]
+    if missing:
+        raise AssertionError(
+            f"buckets {missing} never became available — stage "
+            f"decomposition does not cover their leaves")
+
+    total_cost = float(sum(costs)) or 1.0
+    pinned_frac = sum(
+        costs[si] for step_i, si in enumerate(backward_stage_order)
+        if first_issue_step is not None and step_i > first_issue_step
+    ) / total_cost
+    _record_staged_step(bucket_meta, wire, pinned_frac)
+    gather_bytes = sum(
+        n * k * np.dtype(d).itemsize
+        for k, d in zip(layout.ks, layout.dtypes))
+    # ≤ one re-gather per bucket per backward (exactly one for buckets
+    # the primal stages used; head-only buckets gather once total)
+    _record_fsdp_step(layout.shard_bytes, gather_bytes,
+                      regather_bytes=regather_bytes,
+                      offload_bytes=offload_bytes)
+
+    for shard, L in zip(reduced, lens):
+        k = -(-L // n)
+        if shard.shape != (k,):
+            raise AssertionError((shard.shape, k))
+    return loss, StagedShards(reduced,
+                              new_residuals=new_res if ef else None)
+
+
+def _record_fsdp_step(param_bytes: int, gather_bytes: int,
+                      regather_bytes: int = 0, offload_bytes: int = 0):
     """Execution-time FSDP telemetry: the per-device resident parameter
-    bytes (the HBM win) and the full-precision bytes the forward
-    all-gathers re-materialize each step (the wire rent paid for it) —
-    hvd_hbm_param_bytes / hvd_fsdp_gather_bytes_total plus the StepStats
-    JSONL fields (docs/metrics.md)."""
+    bytes (the HBM win), the full-precision bytes the forward
+    all-gathers re-materialize each step (the wire rent paid for it),
+    plus — regather mode — the backward re-gather bytes and the
+    stage-carry bytes offloaded to host RAM: hvd_hbm_param_bytes /
+    hvd_fsdp_gather_bytes_total / hvd_fsdp_regather_bytes_total /
+    hvd_fsdp_offload_bytes_total and the StepStats JSONL fields
+    (docs/metrics.md)."""
     import functools
 
     from ..utils import metrics as _metrics
@@ -807,7 +1190,8 @@ def _record_fsdp_step(param_bytes: int, gather_bytes: int):
     from jax.experimental import io_callback
 
     io_callback(functools.partial(
-        _metrics.record_fsdp_step, int(param_bytes), int(gather_bytes)),
+        _metrics.record_fsdp_step, int(param_bytes), int(gather_bytes),
+        int(regather_bytes), int(offload_bytes)),
         None)
 
 

@@ -20,14 +20,18 @@ backward-availability bucket plan — and the train step:
     costs a full replicated copy of the model) yet overlaps segment k's
     compute. Gathered buffers are dropped after their last forward use,
     so the forward's gather working set stays ~one bucket above the
-    sharded size. Honest limit, stated plainly: each stage's vjp
-    residuals still hold that stage's gathered param slices from
-    forward to backward (matmul transposes need W), so within-step
-    peak param liveness can reach the replicated size — the RESIDENT
-    wins (train state between steps, optimizer state, init,
-    checkpoints) are 1/world and gated; freeing the residuals needs
-    backward re-gather (recompute-the-gather), the named follow-up in
-    docs/fsdp.md;
+    sharded size. Under the default regather policy
+    (HOROVOD_FSDP_REGATHER) the forward is primal-only — no vjp
+    residual captures gathered weights — and the backward re-issues
+    each bucket's all-gather at its backward-first-use boundary
+    (`fusion.bucket_regather_schedule`), so WITHIN-STEP peak param
+    liveness is sharded + the prefetch-depth bucket working set, not
+    just the resident bound; the old honest limit (vjp residuals
+    holding gathered slices forward→backward, peak reaching the
+    replicated size) now applies only to HOROVOD_FSDP_REGATHER=0,
+    which keeps the saved-gather lowering bit-for-bit.
+    HOROVOD_FSDP_OFFLOAD additionally parks stage-boundary activation
+    carries in pinned host RAM until backward, duty-bounded;
   * **backward**: the reduce-scatters ride the existing staged path —
     each gradient bucket `psum_scatter`s at its availability boundary
     (`optim.zero._scatter_bucket`, the shared data plane), including
@@ -436,25 +440,32 @@ def FullyShardedOptimizer(optimizer, axis_name=None,
 
 
 def fsdp_value_and_grad(stages_fn, opt, layout: FsdpLayout,
-                        mode: str = "prefetch", prefetch=None):
+                        mode: str = "prefetch", prefetch=None,
+                        regather=None, offload=None):
     """Build ``vag(rows, *batch, opt_state=None) -> (loss,
     StagedShards)`` over fully-sharded parameter rows.
 
     ``mode="prefetch"`` (the real path) delegates to
     `ops/overlap.fsdp_staged_value_and_grad`: segmented forward,
     per-bucket all-gathers prefetch-interleaved with compute, staged
-    backward reduce-scatters. ``mode="upfront"`` is the **gathered
+    backward reduce-scatters — and, under ``regather`` (default the
+    HOROVOD_FSDP_REGATHER knob, on), a primal-only forward with the
+    backward re-issuing each bucket's gather at its backward-first-use
+    boundary so no gathered weights survive forward→backward;
+    ``offload`` additionally moves stage-boundary carries to host RAM
+    (HOROVOD_FSDP_OFFLOAD). ``mode="upfront"`` is the **gathered
     reference**: every bucket all-gathered unpinned at t=0, one
     monolithic `jax.value_and_grad` over the replicated tree, then the
     ordered monolithic scatter chain — the naive lowering the A/B
     artifact compares against and the bitwise-parity oracle
-    `scripts/fsdp_check.py` gates with. Both modes share every reduce
+    `scripts/fsdp_check.py` gates with. All modes share every reduce
     and update op, which is what makes parity exact."""
     from ..ops import overlap as overlap_mod
 
     if mode == "prefetch":
         return overlap_mod.fsdp_staged_value_and_grad(
-            stages_fn, opt, layout, prefetch=prefetch)
+            stages_fn, opt, layout, prefetch=prefetch,
+            regather=regather, offload=offload)
     if mode != "upfront":
         raise ValueError(f"unknown fsdp mode {mode!r} "
                          "(expected prefetch|upfront)")

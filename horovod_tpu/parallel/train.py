@@ -321,7 +321,8 @@ def _maybe_fsdp_step_fn(cfg, model, optimizer, mesh, batch_spec,
 
     svag = overlap_mod.fsdp_staged_value_and_grad(
         _count_weighted_stages(model, want, n_world), optimizer,
-        layout, prefetch=knobs.fsdp_prefetch)
+        layout, prefetch=knobs.fsdp_prefetch,
+        regather=knobs.fsdp_regather, offload=knobs.fsdp_offload)
 
     def fsdp_step(rows, opt_state, tokens):
         loss, g = svag(rows, tokens, opt_state=opt_state)

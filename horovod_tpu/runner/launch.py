@@ -135,6 +135,33 @@ def parse_args(argv: Optional[List[str]] = None):
                         "k+1's parameter gather issues at segment k's "
                         "boundary and overlaps its compute; 0 "
                         "serializes gathers at their need boundaries")
+    p.add_argument("--fsdp-regather", dest="fsdp_regather",
+                   choices=["0", "1"],
+                   help="FSDP backward re-gather policy "
+                        "(HOROVOD_FSDP_REGATHER, docs/fsdp.md): 1 "
+                        "(default) drops each gathered bucket at its "
+                        "last forward use and re-issues the all-gather "
+                        "at its backward-first-use boundary — "
+                        "within-step peak param liveness capped at "
+                        "sharded + one bucket working set, bitwise "
+                        "equal to 0 (save gathered weights across the "
+                        "whole step — the pre-regather lowering)")
+    p.add_argument("--fsdp-offload", dest="fsdp_offload",
+                   choices=["0", "1"],
+                   help="FSDP host-RAM activation offload "
+                        "(HOROVOD_FSDP_OFFLOAD, docs/fsdp.md): 1 parks "
+                        "inter-stage carries in pinned host memory on "
+                        "forward and prefetches each back one backward "
+                        "segment ahead; bitwise no-op on values; "
+                        "default 0")
+    p.add_argument("--fsdp-offload-duty", dest="fsdp_offload_duty",
+                   type=float,
+                   help="fraction of eligible stage carries the "
+                        "offload parks on the host "
+                        "(HOROVOD_FSDP_OFFLOAD_DUTY, default 1.0): "
+                        "earliest stages first — bound the host PCIe "
+                        "duty cycle when full offload would not hide "
+                        "under compute")
     p.add_argument("--fused-collectives", dest="fused_collectives",
                    choices=["0", "1"],
                    help="fused computation-collective Pallas backend "

@@ -35,6 +35,9 @@ ARG_TO_ENV = {
     # False, so a store_false flag could never reach the env)
     "fsdp": "HOROVOD_FSDP",
     "fsdp_prefetch": "HOROVOD_FSDP_PREFETCH",
+    "fsdp_regather": "HOROVOD_FSDP_REGATHER",
+    "fsdp_offload": "HOROVOD_FSDP_OFFLOAD",
+    "fsdp_offload_duty": "HOROVOD_FSDP_OFFLOAD_DUTY",
     "fused_collectives": "HOROVOD_FUSED_COLLECTIVES",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
     "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",

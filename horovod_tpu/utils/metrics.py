@@ -580,6 +580,8 @@ class StepStats:
         self.overlap_window = None  # staged-scheduler pin (0..1)
         self.fsdp_param_bytes = None  # per-device resident param bytes
         self.fsdp_gather_bytes = 0    # forward all-gather bytes
+        self.fsdp_regather_bytes = 0  # backward re-gather bytes
+        self.fsdp_offload_bytes = 0   # stage carries parked in host RAM
         self.mfu = None             # model-FLOPs utilization (0..1)
         self.attribution = None     # sampled device attribution dict
         self.queue_depth = 0
@@ -619,10 +621,13 @@ class StepStats:
         with self._lock:
             self.overlap_window = float(frac)
 
-    def add_fsdp(self, param_bytes: int, gather_bytes: int) -> None:
+    def add_fsdp(self, param_bytes: int, gather_bytes: int,
+                 regather_bytes: int = 0, offload_bytes: int = 0) -> None:
         with self._lock:
             self.fsdp_param_bytes = int(param_bytes)
             self.fsdp_gather_bytes += int(gather_bytes)
+            self.fsdp_regather_bytes += int(regather_bytes)
+            self.fsdp_offload_bytes += int(offload_bytes)
 
     def set_mfu(self, mfu: float) -> None:
         with self._lock:
@@ -732,6 +737,8 @@ class StepStats:
                 record["fsdp"] = {
                     "hbm_param_bytes": self.fsdp_param_bytes,
                     "gather_bytes": self.fsdp_gather_bytes,
+                    "regather_bytes": self.fsdp_regather_bytes,
+                    "offload_bytes": self.fsdp_offload_bytes,
                 }
             if self.mfu is not None:
                 record["mfu"] = self.mfu
@@ -1003,15 +1010,21 @@ def record_overlap_window(frac: float) -> None:
     step_stats.set_overlap_window(frac)
 
 
-def record_fsdp_step(param_bytes: int, gather_bytes: int) -> None:
+def record_fsdp_step(param_bytes: int, gather_bytes: int,
+                     regather_bytes: int = 0,
+                     offload_bytes: int = 0) -> None:
     """One executed fully-sharded-parameter step (optim/fsdp.py,
     io_callback from the compiled step): the per-device parameter bytes
     RESIDENT in HBM (the sharded footprint — under FSDP ~1/world of
     the replicated size; the durable memory win) and the full-precision
     parameter bytes the forward all-gathers re-materialized this step
     (the recurring wire rent paid for it). Their ratio per step is
-    ~world: FSDP trades gather bandwidth for resident HBM
-    (docs/fsdp.md)."""
+    ~world: FSDP trades gather bandwidth for resident HBM. Regather
+    mode (HOROVOD_FSDP_REGATHER) pays the rent twice —
+    ``regather_bytes`` counts the backward re-issued gathers that cap
+    within-step peak liveness — and ``offload_bytes`` counts
+    stage-boundary activation carries parked in host RAM under
+    HOROVOD_FSDP_OFFLOAD (docs/fsdp.md)."""
     if not _enabled:
         return
     registry.gauge(
@@ -1023,7 +1036,19 @@ def record_fsdp_step(param_bytes: int, gather_bytes: int) -> None:
         "hvd_fsdp_gather_bytes_total",
         "Full-precision parameter bytes materialized by FSDP forward "
         "all-gathers").inc(float(gather_bytes))
-    step_stats.add_fsdp(param_bytes, gather_bytes)
+    if regather_bytes:
+        registry.counter(
+            "hvd_fsdp_regather_bytes_total",
+            "Full-precision parameter bytes re-materialized by FSDP "
+            "backward re-gathers (regather mode)").inc(
+                float(regather_bytes))
+    if offload_bytes:
+        registry.counter(
+            "hvd_fsdp_offload_bytes_total",
+            "Stage-boundary activation bytes offloaded to host RAM "
+            "per step (HOROVOD_FSDP_OFFLOAD)").inc(float(offload_bytes))
+    step_stats.add_fsdp(param_bytes, gather_bytes, regather_bytes,
+                        offload_bytes)
 
 
 def record_mfu(mfu: float) -> None:

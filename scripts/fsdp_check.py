@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """World-local FSDP loopback gate (the 10th run_all_checks.py gate).
 
-Four properties of the fully-sharded parameter path (optim/fsdp.py,
+Seven properties of the fully-sharded parameter path (optim/fsdp.py,
 docs/fsdp.md), all on the 8-device virtual CPU host mesh:
 
 1. **Bitwise parity vs the gathered reference** — one executed step of
@@ -19,9 +19,24 @@ docs/fsdp.md), all on the 8-device virtual CPU host mesh:
    fsdp.apply_shard_updates);
 3. **Measured memory bound** — per-device resident parameter bytes of
    the initialized train state ≤ replicated_bytes/world + one bucket;
-4. **Knob-off lowering hash** — flipping HOROVOD_FSDP does not perturb
-   a non-FSDP (ShardedOptimizer) step: identical lowered HLO text
-   hashes with the knob 0 and 1 (today's paths stay bit-for-bit).
+4. **Knob-off lowering hash** — flipping HOROVOD_FSDP (and the
+   regather/offload knobs) does not perturb a non-FSDP
+   (ShardedOptimizer) step: identical lowered HLO text hashes with
+   the knobs flipped (today's paths stay bit-for-bit);
+5. **Regather ≡ saved-gather bitwise** — the backward-regather policy
+   (HOROVOD_FSDP_REGATHER, the default) executes bit-identically to
+   the saved-gather lowering (params rows, optimizer state incl. the
+   int8 error-feedback residual, loss) on the plain AND int8 wires,
+   and HOROVOD_FSDP_REGATHER=0 reproduces the saved-gather lowering
+   hash-identically;
+6. **Measured peak liveness** — pre-opt HLO live-interval analysis
+   (overlap_check.analyze_liveness_preopt): under regather no
+   gathered bucket stays live from forward to backward — max
+   simultaneously-live gathers ≤ prefetch depth + O(1) working set,
+   while the saved-gather lowering holds every bucket live at the
+   forward→backward boundary (the negative control);
+7. **Offload smoke** — HOROVOD_FSDP_OFFLOAD=1 (host-RAM carry
+   offload) executes and stays bitwise-equal to offload-off.
 
 Usage:
     python scripts/fsdp_check.py --check
@@ -230,19 +245,154 @@ def check_knob_hash(failures):
         return js.lower(params, state, toks).as_text()
 
     knobs = global_state().knobs
-    old = knobs.fsdp
+    old = (knobs.fsdp, knobs.fsdp_regather, knobs.fsdp_offload,
+           knobs.fsdp_offload_duty)
     try:
         knobs.fsdp = True
         h_on = hashlib.sha256(build().encode()).hexdigest()
         knobs.fsdp = False
         h_off = hashlib.sha256(build().encode()).hexdigest()
+        knobs.fsdp = old[0]
+        knobs.fsdp_regather = not old[1]
+        knobs.fsdp_offload = True
+        knobs.fsdp_offload_duty = 0.5
+        h_new = hashlib.sha256(build().encode()).hexdigest()
     finally:
-        knobs.fsdp = old
-    print(f"knob-off lowering hash: on={h_on[:12]} off={h_off[:12]}")
+        (knobs.fsdp, knobs.fsdp_regather, knobs.fsdp_offload,
+         knobs.fsdp_offload_duty) = old
+    print(f"knob-off lowering hash: on={h_on[:12]} off={h_off[:12]} "
+          f"regather/offload-flipped={h_new[:12]}")
     if h_on != h_off:
         failures.append(
             "HOROVOD_FSDP flip changed a non-FSDP step's lowered HLO "
             "— the knob is no longer inert on existing paths")
+    if h_new != h_on:
+        failures.append(
+            "HOROVOD_FSDP_REGATHER/OFFLOAD flip changed a non-FSDP "
+            "step's lowered HLO — the new knobs leak outside the "
+            "FSDP staged path")
+
+
+def check_regather(args, failures):
+    """Properties 5–7: the backward-regather + offload policies.
+
+    Executes one step of the tiny vehicle under five lowerings —
+    saved-gather, regather, regather+offload (plain wire) and
+    saved/regather (int8 wire, EF residual in state) — and asserts
+    pairwise bitwise equality; proves the within-step peak bound
+    structurally on the pre-opt HLO (live-interval max overlap); and
+    pins HOROVOD_FSDP_REGATHER=0 to the explicit regather=False
+    lowering hash."""
+    import horovod_tpu as hvd
+    from horovod_tpu.core.state import global_state
+    from horovod_tpu.optim import fsdp as fsdp_mod
+    from overlap_check import (_model_pieces, analyze_liveness_preopt,
+                               build_fsdp_step)
+
+    mesh = hvd.mesh()
+    nchips = len(jax.devices())
+    cfg, model_obj, _, bpc = _model_pieces("tiny", 0)
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (bpc * nchips, cfg.max_seq_len)),
+        jnp.int32)
+    params = model_obj.init(jax.random.PRNGKey(0), toks[:1])["params"]
+
+    def _exec(js, layout, compression):
+        comp = (hvd.Compression.lookup(compression)
+                if compression else None)
+        opt = hvd.FullyShardedOptimizer(
+            optax.adamw(1e-4),
+            fusion_threshold_bytes=int(args.fusion_mb * (1 << 20)),
+            compression=comp)
+        r = js(fsdp_mod.shard_params(params, layout),
+               opt.init(params), toks)
+        jax.block_until_ready(r)
+        return r
+
+    results, liveness, lower_hash = {}, {}, {}
+    for key, comp, kw in (
+            ("saved", None, dict(regather=False)),
+            ("regather", None, dict(regather=True)),
+            ("offload", None, dict(regather=True, offload=True)),
+            ("saved_int8", "int8", dict(regather=False)),
+            ("regather_int8", "int8", dict(regather=True))):
+        js, rows_s, state_s, toks_s, layout = build_fsdp_step(
+            "tiny", mesh, nchips, args.fusion_mb, 0,
+            compression=comp, **kw)
+        low = js.lower(rows_s, state_s, toks_s)
+        if comp is None:
+            liveness[key] = analyze_liveness_preopt(
+                low.compiler_ir(dialect="hlo").as_hlo_text())
+            lower_hash[key] = hashlib.sha256(
+                low.as_text().encode()).hexdigest()
+        results[key] = _exec(js, layout, comp)
+
+    for a, b, lbl in (("saved", "regather", "plain wire"),
+                      ("saved_int8", "regather_int8", "int8+EF wire"),
+                      ("regather", "offload", "offload on/off")):
+        for i, part in enumerate(("params rows", "optimizer state",
+                                  "loss")):
+            if not _bitwise(results[a][i], results[b][i]):
+                failures.append(
+                    f"regather A/B ({lbl}): {part} NOT bitwise equal "
+                    f"({a} vs {b})")
+
+    # HOROVOD_FSDP_REGATHER=0 must reproduce the explicit
+    # regather=False lowering hash-identically
+    knobs = global_state().knobs
+    old = knobs.fsdp_regather
+    try:
+        knobs.fsdp_regather = False
+        js_k, rows_s, state_s, toks_s, _ = build_fsdp_step(
+            "tiny", mesh, nchips, args.fusion_mb, 0)
+        h_knob = hashlib.sha256(
+            js_k.lower(rows_s, state_s, toks_s).as_text().encode()
+        ).hexdigest()
+    finally:
+        knobs.fsdp_regather = old
+    if h_knob != lower_hash["saved"]:
+        failures.append(
+            "HOROVOD_FSDP_REGATHER=0 lowering differs from explicit "
+            "regather=False — the knob no longer reproduces the "
+            "saved-gather lowering bit-for-bit")
+
+    # structural peak-liveness proof: saved mode holds every bucket
+    # live across the forward→backward boundary (negative control);
+    # regather's max overlap stays within prefetch depth + the O(1)
+    # gather/consume working set, and it issues MORE gathers than
+    # buckets (the re-issue itself, visible in the instruction count)
+    n_buckets = liveness["saved"]["param_all_gathers"]
+    depth = int(getattr(global_state().knobs, "fsdp_prefetch", 1) or 1)
+    bound = depth + 3
+    print(json.dumps({
+        "buckets": n_buckets,
+        "liveness": {k: {"gathers": v["param_all_gathers"],
+                         "max_live": v["max_live_gathers"]}
+                     for k, v in liveness.items()},
+        "peak_live_bound_regather": bound,
+    }))
+    if liveness["saved"]["max_live_gathers"] < n_buckets:
+        failures.append(
+            f"negative control broken: saved-gather mode keeps only "
+            f"{liveness['saved']['max_live_gathers']} of {n_buckets} "
+            f"gathers live at peak — the liveness analyzer no longer "
+            f"sees the forward→backward retention it must refute")
+    for key in ("regather", "offload"):
+        if liveness[key]["max_live_gathers"] > bound:
+            failures.append(
+                f"{key}: {liveness[key]['max_live_gathers']} gathered "
+                f"buckets simultaneously live in the pre-opt HLO — "
+                f"exceeds prefetch depth + working set ({bound}); a "
+                f"gathered bucket survives the forward→backward "
+                f"boundary")
+        if liveness[key]["param_all_gathers"] <= n_buckets:
+            failures.append(
+                f"{key}: only {liveness[key]['param_all_gathers']} "
+                f"all-gathers for {n_buckets} buckets — backward is "
+                f"not re-issuing the collective")
+    print("regather: bitwise parity (plain, int8+EF, offload), "
+          "knob-off hash, peak-liveness bound hold")
 
 
 def main(argv=None):
@@ -263,12 +413,14 @@ def main(argv=None):
     layout, rows, _ = check_replicated_agreement(failures)
     check_memory_bound(layout, rows, failures)
     check_knob_hash(failures)
+    check_regather(args, failures)
     hvd.shutdown()
     if failures:
         for f in failures:
             print("fsdp check FAILED:", f)
         return 1
-    print("fsdp check OK: parity, pins, memory bound, knob hash")
+    print("fsdp check OK: parity, pins, memory bound, knob hash, "
+          "regather parity + peak liveness, offload smoke")
     return 0
 
 

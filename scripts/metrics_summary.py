@@ -269,9 +269,19 @@ def summarize(records):
     if fsdp:
         last = fsdp[-1]
         gathered = sum(f.get("gather_bytes", 0) for f in fsdp)
-        print(f"fsdp: param shard {_human_bytes(last['hbm_param_bytes'])}"
-              f" resident/device, {_human_bytes(gathered)} gathered "
-              f"over {len(fsdp)}/{len(records)} sharded steps")
+        line = (f"fsdp: param shard "
+                f"{_human_bytes(last['hbm_param_bytes'])}"
+                f" resident/device, {_human_bytes(gathered)} gathered "
+                f"over {len(fsdp)}/{len(records)} sharded steps")
+        regathered = sum(f.get("regather_bytes", 0) for f in fsdp)
+        if regathered:
+            line += (f", {_human_bytes(regathered)} re-gathered on "
+                     f"backward")
+        offloaded = sum(f.get("offload_bytes", 0) for f in fsdp)
+        if offloaded:
+            line += (f", {_human_bytes(offloaded)} carries offloaded "
+                     f"to host")
+        print(line)
 
     # continuous profiler (utils/prof.py, docs/timeline.md): hvd_mfu is
     # per-step once set_step_flops declared the model cost; attribution

@@ -529,14 +529,84 @@ def analyze_gather_preopt(txt, min_elems: int = 256):
     return out
 
 
+_PAT_VIEW = re.compile(
+    r' (dynamic-slice|slice|reshape|bitcast|copy|transpose)\(')
+
+
+def analyze_liveness_preopt(txt, min_elems: int = 256):
+    """Within-step liveness of gathered parameter buckets in the
+    PRE-optimization HLO: each parameter all-gather's text-order live
+    interval runs from its definition to the LAST line where the
+    gathered value — or any view-like alias of it (dynamic-slice,
+    slice, reshape, bitcast, copy, transpose) — appears as an
+    operand. Pre-opt text preserves trace order, so the maximum
+    number of simultaneously-live intervals is the within-step peak
+    gathered-bucket count the lowering commits to before any
+    scheduler runs: the saved-gather policy keeps every forward
+    gather's buffer alive across the forward→backward boundary
+    (max_live ≈ bucket count), the regather policy drops each bucket
+    at its last same-phase use and re-issues the collective on
+    backward (max_live ≈ prefetch depth + O(1) working set). An
+    operand use inside a called computation is charged to the call
+    line — remat bodies stay opaque, the call itself is the use."""
+    comps = _split_computations(txt)
+
+    def _gathers(body):
+        return [i for i, l in enumerate(body)
+                if re.search(r' all-gather\(', l)
+                and _ar_elems(l) >= min_elems]
+
+    best, ags = None, []
+    for name, body in comps.items():
+        a = _gathers(body)
+        if len(a) > len(ags):
+            best, ags = name, a
+    out = {"param_all_gathers": len(ags), "max_live_gathers": 0,
+           "live_intervals": []}
+    if best is None:
+        return out
+    body = comps[best]
+    lhs, refs = [], []
+    for l in body:
+        m = _PAT_LHS.match(l)
+        lhs.append(m.group(1) if m else None)
+        refs.append(re.findall(r'([A-Za-z_][\w-]*\.\d+)',
+                               l.split(" = ", 1)[1])
+                    if m and " = " in l else [])
+    intervals = []
+    for g in ags:
+        aliases = {lhs[g]}
+        end = g
+        for i in range(g + 1, len(body)):
+            if not aliases.intersection(refs[i]):
+                continue
+            end = i
+            if lhs[i] and _PAT_VIEW.search(body[i]):
+                aliases.add(lhs[i])
+        intervals.append((g, end))
+    events = []
+    for s, e in intervals:
+        events.append((s, 1))
+        events.append((e + 1, -1))
+    live = peak = 0
+    for _, d in sorted(events):
+        live += d
+        peak = max(peak, live)
+    out["max_live_gathers"] = peak
+    out["live_intervals"] = [[s, e] for s, e in intervals]
+    return out
+
+
 def build_fsdp_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
-                    mode="prefetch", compression=None, prefetch=None):
+                    mode="prefetch", compression=None, prefetch=None,
+                    regather=None, offload=None):
     """The FSDP train step over sharded parameter rows: same model
     config/loss/optimizer as build_step, parameters living as
     per-bucket row shards (optim/fsdp.py). ``mode="upfront"`` is the
     naive gather-everything-at-t0 reference; ``"prefetch"`` the
-    interleaved schedule. Returns (jitted step, rows, state, token
-    shape, layout)."""
+    interleaved schedule; ``regather``/``offload`` thread through to
+    the staged path (None = session knobs, docs/fsdp.md). Returns
+    (jitted step, rows, state, token shape, layout)."""
     import horovod_tpu as hvd
     from horovod_tpu.optim import fsdp as fsdp_mod
 
@@ -571,7 +641,9 @@ def build_fsdp_step(model_name, mesh, nchips, fusion_mb, batch_per_chip,
             model, b, lambda lg, _b=b: loss_of_logits(lg, _b))
 
     vag = fsdp_mod.fsdp_value_and_grad(stages_for, opt, layout,
-                                       mode=mode, prefetch=prefetch)
+                                       mode=mode, prefetch=prefetch,
+                                       regather=regather,
+                                       offload=offload)
 
     def step(r, s, b):
         l, g = vag(r, b, opt_state=s)

@@ -29,10 +29,12 @@ locally before the full pytest tier:
   provably pins backward compute behind the first gradient
   collective);
 * ``fsdp`` — ``scripts/fsdp_check.py --check`` (fully-sharded
-  parameters: prefetch-vs-upfront bitwise parity on plain + int8
-  wires, forward gather + backward reduce-scatter pin structure,
-  measured per-device param bytes ≤ replicated/world + one bucket,
-  and the HOROVOD_FSDP knob inert on non-FSDP lowerings);
+  parameters: prefetch-vs-upfront AND regather-vs-saved bitwise
+  parity on plain + int8 wires, forward gather + backward
+  reduce-scatter pin structure, measured per-device param bytes ≤
+  replicated/world + one bucket, the pre-opt HLO peak-liveness proof
+  of the regather within-step bound, the host-offload smoke, and the
+  HOROVOD_FSDP/REGATHER/OFFLOAD knobs inert on non-FSDP lowerings);
 * ``autotune`` — ``scripts/autotune_check.py --check`` (closed-loop
   autotuner: world-2 loopback sweep with skewed per-rank timings pins
   identical winners on both ranks, the pinned config is never worse
@@ -231,8 +233,8 @@ def check_overlap():
 
 def check_fsdp():
     """The fully-sharded-parameter gate (10th): parity vs the gathered
-    reference, pin structure both directions, memory bound, knob
-    hash."""
+    reference AND regather-vs-saved, pin structure both directions,
+    memory bound, peak-liveness proof, offload smoke, knob hashes."""
     env = _env()
     if "xla_force_host_platform_device_count" not in env.get(
             "XLA_FLAGS", ""):

@@ -24,7 +24,7 @@ v5e public interconnect: 1600 Gbps aggregate ICI per chip = 4 links x
 50 GB/s per direction (2D torus); a 16x16 slice is all-ICI (no DCN hop),
 so the 256-chip BASELINE point never leaves the torus.
 
-Usage: python scripts/scaling_projection.py [--out SCALING_PROJECTION_r05.json]
+Usage: python scripts/scaling_projection.py [--out SCALING_PROJECTION_r08.json]
 """
 
 import argparse
@@ -152,6 +152,12 @@ def _model_param_bytes(name):
     return total, abs_params
 
 
+# measured max simultaneously-live gathered buckets under the regather
+# policy (scripts/fsdp_check.py peak-liveness gate, prefetch depth 1:
+# consuming bucket + look-ahead + gather in flight)
+REGATHER_LIVE_BUCKETS = 3
+
+
 def _hbm_block(chips=(8, 64, 256)):
     """Per-chip HBM of the parameter + Adam(m,v) train state under the
     three layouts — replicated (DistributedOptimizer), ZeRO-1
@@ -160,7 +166,18 @@ def _hbm_block(chips=(8, 64, 256)):
     forward working set, fsdp_layout.max_bucket_bytes at the default
     128 MB fusion threshold). Activations/workspace excluded — this
     column answers "does the train STATE fit", the binding constraint
-    replication hits first. fits = per-chip bytes < 16 GB v5e HBM."""
+    replication hits first. fits = per-chip bytes < 16 GB v5e HBM.
+
+    hbm_peak_within_step: the TRAINING-step peak of parameter liveness
+    per chip, by gather policy — saved-gather (HOROVOD_FSDP_REGATHER=0)
+    keeps every gathered bucket alive in the vjp residuals from forward
+    to backward, so its peak is resident shards + the full replicated
+    params; the regather default re-issues each bucket's all-gather at
+    its backward-first-use boundary, capping the peak at resident
+    shards + a measured 3-bucket working set (fsdp_check.py liveness
+    gate). regather+offload shares the regather param bound — it
+    additionally parks inter-stage activation carries in pinned host
+    RAM, which this (activation-free) column cannot show."""
     from horovod_tpu.optim.fsdp import fsdp_layout
 
     out = {}
@@ -172,7 +189,11 @@ def _hbm_block(chips=(8, 64, 256)):
             state = 2 * pbytes  # Adam m+v, same dtype as params
             repl = pbytes + state
             zero1 = pbytes + state // n
-            fsdp = (pbytes + state) // n + layout.max_bucket_bytes
+            resident = (pbytes + state) // n
+            fsdp = resident + layout.max_bucket_bytes
+            peak_saved = resident + pbytes
+            peak_regather = (resident + REGATHER_LIVE_BUCKETS
+                             * layout.max_bucket_bytes)
             rows.append({
                 "chips": n,
                 "replicated_gb": round(repl / 1024**3, 3),
@@ -182,6 +203,18 @@ def _hbm_block(chips=(8, 64, 256)):
                     "replicated": repl < V5E_HBM_BYTES,
                     "zero1": zero1 < V5E_HBM_BYTES,
                     "fsdp": fsdp < V5E_HBM_BYTES,
+                },
+                "hbm_peak_within_step": {
+                    "saved_gather_gb": round(peak_saved / 1024**3, 3),
+                    "regather_gb": round(peak_regather / 1024**3, 3),
+                    "regather_offload_gb": round(
+                        peak_regather / 1024**3, 3),
+                    "fits_16gb": {
+                        "saved_gather": peak_saved < V5E_HBM_BYTES,
+                        "regather": peak_regather < V5E_HBM_BYTES,
+                        "regather_offload":
+                            peak_regather < V5E_HBM_BYTES,
+                    },
                 },
             })
         out[name] = {
@@ -203,7 +236,12 @@ def main(argv=None):
                          "--schedule-ab: its measured scheduled window "
                          "replaces the unscheduled one in a second "
                          "projection (default: newest in repo root)")
-    ap.add_argument("--out", default="SCALING_PROJECTION_r05.json")
+    ap.add_argument("--out", default="SCALING_PROJECTION_r08.json")
+    ap.add_argument("--fused-artifact", default="",
+                    help="FUSED_AB_*.json from fused_check.py: its "
+                         "loopback exposed-wire delta scales the "
+                         "256-chip exposed time in a fused-wire row "
+                         "(default: newest in repo root)")
     ap.add_argument("--multipod-out", default="",
                     help="also write the N-pod DCN-tier projection "
                          "(MULTIPOD_PROJECTION_r01.json): sync vs "
@@ -316,12 +354,19 @@ def main(argv=None):
                     "llama2-7b needs ~75 GB/chip replicated and ~25 GB "
                     "under ZeRO-1 (neither ever fits); FSDP brings the "
                     "resident state to 9.9 GB at 8 chips and 1.7 GB at "
-                    "64. Within-step caveat: the backward's vjp "
-                    "residuals hold the gathered weights, so training "
-                    "step-peak param liveness can still reach the "
-                    "replicated size until backward re-gather lands "
-                    "(docs/fsdp.md, the named follow-up) — this column "
-                    "is the resident/train-state bound.",
+                    "64. hbm_peak_within_step is the TRAINING-step "
+                    "param-liveness peak by gather policy: under the "
+                    "regather default (HOROVOD_FSDP_REGATHER, "
+                    "docs/fsdp.md) the backward re-issues each "
+                    "bucket's all-gather instead of saving gathered "
+                    "weights in vjp residuals, so the step peak is "
+                    "resident + a measured 3-bucket working set "
+                    "(fsdp_check.py liveness gate) rather than "
+                    "resident + full replicated params — the 7B class "
+                    "now FITS within-step at 8 chips. "
+                    "HOROVOD_FSDP_REGATHER=0 restores the old "
+                    "saved-gather bound (its former caveat applies "
+                    "only there).",
         "reference_claim": "docs/benchmarks.rst:8-13 (90% scaling, 512 "
                            "GPUs); BASELINE target >=90% at 256 chips",
     }
@@ -353,6 +398,48 @@ def main(argv=None):
     step_s = MODELS["bert-large"]["batch_tokens_per_chip"] / rate
     out["models"]["bert-large"] = _model_block(
         step_s, MODELS["bert-large"]["params"] * 4)
+
+    # fused computation-collective backend (docs/fused_collectives.md):
+    # fold the measured loopback exposed-wire delta into the 256-chip
+    # rows — the Pallas fused kernels shrink the exposed wire around
+    # each collective (FUSED_AB exposed_wire_frac_proxy, unfused vs
+    # fused), scaling the projected exposed time by the same factor
+    fused_path = args.fused_artifact
+    if not fused_path:
+        cands = sorted(f for f in os.listdir(root)
+                       if f.startswith("FUSED_AB_")
+                       and f.endswith(".json"))
+        fused_path = os.path.join(root, cands[-1]) if cands else ""
+    if fused_path and os.path.exists(fused_path):
+        with open(fused_path) as f:
+            fab = json.load(f)
+        runs = fab.get("runs", [])
+        off_r = next((r for r in runs if not r.get("fused")), None)
+        on_r = next((r for r in runs if r.get("fused")), None)
+        if off_r and on_r and off_r.get("exposed_wire_frac_proxy"):
+            scale = (on_r["exposed_wire_frac_proxy"]
+                     / off_r["exposed_wire_frac_proxy"])
+            for block in out["models"].values():
+                step_ms = block["step_ms_per_chip"]
+                for key in ("projection", "projection_scheduled"):
+                    r256 = next((r for r in block.get(key) or []
+                                 if r["chips"] == 256), None)
+                    if r256 is None:
+                        continue
+                    t_exp = r256["t_exposed_ms"] * scale
+                    r256["fused_wire"] = {
+                        "t_exposed_ms": round(t_exp, 3),
+                        "efficiency": round(
+                            step_ms / (step_ms + t_exp), 4),
+                    }
+            out["inputs"]["fused_wire_source"] = (
+                f"{os.path.basename(fused_path)}: loopback "
+                f"exposed_wire_frac_proxy "
+                f"{off_r['exposed_wire_frac_proxy']} unfused -> "
+                f"{on_r['exposed_wire_frac_proxy']} fused (x"
+                f"{round(scale, 4)} on the projected 256-chip exposed "
+                f"wire; CPU loopback proxy — TPU-hardware validation "
+                f"still pending)")
 
     txt = json.dumps(out, indent=1)
     print(txt)

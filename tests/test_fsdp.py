@@ -326,6 +326,9 @@ def test_make_lm_train_step_routes_fsdp_and_knob_gates(hvd8):
         snap = metrics.registry.snapshot()
         assert snap.get("hvd_hbm_param_bytes"), sorted(snap)
         assert snap.get("hvd_fsdp_gather_bytes_total"), sorted(snap)
+        # regather is the default policy: the backward re-issue
+        # telemetry must flow through the routed step too
+        assert snap.get("hvd_fsdp_regather_bytes_total"), sorted(snap)
     finally:
         metrics.reset()
 
@@ -360,5 +363,11 @@ def test_knobs_defaults_and_parser():
     k = Knobs()
     assert k.fsdp is True
     assert k.fsdp_prefetch == 1
+    assert k.fsdp_regather is True
+    assert k.fsdp_offload is False
+    assert k.fsdp_offload_duty == 1.0
     assert ARG_TO_ENV["fsdp"] == "HOROVOD_FSDP"
     assert ARG_TO_ENV["fsdp_prefetch"] == "HOROVOD_FSDP_PREFETCH"
+    assert ARG_TO_ENV["fsdp_regather"] == "HOROVOD_FSDP_REGATHER"
+    assert ARG_TO_ENV["fsdp_offload"] == "HOROVOD_FSDP_OFFLOAD"
+    assert ARG_TO_ENV["fsdp_offload_duty"] == "HOROVOD_FSDP_OFFLOAD_DUTY"

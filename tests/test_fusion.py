@@ -162,3 +162,51 @@ def test_bucket_prefetch_schedule_multi_leaf_buckets():
     assert need == [[0], [1], []]
     flat = [b for step in need for b in step]
     assert sorted(flat) == [0, 1]
+
+
+def test_bucket_regather_schedule_backward_direction():
+    """bucket_issue_schedule driven in the backward (regather)
+    direction (docs/fsdp.md): under HOROVOD_FSDP_REGATHER a bucket's
+    weights are re-needed at the LAST forward stage touching any of
+    its leaves — the earliest point the reversed traversal reaches it.
+    The tied-embedding bucket flips again: needed FIRST on backward
+    (the head's matmul transpose reads it in backward step 0) even
+    though its gradient completes LAST."""
+    from horovod_tpu.ops.fusion import bucket_regather_schedule
+
+    # stages: 0=embed, 1=block, 2=head(tied). leaves: 0=tok_emb (tied,
+    # stages 0 and 2), 1=block w (stage 1), 2=ln_final (stage 2)
+    plans = [[(0, 0, 4, (4,))], [(1, 0, 4, (4,))], [(2, 0, 4, (4,))]]
+    leaf_stages = [[0, 2], [1], [2]]
+    need = bucket_regather_schedule(
+        plans, [max(s) for s in leaf_stages], 3)
+    # backward step 0 = stage 2's backward: the tied bucket 0 and the
+    # head bucket 2 are both needed immediately; block bucket at step 1
+    assert need == [[0, 2], [1], []]
+
+
+def test_bucket_regather_schedule_multi_leaf_latest_need():
+    """A bucket mixing leaves whose last uses differ is re-needed at
+    the LATEST forward stage among them (= the earliest backward
+    step); scheduling at the earliest-ending leaf would arrive after
+    the first backward segment already read the weights."""
+    from horovod_tpu.ops.fusion import bucket_regather_schedule
+
+    # bucket 0 spans leaves last used at stages 0 and 2 -> the
+    # reversed walk hits stage 2 first: needed at backward step 0
+    plans = [[(0, 0, 4, (4,)), (1, 4, 4, (4,))], [(2, 0, 4, (4,))]]
+    need = bucket_regather_schedule(plans, [0, 2, 1], 3)
+    assert need == [[0], [1], []]
+
+
+def test_bucket_regather_schedule_exactly_once():
+    """Every bucket appears exactly once across the backward steps —
+    the exactly-once re-gather per backward the bitwise contract
+    rides on."""
+    from horovod_tpu.ops.fusion import bucket_regather_schedule
+
+    plans = [[(0, 0, 4, (4,)), (1, 4, 4, (4,))],
+             [(2, 0, 4, (4,))], [(3, 0, 4, (4,))]]
+    need = bucket_regather_schedule(plans, [1, 3, 0, 2], 4)
+    flat = [b for step in need for b in step]
+    assert sorted(flat) == [0, 1, 2]
